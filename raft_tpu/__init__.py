@@ -1,0 +1,26 @@
+"""raft_tpu — a TPU-native framework with the capabilities of RAPIDS RAFT.
+
+Built from scratch on JAX/XLA/Pallas/pjit. The reference (RAPIDS RAFT, CUDA) is a
+library of accelerated primitives for data science / ML: dense & sparse linear
+algebra, pairwise distances, nearest-neighbor search (brute-force, IVF-Flat,
+IVF-PQ, CAGRA), clustering, statistics, random generation, solvers, and a
+multi-node communicator fabric.  raft_tpu reproduces that capability surface
+idiomatically for TPU:
+
+- compute primitives are pure functions over ``jax.Array`` (XLA fuses them);
+- bespoke kernels (fused L2 1-NN, PQ-LUT scan, large-k select) are Pallas;
+- the reference's ``raft::resources`` handle (cpp/include/raft/core/resources.hpp)
+  becomes :class:`raft_tpu.core.Resources` carrying devices, mesh, PRNG state and
+  comms;
+- the reference's NCCL/UCX ``comms_t`` (cpp/include/raft/core/comms.hpp) becomes
+  a comms abstraction over XLA collectives on a ``jax.sharding.Mesh`` (ICI/DCN).
+"""
+
+from raft_tpu.core import (  # noqa: F401
+    Resources,
+    DeviceResources,
+    RaftError,
+    expects,
+)
+
+__version__ = "0.1.0"

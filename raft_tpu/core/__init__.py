@@ -1,0 +1,33 @@
+"""Core layer: resources handle, array contracts, error/logging/tracing,
+serialization, cooperative cancellation.
+
+Reference: cpp/include/raft/core/ (see SURVEY.md §2.1).
+"""
+
+from raft_tpu.core.error import RaftError, LogicError, expects, fail  # noqa: F401
+from raft_tpu.core.resources import (  # noqa: F401
+    Resources,
+    DeviceResources,
+    resource_type,
+)
+from raft_tpu.core.mdarray import (  # noqa: F401
+    ensure_array,
+    check_matrix,
+    check_vector,
+    check_rank,
+    check_same_shape,
+    check_same_dtype,
+    make_device_matrix,
+    make_device_vector,
+    make_device_scalar,
+    row_major,
+    col_major,
+)
+from raft_tpu.core.serialize import (  # noqa: F401
+    serialize_mdspan,
+    deserialize_mdspan,
+    serialize_scalar,
+    deserialize_scalar,
+)
+from raft_tpu.core.interruptible import interruptible, InterruptedException  # noqa: F401
+from raft_tpu.core import logger, tracing  # noqa: F401

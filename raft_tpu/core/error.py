@@ -1,0 +1,33 @@
+"""Error handling.
+
+TPU-native counterpart of the reference's exception machinery
+(cpp/include/raft/core/error.hpp: ``raft::exception``, ``raft::logic_error``,
+``RAFT_EXPECTS`` :168, ``RAFT_FAIL`` :184).  Python exceptions already carry
+backtraces, so the value here is the validation idiom: every public entry point
+validates its inputs with :func:`expects` so shape/dtype contract violations
+fail eagerly at trace time rather than deep inside XLA.
+"""
+
+from __future__ import annotations
+
+
+class RaftError(RuntimeError):
+    """Base exception for raft_tpu (reference: ``raft::exception``, error.hpp:67)."""
+
+
+class LogicError(RaftError):
+    """Invalid arguments / broken invariants (reference: ``raft::logic_error``, error.hpp:96)."""
+
+
+def expects(cond: bool, msg: str = "precondition violated") -> None:
+    """Validate a precondition; raise :class:`LogicError` on failure.
+
+    Reference: ``RAFT_EXPECTS(cond, fmt, ...)`` (core/error.hpp:168).
+    """
+    if not cond:
+        raise LogicError(msg)
+
+
+def fail(msg: str) -> None:
+    """Unconditionally raise (reference: ``RAFT_FAIL``, core/error.hpp:184)."""
+    raise LogicError(msg)

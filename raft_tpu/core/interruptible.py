@@ -1,0 +1,81 @@
+"""Cooperative cancellation.
+
+Counterpart of the reference's ``raft::interruptible``
+(cpp/include/raft/core/interruptible.hpp:66-130): a per-thread token that other
+CPU threads can ``cancel()``, causing the target thread's next
+``interruptible::synchronize`` (a stream-sync point) to raise.
+
+The TPU analogue: JAX dispatch is async and device work is not abortable
+mid-kernel (same as CUDA kernels), so the cancellation points are the host-side
+sync points — :func:`synchronize` here.  Long-running host loops (index build
+batching, k-means iterations) call :func:`synchronize` or :func:`yield_no_wait`
+each iteration, making them cancellable from another thread, mirroring how the
+reference threads cancellation through stream syncs.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import jax
+
+
+class InterruptedException(RuntimeError):
+    """Raised at a sync point after cancel() (reference: raft::interruptible::interrupted_exception)."""
+
+
+class interruptible:
+    _tokens: Dict[int, "interruptible"] = {}
+    _lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._cancelled = threading.Event()
+
+    # -- token registry (reference: get_token / get_token(thread_id)) --------
+    @classmethod
+    def get_token(cls, thread_id: Optional[int] = None) -> "interruptible":
+        tid = thread_id if thread_id is not None else threading.get_ident()
+        with cls._lock:
+            # prune tokens of dead threads so reused thread ids never inherit
+            # a stale cancellation (reference stores weak_ptr for the same
+            # reason, interruptible.hpp)
+            live = {t.ident for t in threading.enumerate()}
+            for dead in [k for k in cls._tokens if k not in live]:
+                del cls._tokens[dead]
+            tok = cls._tokens.get(tid)
+            if tok is None:
+                tok = interruptible()
+                cls._tokens[tid] = tok
+            return tok
+
+    def cancel(self) -> None:
+        """Flag the owning thread for interruption (reference: :cancel)."""
+        self._cancelled.set()
+
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    # -- sync points ---------------------------------------------------------
+    @classmethod
+    def yield_no_wait(cls) -> None:
+        """Check the current thread's token without blocking (reference: yield_no_wait)."""
+        tok = cls.get_token()
+        if tok._cancelled.is_set():
+            tok._cancelled.clear()
+            raise InterruptedException("raft_tpu: thread interrupted")
+
+    @classmethod
+    def synchronize(cls, *arrays: jax.Array) -> None:
+        """Block on device work, raising if cancelled (reference: :synchronize :78).
+
+        With arrays given, blocks until those are ready; otherwise drains all
+        dispatched work.
+        """
+        cls.yield_no_wait()
+        if arrays:
+            for a in arrays:
+                a.block_until_ready()
+        else:
+            jax.effects_barrier()
+        cls.yield_no_wait()

@@ -1,0 +1,113 @@
+"""Array contracts.
+
+The reference builds its entire API on ``mdspan``/``mdarray`` — non-owning
+multi-dim views with compile-time layout and host/device accessor tags
+(cpp/include/raft/core/device_mdspan.hpp:39,161,256; device_mdarray.hpp:47-172;
+mdarray.hpp).  On TPU, ``jax.Array`` already *is* an owning, device-placed,
+layout-carrying multi-dim array, and XLA picks physical layouts — so a vendored
+mdspan would be pure ceremony.
+
+What survives is the *contract*: every public function states and checks the
+rank/shape/dtype relationships of its arguments up front (the role
+``RAFT_EXPECTS`` + typed mdspan signatures play in the reference).  This module
+provides those checkers plus the ``make_*`` factories mirroring the reference
+naming so ported call sites read the same.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.error import expects
+
+ArrayLike = Union[jax.Array, np.ndarray]
+
+# Layout tags for API parity (reference: layout_c_contiguous / layout_f_contiguous).
+# XLA controls physical layout; these document logical index order only.
+row_major = "row_major"
+col_major = "col_major"
+
+
+def ensure_array(x: ArrayLike, name: str = "array") -> jax.Array:
+    """Ingest any array-like (numpy, dlpack-capable, jax) as a jax.Array.
+
+    Plays the role of pylibraft's ``cai_wrapper``/``ai_wrapper`` zero-copy
+    ingestion (python/pylibraft/pylibraft/common/cai_wrapper.py:21).
+    """
+    if isinstance(x, jax.Array):
+        return x
+    if hasattr(x, "__dlpack__") and not isinstance(x, np.ndarray):
+        return jnp.from_dlpack(x)
+    return jnp.asarray(x)
+
+
+def check_rank(x: jax.Array, rank: int, name: str = "array") -> None:
+    expects(x.ndim == rank, f"{name}: expected rank {rank}, got {x.ndim}")
+
+
+def check_matrix(x: ArrayLike, name: str = "matrix",
+                 dtype: Optional[jnp.dtype] = None,
+                 rows: Optional[int] = None,
+                 cols: Optional[int] = None) -> jax.Array:
+    """Validate a rank-2 array (reference: device_matrix_view contract)."""
+    x = ensure_array(x, name)
+    check_rank(x, 2, name)
+    if dtype is not None:
+        expects(x.dtype == jnp.dtype(dtype),
+                f"{name}: expected dtype {jnp.dtype(dtype)}, got {x.dtype}")
+    if rows is not None:
+        expects(x.shape[0] == rows, f"{name}: expected {rows} rows, got {x.shape[0]}")
+    if cols is not None:
+        expects(x.shape[1] == cols, f"{name}: expected {cols} cols, got {x.shape[1]}")
+    return x
+
+
+def check_vector(x: ArrayLike, name: str = "vector",
+                 dtype: Optional[jnp.dtype] = None,
+                 size: Optional[int] = None) -> jax.Array:
+    """Validate a rank-1 array (reference: device_vector_view contract)."""
+    x = ensure_array(x, name)
+    check_rank(x, 1, name)
+    if dtype is not None:
+        expects(x.dtype == jnp.dtype(dtype),
+                f"{name}: expected dtype {jnp.dtype(dtype)}, got {x.dtype}")
+    if size is not None:
+        expects(x.shape[0] == size, f"{name}: expected size {size}, got {x.shape[0]}")
+    return x
+
+
+def check_same_shape(a: jax.Array, b: jax.Array,
+                     names: Tuple[str, str] = ("a", "b")) -> None:
+    expects(a.shape == b.shape,
+            f"{names[0]} shape {a.shape} != {names[1]} shape {b.shape}")
+
+
+def check_same_dtype(*arrays: jax.Array) -> None:
+    dts = {a.dtype for a in arrays}
+    expects(len(dts) == 1, f"dtype mismatch: {sorted(map(str, dts))}")
+
+
+# -- factories mirroring reference naming (device_mdarray.hpp:134-172) -------
+
+def make_device_matrix(res, n_rows: int, n_cols: int,
+                       dtype=jnp.float32) -> jax.Array:
+    """Zero-initialised (n_rows, n_cols) array on the handle's device."""
+    dev = res.device if res is not None else None
+    arr = jnp.zeros((n_rows, n_cols), dtype=dtype)
+    return jax.device_put(arr, dev) if dev is not None else arr
+
+
+def make_device_vector(res, n: int, dtype=jnp.float32) -> jax.Array:
+    dev = res.device if res is not None else None
+    arr = jnp.zeros((n,), dtype=dtype)
+    return jax.device_put(arr, dev) if dev is not None else arr
+
+
+def make_device_scalar(res, value, dtype=jnp.float32) -> jax.Array:
+    dev = res.device if res is not None else None
+    arr = jnp.asarray(value, dtype=dtype)
+    return jax.device_put(arr, dev) if dev is not None else arr

@@ -1,0 +1,203 @@
+"""Resource handle.
+
+TPU-native counterpart of the reference's ``raft::resources`` registry
+(cpp/include/raft/core/resources.hpp:46,90,109) and ``raft::device_resources``
+(cpp/include/raft/core/device_resources.hpp:60).
+
+The reference carries all expensive, device-bound state — CUDA stream(s),
+cuBLAS/cuSOLVER handles, communicator, workspace allocator — in a type-erased
+map of lazily-created resources keyed by ``resource_type``
+(core/resource/resource_types.hpp:29-45).  Copying a ``resources`` shares the
+*factories*, and each resource is instantiated on first access.
+
+On TPU the analogous expensive state is:
+
+- the set of :class:`jax.Device` s and the :class:`jax.sharding.Mesh` laid over
+  them (the stream-pool / sub-communicator analogue);
+- the PRNG key chain (the reference threads an ``rng_state`` separately; here
+  it lives in the handle so algorithms can draw keys deterministically);
+- the communicator (:mod:`raft_tpu.comms`) bound to a mesh axis;
+- donated workspace buffers (the RMM workspace-resource analogue) — on TPU,
+  XLA owns allocation, so the workspace slot records a *byte budget* used by
+  batching heuristics instead of an allocator.
+
+Compute primitives in raft_tpu are pure functions (jit-friendly); the handle is
+passed to stateful entry points (index build, random generation, distributed
+algorithms) exactly where the reference passes ``raft::resources const&``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+
+from raft_tpu.core.error import expects
+
+
+class resource_type:
+    """Well-known resource slots (reference: core/resource/resource_types.hpp:29-45).
+
+    CUDA-specific slots (CUBLAS_HANDLE, CUDA_STREAM_VIEW, ...) have no TPU
+    meaning; their roles map onto the slots below.
+    """
+
+    DEVICE = "device"              # reference: DEVICE_ID
+    DEVICES = "devices"            # reference: CUDA_STREAM_POOL (parallel lanes)
+    MESH = "mesh"                  # reference: none; TPU-native device grid
+    COMMUNICATOR = "communicator"  # reference: COMMUNICATOR
+    SUB_COMMUNICATOR = "sub_communicator"  # reference: SUB_COMMUNICATOR
+    RNG = "rng"                    # PRNG key chain
+    WORKSPACE = "workspace"        # reference: WORKSPACE_RESOURCE (byte budget here)
+    DEVICE_PROPERTIES = "device_properties"
+
+
+class Resources:
+    """Type-erased registry of lazily-created resources.
+
+    Reference: ``class resources`` (core/resources.hpp:46); factories are
+    registered with :meth:`add_resource_factory` (:90) and instantiated on the
+    first :meth:`get_resource` (:109).  Copies share factories; instantiated
+    resources are created per-copy, mirroring the reference semantics.
+    """
+
+    def __init__(self, other: Optional["Resources"] = None) -> None:
+        self._factories: Dict[str, Callable[[], Any]] = (
+            dict(other._factories) if other is not None else {}
+        )
+        self._resources: Dict[str, Any] = {}
+
+    def add_resource_factory(self, rtype: str, factory: Callable[[], Any]) -> None:
+        self._factories[rtype] = factory
+        self._resources.pop(rtype, None)
+
+    def has_resource_factory(self, rtype: str) -> bool:
+        return rtype in self._factories
+
+    def get_resource(self, rtype: str) -> Any:
+        if rtype not in self._resources:
+            expects(rtype in self._factories,
+                    f"no factory registered for resource '{rtype}'")
+            self._resources[rtype] = self._factories[rtype]()
+        return self._resources[rtype]
+
+
+def _default_device() -> jax.Device:
+    return jax.devices()[0]
+
+
+class DeviceResources(Resources):
+    """Accelerator-flavored handle (reference: device_resources.hpp:60-232).
+
+    Parameters
+    ----------
+    device:
+        Primary device; defaults to ``jax.devices()[0]``.
+    devices:
+        Device set for multi-device work; defaults to ``[device]``.
+    mesh:
+        Optional :class:`jax.sharding.Mesh` for sharded execution; lazily built
+        as a 1-D ``("data",)`` mesh over ``devices`` when first requested.
+    seed:
+        Seed for the handle's PRNG chain.
+    workspace_bytes:
+        Byte budget batching heuristics may assume resident at once
+        (reference: WORKSPACE_RESOURCE / rmm limiting adaptor,
+        core/resource/device_memory_resource.hpp:41-73).
+    """
+
+    def __init__(
+        self,
+        device: Optional[jax.Device] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        seed: int = 0,
+        workspace_bytes: int = 1 << 30,
+    ) -> None:
+        super().__init__()
+        self.add_resource_factory(
+            resource_type.DEVICE,
+            (lambda: device) if device is not None else _default_device,
+        )
+        self.add_resource_factory(
+            resource_type.DEVICES,
+            (lambda: list(devices)) if devices is not None
+            else (lambda: [self.get_resource(resource_type.DEVICE)]),
+        )
+        if mesh is not None:
+            self.add_resource_factory(resource_type.MESH, lambda: mesh)
+        else:
+            self.add_resource_factory(resource_type.MESH, self._make_default_mesh)
+        self.add_resource_factory(resource_type.RNG, lambda: _RngChain(seed))
+        self.add_resource_factory(resource_type.WORKSPACE, lambda: workspace_bytes)
+
+    def _make_default_mesh(self) -> jax.sharding.Mesh:
+        devs = np.asarray(self.get_resource(resource_type.DEVICES))
+        return jax.sharding.Mesh(devs, ("data",))
+
+    # -- accessors mirroring device_resources.hpp ---------------------------
+    @property
+    def device(self) -> jax.Device:
+        """Primary device (reference: get_device_id)."""
+        return self.get_resource(resource_type.DEVICE)
+
+    @property
+    def devices(self) -> Sequence[jax.Device]:
+        return self.get_resource(resource_type.DEVICES)
+
+    @property
+    def mesh(self) -> jax.sharding.Mesh:
+        return self.get_resource(resource_type.MESH)
+
+    @property
+    def workspace_bytes(self) -> int:
+        return self.get_resource(resource_type.WORKSPACE)
+
+    # -- PRNG ---------------------------------------------------------------
+    def next_key(self) -> jax.Array:
+        """Draw the next PRNG key from the handle's deterministic chain."""
+        return self.get_resource(resource_type.RNG).next_key()
+
+    # -- comms (reference: device_resources.hpp get_comms :209) -------------
+    def set_comms(self, comms: Any) -> None:
+        """Inject a communicator (reference: comms/std_comms.hpp inject pattern)."""
+        self.add_resource_factory(resource_type.COMMUNICATOR, lambda: comms)
+
+    def get_comms(self) -> Any:
+        return self.get_resource(resource_type.COMMUNICATOR)
+
+    def comms_initialized(self) -> bool:
+        return self.has_resource_factory(resource_type.COMMUNICATOR)
+
+    def set_sub_comms(self, key: str, comms: Any) -> None:
+        """Register a sub-communicator by key (reference: sub_comms.hpp)."""
+        subs = self._resources.setdefault(resource_type.SUB_COMMUNICATOR, {})
+        subs[key] = comms
+
+    def get_sub_comms(self, key: str) -> Any:
+        subs = self._resources.get(resource_type.SUB_COMMUNICATOR, {})
+        expects(key in subs, f"no sub-communicator '{key}'")
+        return subs[key]
+
+    def sync(self) -> None:
+        """Block until enqueued device work completes.
+
+        Reference: ``device_resources::sync_stream`` (:164).  JAX dispatch is
+        async; this is the barrier tests/benchmarks use.
+        """
+        jax.effects_barrier()
+
+
+class _RngChain:
+    """Deterministic PRNG key chain (reference analogue: rng_state's
+    seed+subsequence, random/rng_state.hpp:28-52 — jax keys are already
+    counter-based, so a fold_in chain is the native fit)."""
+
+    def __init__(self, seed: int) -> None:
+        self._key = jax.random.key(seed)
+        self._count = 0
+
+    def next_key(self) -> jax.Array:
+        self._count += 1
+        return jax.random.fold_in(self._key, self._count)

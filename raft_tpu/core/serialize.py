@@ -1,0 +1,52 @@
+"""numpy-format array serialization.
+
+Counterpart of the reference's mdspan (de)serializer that writes the numpy
+``.npy`` wire format to iostreams (cpp/include/raft/core/serialize.hpp:34-124,
+core/detail/mdspan_numpy_serializer.hpp).  Index serializers
+(:mod:`raft_tpu.neighbors`) compose these with a version header exactly like
+neighbors/detail/ivf_pq_serialize.cuh.
+
+We use :func:`numpy.lib.format.write_array` which emits the identical format
+(the reference hand-rolls the same header), plus scalar helpers.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import BinaryIO
+
+import jax
+import numpy as np
+from numpy.lib import format as npy_format
+
+_SCALAR_MAGIC = b"RTSC"
+
+
+def serialize_mdspan(res, stream: BinaryIO, arr) -> None:
+    """Write an array in ``.npy`` format (reference: serialize.hpp:34-67)."""
+    np_arr = np.asarray(jax.device_get(arr) if isinstance(arr, jax.Array) else arr)
+    npy_format.write_array(stream, np_arr, allow_pickle=False)
+
+
+def deserialize_mdspan(res, stream: BinaryIO) -> np.ndarray:
+    """Read an array in ``.npy`` format (reference: serialize.hpp:81-124)."""
+    return npy_format.read_array(stream, allow_pickle=False)
+
+
+def serialize_scalar(res, stream: BinaryIO, value) -> None:
+    """Write one scalar with a dtype tag (reference: serialize_scalar)."""
+    arr = np.asarray(value)
+    dt = arr.dtype.str.encode()
+    stream.write(_SCALAR_MAGIC)
+    stream.write(struct.pack("<B", len(dt)))
+    stream.write(dt)
+    stream.write(arr.tobytes())
+
+
+def deserialize_scalar(res, stream: BinaryIO):
+    magic = stream.read(4)
+    if magic != _SCALAR_MAGIC:
+        raise ValueError("corrupt scalar stream (bad magic)")
+    (n,) = struct.unpack("<B", stream.read(1))
+    dtype = np.dtype(stream.read(n).decode())
+    return np.frombuffer(stream.read(dtype.itemsize), dtype=dtype)[0]

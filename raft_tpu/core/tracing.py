@@ -1,0 +1,54 @@
+"""Tracing / profiling annotations.
+
+Counterpart of the reference's NVTX ranges (cpp/include/raft/core/nvtx.hpp:48-76):
+RAII ``common::nvtx::range<domain>`` plus ``push_range``/``pop_range``, used at
+every algorithm entry point.  On TPU the profiler is ``jax.profiler`` and the
+annotation primitive is ``jax.named_scope`` / ``jax.profiler.TraceAnnotation``;
+we expose the same surface.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Iterator, List
+
+import jax
+
+
+class domain:
+    """Annotation domains (reference: core/nvtx.hpp ``domain::app`` / ``domain::raft``)."""
+
+    app = "app"
+    raft = "raft_tpu"
+
+
+_range_stack: List[Any] = []
+
+
+@contextlib.contextmanager
+def range(name: str, *fmt_args: Any, domain: str = domain.raft) -> Iterator[None]:
+    """RAII-style trace range (reference: ``common::nvtx::range``, core/nvtx.hpp:76).
+
+    Inside a traced/jitted computation this adds a named scope to the HLO (so
+    the op shows up grouped in the TPU profiler); outside it also emits a
+    ``jax.profiler`` trace annotation visible in host traces.
+    """
+    if fmt_args:
+        name = name % fmt_args
+    label = f"{domain}:{name}"
+    with jax.named_scope(label), jax.profiler.TraceAnnotation(label):
+        yield
+
+
+def push_range(name: str, *fmt_args: Any) -> None:
+    """Imperative begin-range (reference: core/nvtx.hpp ``push_range``)."""
+    cm = range(name, *fmt_args)
+    cm.__enter__()
+    _range_stack.append(cm)
+
+
+def pop_range() -> None:
+    """Imperative end-range (reference: core/nvtx.hpp ``pop_range``)."""
+    if _range_stack:
+        cm = _range_stack.pop()
+        cm.__exit__(None, None, None)

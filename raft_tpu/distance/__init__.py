@@ -1,0 +1,31 @@
+"""Pairwise distances.
+
+Reference: cpp/include/raft/distance/ (SURVEY.md §2.2) — 20 metrics
+(distance_types.hpp:23-70), a tiled GEMM-like pairwise kernel with CUTLASS
+dispatch, and the fused L2 + 1-NN argmin kernel (fused_l2_nn.cuh:100) that
+powers k-means and IVF builds.
+
+TPU-first design: the whole CUDA dispatch tree collapses into two paths —
+(1) "expanded" metrics whose inner term is an inner product ride
+``lax.dot_general`` on the MXU with an elementwise epilogue XLA fuses;
+(2) genuinely elementwise metrics (L1, Linf, Canberra, ...) run through a
+row-tiled broadcast engine that bounds memory at tile_m x n x k.
+``fused_l2_nn`` keeps the reference's contract (1-NN without materialising the
+n x m matrix) as a scan over database tiles with a running (min, argmin).
+"""
+
+from raft_tpu.distance.types import DistanceType  # noqa: F401
+from raft_tpu.distance.pairwise import (  # noqa: F401
+    pairwise_distance,
+    distance,
+)
+from raft_tpu.distance.fused_l2_nn import (  # noqa: F401
+    fused_l2_nn,
+    fused_l2_nn_min_reduce,
+)
+from raft_tpu.distance.masked_nn import masked_l2_nn  # noqa: F401
+from raft_tpu.distance.kernels import (  # noqa: F401
+    KernelParams,
+    KernelType,
+    gram_matrix,
+)

@@ -1,0 +1,50 @@
+"""Gram / kernel matrices (SVM support).
+
+Reference: raft/distance/kernels.cuh + detail/kernels/ — polynomial, tanh and
+RBF kernels over dense inputs, each a GEMM plus epilogue.  Pure MXU work on
+TPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.distance.pairwise import _inner, _l2_expanded
+
+
+class KernelType(enum.IntEnum):
+    """Reference: detail/kernels/kernel_factory KernelType."""
+
+    LINEAR = 0
+    POLYNOMIAL = 1
+    RBF = 2
+    TANH = 3
+
+
+@dataclasses.dataclass
+class KernelParams:
+    """Reference: kernels.cuh ``KernelParams``."""
+
+    kernel: KernelType = KernelType.LINEAR
+    degree: int = 3
+    gamma: float = 1.0
+    coef0: float = 0.0
+
+
+def gram_matrix(x: jax.Array, y: jax.Array,
+                params: KernelParams = KernelParams()) -> jax.Array:
+    """K(x, y) per params (reference: kernels.cuh GramMatrix::evaluate)."""
+    if params.kernel == KernelType.LINEAR:
+        return _inner(x, y)
+    if params.kernel == KernelType.POLYNOMIAL:
+        return jnp.power(params.gamma * _inner(x, y) + params.coef0,
+                         params.degree)
+    if params.kernel == KernelType.TANH:
+        return jnp.tanh(params.gamma * _inner(x, y) + params.coef0)
+    if params.kernel == KernelType.RBF:
+        return jnp.exp(-params.gamma * _l2_expanded(x, y))
+    raise ValueError(f"unknown kernel {params.kernel}")

@@ -1,0 +1,59 @@
+"""Masked nearest-neighbor.
+
+Reference: raft/distance/masked_nn.cuh — fused L2 1-NN where an adjacency mask
+restricts which (row, group) pairs participate (used by connect_components in
+single-linkage).  The reference compresses the mask to bits
+(detail/compress_to_bits.cuh); on TPU a dense bool mask folded into the
+distance epilogue is the fused form — XLA keeps it in the matmul consumer.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+
+def masked_l2_nn(
+    x: jax.Array,
+    y: jax.Array,
+    adj: jax.Array,
+    group_idxs: jax.Array,
+    *,
+    sqrt: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """For each row i of x: nearest row j of y with adj[i, group(j)] true.
+
+    ``adj`` is (m, n_groups) bool; ``group_idxs`` is (n_groups,) *end offsets*
+    of each contiguous group of y rows (reference: masked_nn.cuh group_idxs
+    convention).  Returns (dists (m,), idx (m,)); masked-out rows yield inf/0.
+    """
+    expects(x.ndim == 2 and y.ndim == 2 and x.shape[1] == y.shape[1],
+            "masked_l2_nn: (m,k),(n,k) required")
+    m, n = x.shape[0], y.shape[0]
+    n_groups = adj.shape[1]
+    expects(group_idxs.shape[0] == n_groups, "group_idxs vs adj mismatch")
+
+    # group id of every y row from end-offsets: group[j] = #ends <= j
+    j = jnp.arange(n)
+    group_of_y = jnp.sum(j[:, None] >= group_idxs[None, :], axis=1)
+
+    xf = x.astype(jnp.float32)
+    yf = y.astype(jnp.float32)
+    from raft_tpu.utils.precision import get_matmul_precision
+    ip = jax.lax.dot_general(xf, yf, (((1,), (1,)), ((), ())),
+                             precision=get_matmul_precision(),
+                             preferred_element_type=jnp.float32)
+    d = (jnp.sum(xf * xf, axis=1)[:, None]
+         + jnp.sum(yf * yf, axis=1)[None, :] - 2.0 * ip)
+    d = jnp.maximum(d, 0.0)
+    mask = jnp.take(adj, group_of_y, axis=1)  # (m, n)
+    d = jnp.where(mask, d, jnp.inf)
+    best = jnp.min(d, axis=1)
+    arg = jnp.argmin(d, axis=1).astype(jnp.int32)
+    if sqrt:
+        best = jnp.sqrt(best)
+    return best, arg

@@ -1,0 +1,53 @@
+"""Dense linear algebra primitives.
+
+Reference: cpp/include/raft/linalg/ (71 files, SURVEY.md §2.3) — cuBLAS/cuSOLVER
+wrappers plus element-wise / reduction fusion primitives.  On TPU every one of
+these lowers to XLA ops that the compiler fuses and maps onto the MXU/VPU, so
+the value kept here is the API names and semantics (axis conventions, norm
+types, key-grouped reductions) so reference call sites translate 1:1.
+"""
+
+from raft_tpu.linalg.blas import gemm, gemv, axpy, dot, transpose  # noqa: F401
+from raft_tpu.linalg.solvers import (  # noqa: F401
+    eig_dc,
+    eig_jacobi,
+    svd,
+    svd_qr,
+    rsvd,
+    qr_get_q,
+    qr_get_qr,
+    lstsq,
+    cholesky,
+    cholesky_rank_one_update,
+)
+from raft_tpu.linalg.eltwise import (  # noqa: F401
+    unary_op,
+    binary_op,
+    ternary_op,
+    map,
+    map_offset,
+    map_reduce,
+    add,
+    subtract,
+    multiply,
+    divide,
+    eltwise_power,
+    eltwise_sqrt,
+    scalar_add,
+    scalar_multiply,
+    matrix_vector_op,
+)
+from raft_tpu.linalg.reduce import (  # noqa: F401
+    NormType,
+    Apply,
+    reduce,
+    coalesced_reduction,
+    strided_reduction,
+    norm,
+    row_norm,
+    col_norm,
+    normalize,
+    reduce_rows_by_key,
+    reduce_cols_by_key,
+    mean_squared_error,
+)

@@ -1,0 +1,84 @@
+"""BLAS-level primitives.
+
+Reference: raft/linalg/gemm.cuh (detail/gemm.hpp:71-238 → cublasgemm),
+gemv.cuh, axpy.cuh, dot.cuh.  On TPU these are ``lax.dot_general`` — XLA tiles
+them onto the MXU; ``preferred_element_type`` keeps fp32 accumulation for
+bf16/int8 inputs (the tensor-core-accumulator analogue).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+
+def gemm(x: jax.Array, y: jax.Array, *,
+         alpha: float = 1.0, beta: float = 0.0,
+         z: Optional[jax.Array] = None,
+         trans_x: bool = False, trans_y: bool = False,
+         precision=None) -> jax.Array:
+    """out = alpha * op(x) @ op(y) + beta * z (reference: linalg/gemm.cuh)."""
+    a = x.T if trans_x else x
+    b = y.T if trans_y else y
+    expects(a.ndim == 2 and b.ndim == 2, "gemm: rank-2 inputs required")
+    expects(a.shape[1] == b.shape[0],
+            f"gemm: inner dims mismatch {a.shape} @ {b.shape}")
+    in_t = jnp.promote_types(x.dtype, y.dtype)
+    # integer gemm returns the wide accumulator (cublas int8->int32 contract);
+    # float gemm accumulates in >=fp32 and returns the promoted float type
+    acc_t = jnp.promote_types(in_t, jnp.int32) if jnp.issubdtype(in_t, jnp.integer) \
+        else jnp.promote_types(in_t, jnp.float32)
+    if precision is None:
+        from raft_tpu.utils.precision import get_matmul_precision
+        precision = get_matmul_precision()
+    out = jax.lax.dot_general(
+        a, b, (((1,), (0,)), ((), ())),
+        precision=precision,
+        preferred_element_type=acc_t,
+    )
+    if not jnp.issubdtype(in_t, jnp.integer):
+        out = out.astype(in_t if jnp.issubdtype(in_t, jnp.floating) else acc_t)
+    if alpha != 1.0:
+        out = alpha * out
+    if beta != 0.0:
+        expects(z is not None, "gemm: beta != 0 requires z")
+        out = out + beta * z
+    return out
+
+
+def gemv(A: jax.Array, x: jax.Array, *,
+         alpha: float = 1.0, beta: float = 0.0,
+         y: Optional[jax.Array] = None,
+         trans: bool = False) -> jax.Array:
+    """out = alpha * op(A) @ x + beta * y (reference: linalg/gemv.cuh)."""
+    a = A.T if trans else A
+    expects(a.ndim == 2 and x.ndim == 1, "gemv: A rank-2, x rank-1")
+    expects(a.shape[1] == x.shape[0], "gemv: dims mismatch")
+    from raft_tpu.utils.precision import get_matmul_precision
+    out = jnp.matmul(a, x, precision=get_matmul_precision())
+    if alpha != 1.0:
+        out = alpha * out
+    if beta != 0.0:
+        expects(y is not None, "gemv: beta != 0 requires y")
+        out = out + beta * y
+    return out
+
+
+def axpy(alpha: float, x: jax.Array, y: jax.Array) -> jax.Array:
+    """alpha * x + y (reference: linalg/axpy.cuh)."""
+    return alpha * x + y
+
+
+def dot(x: jax.Array, y: jax.Array) -> jax.Array:
+    """Inner product of flat vectors (reference: linalg/dot.cuh)."""
+    expects(x.shape == y.shape, "dot: shape mismatch")
+    return jnp.vdot(x, y)
+
+
+def transpose(x: jax.Array) -> jax.Array:
+    """Reference: linalg/transpose.cuh."""
+    return x.T

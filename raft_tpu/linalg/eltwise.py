@@ -1,0 +1,112 @@
+"""Element-wise / fusion primitives.
+
+Reference: raft/linalg/{unary_op,binary_op,ternary_op,map,map_reduce,
+matrix_vector_op,eltwise,add,subtract,multiply,divide,power,sqrt}.cuh.  XLA
+fuses chains of these automatically on TPU, so each is a direct jnp expression;
+the named wrappers keep call-site parity with the reference.
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+
+def unary_op(x: jax.Array, op: Callable[[jax.Array], jax.Array]) -> jax.Array:
+    """Reference: linalg/unary_op.cuh."""
+    return op(x)
+
+
+def binary_op(x: jax.Array, y: jax.Array,
+              op: Callable[[jax.Array, jax.Array], jax.Array]) -> jax.Array:
+    """Reference: linalg/binary_op.cuh."""
+    return op(x, y)
+
+
+def ternary_op(x: jax.Array, y: jax.Array, z: jax.Array,
+               op: Callable[[jax.Array, jax.Array, jax.Array], jax.Array]
+               ) -> jax.Array:
+    """Reference: linalg/ternary_op.cuh."""
+    return op(x, y, z)
+
+
+def map(op: Callable, *arrays: jax.Array) -> jax.Array:
+    """N-ary elementwise map (reference: linalg/map.cuh ``map``)."""
+    return op(*arrays)
+
+
+def map_offset(op: Callable, shape, dtype=jnp.int32) -> jax.Array:
+    """Map over flat element offsets (reference: linalg/map.cuh ``map_offset``)."""
+    import numpy as _np
+    n = int(_np.prod(shape))
+    idx = jnp.arange(n, dtype=dtype)
+    return op(idx).reshape(shape)
+
+
+def map_reduce(op: Callable, reduce_op: Callable, neutral,
+               *arrays: jax.Array) -> jax.Array:
+    """Fused map-then-reduce (reference: linalg/map_reduce.cuh,
+    map_then_reduce.cuh) — XLA fuses the map into the reduction."""
+    mapped = op(*arrays)
+    flat = mapped.reshape(-1)
+    return jax.lax.reduce(flat, jnp.asarray(neutral, flat.dtype), reduce_op, (0,))
+
+
+def add(x, y):
+    """Reference: linalg/add.cuh."""
+    return jnp.add(x, y)
+
+
+def subtract(x, y):
+    """Reference: linalg/subtract.cuh."""
+    return jnp.subtract(x, y)
+
+
+def multiply(x, y):
+    """Reference: linalg/multiply.cuh."""
+    return jnp.multiply(x, y)
+
+
+def divide(x, y):
+    """Reference: linalg/divide.cuh."""
+    return jnp.divide(x, y)
+
+
+def eltwise_power(x, y):
+    """Reference: linalg/power.cuh."""
+    return jnp.power(x, y)
+
+
+def eltwise_sqrt(x):
+    """Reference: linalg/sqrt.cuh."""
+    return jnp.sqrt(x)
+
+
+def scalar_add(x, scalar):
+    return x + scalar
+
+
+def scalar_multiply(x, scalar):
+    return x * scalar
+
+
+def matrix_vector_op(matrix: jax.Array, vec: jax.Array,
+                     op: Callable[[jax.Array, jax.Array], jax.Array],
+                     *, along_rows: bool = True) -> jax.Array:
+    """Broadcast a vector against every row (or column) of a matrix.
+
+    Reference: linalg/matrix_vector_op.cuh.  ``along_rows=True`` means the
+    vector spans the row (length = n_cols), applied to each row — the
+    reference's ``bcastAlongRows``.
+    """
+    expects(matrix.ndim == 2 and vec.ndim == 1, "matrix_vector_op: (2d, 1d) required")
+    if along_rows:
+        expects(vec.shape[0] == matrix.shape[1], "vec length must equal n_cols")
+        return op(matrix, vec[None, :])
+    expects(vec.shape[0] == matrix.shape[0], "vec length must equal n_rows")
+    return op(matrix, vec[:, None])

@@ -1,0 +1,149 @@
+"""Reductions.
+
+Reference: raft/linalg/{reduce,coalesced_reduction,strided_reduction,norm,
+normalize,reduce_rows_by_key,reduce_cols_by_key,mean_squared_error}.cuh.
+
+The reference distinguishes coalesced vs strided reductions for memory-access
+reasons; on TPU XLA picks the schedule, so both reduce to axis reductions with
+the reference's (main_op, reduce_op, final_op) functor composition.  Key-grouped
+reductions use ``jax.ops.segment_sum`` (sorted/unsorted both fine; num_segments
+is static as XLA requires).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+
+class NormType:
+    """Reference: linalg/norm.cuh ``NormType``."""
+
+    L1Norm = "l1"
+    L2Norm = "l2"
+    LinfNorm = "linf"
+
+
+class Apply:
+    """Reduction direction (reference: linalg/norm.cuh ``Apply``)."""
+
+    ALONG_ROWS = "along_rows"      # one result per row
+    ALONG_COLUMNS = "along_columns"  # one result per column
+
+
+def _identity(x):
+    return x
+
+
+def reduce(data: jax.Array, *, along_rows: bool = True,
+           main_op: Callable = _identity,
+           reduce_op: str = "add",
+           final_op: Callable = _identity,
+           init=0) -> jax.Array:
+    """General row/col reduction with pre/post ops (reference: linalg/reduce.cuh).
+
+    ``reduce_op`` is one of add/min/max — the reference passes functors; on TPU
+    named reductions let XLA use its native combiners.
+    """
+    expects(data.ndim == 2, "reduce: rank-2 input")
+    axis = 1 if along_rows else 0
+    mapped = main_op(data)
+    init_v = jnp.asarray(init, mapped.dtype)
+    if reduce_op == "add":
+        out = jnp.sum(mapped, axis=axis) + init_v
+    elif reduce_op == "min":
+        # init always participates (reference: raft::linalg::reduce init semantics)
+        out = jnp.minimum(jnp.min(mapped, axis=axis), init_v)
+    elif reduce_op == "max":
+        out = jnp.maximum(jnp.max(mapped, axis=axis), init_v)
+    else:
+        raise ValueError(f"unknown reduce_op {reduce_op!r}")
+    return final_op(out)
+
+
+def coalesced_reduction(data: jax.Array, **kw) -> jax.Array:
+    """Reduce along the contiguous (last) dim (reference: coalesced_reduction.cuh)."""
+    return reduce(data, along_rows=True, **kw)
+
+
+def strided_reduction(data: jax.Array, **kw) -> jax.Array:
+    """Reduce along the strided (first) dim (reference: strided_reduction.cuh)."""
+    return reduce(data, along_rows=False, **kw)
+
+
+def norm(data: jax.Array, norm_type: str = NormType.L2Norm, *,
+         along_rows: bool = True, sqrt: bool = False) -> jax.Array:
+    """Row/col norms (reference: linalg/norm.cuh ``rowNorm``/``colNorm``).
+
+    NB: the reference's L2 norm is the *squared* L2 sum unless ``sqrt`` — we
+    keep that contract (it feeds the expanded-distance identities).  The sqrt
+    final-op applies to every norm type, as in detail/norm.cuh:38-77.
+    """
+    axis = 1 if along_rows else 0
+    if norm_type == NormType.L1Norm:
+        out = jnp.sum(jnp.abs(data), axis=axis)
+    elif norm_type == NormType.L2Norm:
+        out = jnp.sum(data * data, axis=axis)
+    elif norm_type == NormType.LinfNorm:
+        out = jnp.max(jnp.abs(data), axis=axis)
+    else:
+        raise ValueError(f"unknown norm type {norm_type!r}")
+    if sqrt:
+        out = jnp.sqrt(out)
+    return out
+
+
+def row_norm(data: jax.Array, norm_type: str = NormType.L2Norm,
+             sqrt: bool = False) -> jax.Array:
+    return norm(data, norm_type, along_rows=True, sqrt=sqrt)
+
+
+def col_norm(data: jax.Array, norm_type: str = NormType.L2Norm,
+             sqrt: bool = False) -> jax.Array:
+    return norm(data, norm_type, along_rows=False, sqrt=sqrt)
+
+
+def normalize(data: jax.Array, norm_type: str = NormType.L2Norm,
+              eps: float = 1e-12) -> jax.Array:
+    """Row-normalize (reference: linalg/normalize.cuh ``row_normalize``)."""
+    if norm_type == NormType.L2Norm:
+        n = jnp.sqrt(jnp.sum(data * data, axis=1, keepdims=True))
+    elif norm_type == NormType.L1Norm:
+        n = jnp.sum(jnp.abs(data), axis=1, keepdims=True)
+    else:
+        n = jnp.max(jnp.abs(data), axis=1, keepdims=True)
+    return data / jnp.maximum(n, eps)
+
+
+def reduce_rows_by_key(data: jax.Array, keys: jax.Array, n_keys: int,
+                       weights: Optional[jax.Array] = None) -> jax.Array:
+    """Sum rows sharing a key: out[k, :] = sum_{i: keys[i]==k} w[i] * data[i, :].
+
+    Reference: linalg/reduce_rows_by_key.cuh — the k-means centroid-update
+    primitive.  ``jax.ops.segment_sum`` lowers to an XLA scatter-add; n_keys is
+    static (XLA shape requirement, matching the reference's n_uniquekeys arg).
+    """
+    expects(data.ndim == 2 and keys.ndim == 1, "reduce_rows_by_key: (2d, 1d)")
+    expects(keys.shape[0] == data.shape[0], "one key per row required")
+    if weights is not None:
+        data = data * weights[:, None].astype(data.dtype)
+    return jax.ops.segment_sum(data, keys, num_segments=n_keys)
+
+
+def reduce_cols_by_key(data: jax.Array, keys: jax.Array,
+                       n_keys: int) -> jax.Array:
+    """Sum columns sharing a key (reference: linalg/reduce_cols_by_key.cuh)."""
+    expects(data.ndim == 2 and keys.ndim == 1, "reduce_cols_by_key: (2d, 1d)")
+    expects(keys.shape[0] == data.shape[1], "one key per column required")
+    return jax.ops.segment_sum(data.T, keys, num_segments=n_keys).T
+
+
+def mean_squared_error(a: jax.Array, b: jax.Array,
+                       weight: float = 1.0) -> jax.Array:
+    """Reference: linalg/mean_squared_error.cuh."""
+    d = a - b
+    return weight * jnp.mean(d * d)

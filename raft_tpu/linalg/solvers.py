@@ -1,0 +1,133 @@
+"""Dense decompositions / solvers.
+
+Reference: raft/linalg/{eig,svd,rsvd,qr,lstsq,cholesky_r1_update}.cuh, which
+wrap cuSOLVER (detail/eig.cuh:40-57 cusolverDnsyevd, detail/svd.cuh, ...).  On
+TPU the equivalents are ``jnp.linalg`` / ``jax.scipy.linalg``, which lower to
+XLA's decomposition ops; randomized SVD is built from gemm+QR, which is the
+TPU-friendly formulation (all MXU work).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+
+def eig_dc(res, A: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Eigendecomposition of a symmetric matrix; ascending eigenvalues.
+
+    Reference: linalg/eig.cuh ``eig_dc`` (divide & conquer cusolverDnsyevd,
+    detail/eig.cuh:40-57).  Returns (eigenvalues, eigenvectors[:, i]).
+    """
+    expects(A.ndim == 2 and A.shape[0] == A.shape[1], "eig_dc: square matrix required")
+    w, v = jnp.linalg.eigh(A)
+    return w, v
+
+
+def eig_jacobi(res, A: jax.Array, tol: float = 1e-7,
+               sweeps: int = 15) -> Tuple[jax.Array, jax.Array]:
+    """Jacobi eigensolver surface (reference: linalg/eig.cuh ``eig_jacobi``).
+
+    XLA's eigh is already Jacobi-free and accurate; tol/sweeps accepted for API
+    parity and ignored.
+    """
+    return eig_dc(res, A)
+
+
+def svd(res, A: jax.Array, *, full_matrices: bool = False
+        ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """SVD: returns (U, S, V) with A = U @ diag(S) @ V.T.
+
+    Reference: linalg/svd.cuh ``svd_qr`` — note the reference returns V (not
+    V^T); we match that convention.
+    """
+    u, s, vh = jnp.linalg.svd(A, full_matrices=full_matrices)
+    return u, s, vh.T
+
+
+svd_qr = svd
+
+
+def rsvd(res, A: jax.Array, k: int, *, p: int = 10, n_iter: int = 4,
+         key: Optional[jax.Array] = None
+         ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Randomized SVD of rank k (reference: linalg/rsvd.cuh).
+
+    Halko-Martinsson-Tropp sketch: range-find with (p) oversampling and
+    ``n_iter`` power iterations (QR-stabilised), then exact SVD on the small
+    projected matrix.  All heavy work is gemm+QR: ideal for the MXU.
+    """
+    m, n = A.shape
+    l = min(k + p, min(m, n))
+    if key is None:
+        key = res.next_key() if res is not None else jax.random.key(0)
+    from raft_tpu.utils.precision import get_matmul_precision
+    prec = get_matmul_precision()
+    mm = lambda a, b: jnp.matmul(a, b, precision=prec)
+    omega = jax.random.normal(key, (n, l), dtype=A.dtype)
+    Y = mm(A, omega)
+    Q, _ = jnp.linalg.qr(Y)
+    for _ in range(n_iter):
+        Q, _ = jnp.linalg.qr(mm(A.T, Q))
+        Q, _ = jnp.linalg.qr(mm(A, Q))
+    B = mm(Q.T, A)
+    ub, s, vh = jnp.linalg.svd(B, full_matrices=False)
+    u = mm(Q, ub)
+    return u[:, :k], s[:k], vh[:k].T
+
+
+def qr_get_q(res, A: jax.Array) -> jax.Array:
+    """Reference: linalg/qr.cuh ``qr_get_q``."""
+    q, _ = jnp.linalg.qr(A)
+    return q
+
+
+def qr_get_qr(res, A: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Reference: linalg/qr.cuh ``qr_get_qr``."""
+    return jnp.linalg.qr(A)
+
+
+def lstsq(res, A: jax.Array, b: jax.Array) -> jax.Array:
+    """Least-squares solve via SVD (reference: linalg/lstsq.cuh lstsqSvdQR)."""
+    x, _, _, _ = jnp.linalg.lstsq(A, b)
+    return x
+
+
+def cholesky(res, A: jax.Array, lower: bool = True) -> jax.Array:
+    """Cholesky factor (reference: detail/cholesky path of potrf wrappers)."""
+    L = jnp.linalg.cholesky(A)
+    return L if lower else L.T
+
+
+def cholesky_rank_one_update(res, L: jax.Array, v: jax.Array,
+                             lower: bool = True) -> jax.Array:
+    """Rank-1 update of a Cholesky factor: chol(A + v v^T) given L = chol(A).
+
+    Reference: linalg/cholesky_r1_update.cuh.  Implemented as a fixed-length
+    scan of Givens-style rotations — jit-friendly (no data-dependent shapes).
+    """
+    expects(L.ndim == 2 and L.shape[0] == L.shape[1], "square factor required")
+    Lw = L if lower else L.T
+    n = Lw.shape[0]
+
+    def body(carry, k):
+        Lc, w = carry
+        lkk = Lc[k, k]
+        wk = w[k]
+        r = jnp.sqrt(lkk * lkk + wk * wk)
+        c = r / lkk
+        s = wk / lkk
+        col = Lc[:, k]
+        mask = (jnp.arange(n) > k).astype(L.dtype)
+        new_col = jnp.where(jnp.arange(n) >= k, (col + s * w) / c, col)
+        new_w = c * w - s * new_col
+        w = jnp.where(mask.astype(bool), new_w, w)
+        Lc = Lc.at[:, k].set(new_col)
+        return (Lc, w), None
+
+    (Lw, _), _ = jax.lax.scan(body, (Lw, v.astype(L.dtype)), jnp.arange(n))
+    return Lw if lower else Lw.T

@@ -1,0 +1,26 @@
+"""Matrix primitives.
+
+Reference: cpp/include/raft/matrix/ (SURVEY.md §2.4) — headlined by
+``select_k`` (matrix/select_k.cuh:78), the batched top-k primitive that gates
+every ANN search path, plus gather/argmin/slice/sort/linewise utilities.
+"""
+
+from raft_tpu.matrix.select_k import select_k  # noqa: F401
+from raft_tpu.matrix.ops import (  # noqa: F401
+    gather,
+    gather_if,
+    scatter,
+    argmax,
+    argmin,
+    slice as slice_matrix,
+    copy,
+    init,
+    linewise_op,
+    col_wise_sort,
+    reverse,
+    sign_flip,
+    diagonal,
+    set_diagonal,
+    triangular_upper,
+    zero_small_values,
+)

@@ -1,0 +1,112 @@
+"""Matrix utilities.
+
+Reference: raft/matrix/{gather,argmax,argmin,slice,copy,init,linewise_op,
+col_wise_sort,reverse,sign_flip,diagonal,triangular,threshold}.cuh — each a
+bespoke CUDA kernel there; each a fused XLA op here.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+
+def gather(matrix: jax.Array, map_idx: jax.Array) -> jax.Array:
+    """Collect rows by index: out[i] = matrix[map_idx[i]] (reference: gather.cuh)."""
+    expects(matrix.ndim == 2 and map_idx.ndim == 1, "gather: (2d, 1d)")
+    return jnp.take(matrix, map_idx, axis=0)
+
+
+def gather_if(matrix: jax.Array, map_idx: jax.Array, stencil: jax.Array,
+              pred: Callable[[jax.Array], jax.Array],
+              out: jax.Array) -> jax.Array:
+    """Conditional row gather (reference: gather.cuh ``gather_if``): rows where
+    pred(stencil[i]) keep out[i] replaced by matrix[map_idx[i]]."""
+    taken = jnp.take(matrix, map_idx, axis=0)
+    mask = pred(stencil)[:, None]
+    return jnp.where(mask, taken, out)
+
+
+def scatter(matrix: jax.Array, map_idx: jax.Array,
+            updates: jax.Array) -> jax.Array:
+    """out[map_idx[i]] = updates[i] (reference: matrix/scatter.cuh)."""
+    return matrix.at[map_idx].set(updates)
+
+
+def argmax(matrix: jax.Array) -> jax.Array:
+    """Per-row argmax (reference: matrix/argmax.cuh)."""
+    return jnp.argmax(matrix, axis=1)
+
+
+def argmin(matrix: jax.Array) -> jax.Array:
+    """Per-row argmin (reference: matrix/argmin.cuh)."""
+    return jnp.argmin(matrix, axis=1)
+
+
+def slice(matrix: jax.Array, x1: int, y1: int, x2: int, y2: int) -> jax.Array:
+    """Copy the [x1:x2, y1:y2] submatrix (reference: matrix/slice.cuh)."""
+    return matrix[x1:x2, y1:y2]
+
+
+def copy(matrix: jax.Array) -> jax.Array:
+    return jnp.array(matrix)
+
+
+def init(shape: Tuple[int, ...], value, dtype=jnp.float32) -> jax.Array:
+    """Reference: matrix/init.cuh."""
+    return jnp.full(shape, value, dtype=dtype)
+
+
+def linewise_op(matrix: jax.Array, op: Callable, *vecs: jax.Array,
+                along_lines: bool = True) -> jax.Array:
+    """Apply op(row_element, vec_element...) line-wise
+    (reference: matrix/linewise_op.cuh)."""
+    if along_lines:
+        bvecs = [v[None, :] for v in vecs]
+    else:
+        bvecs = [v[:, None] for v in vecs]
+    return op(matrix, *bvecs)
+
+
+def col_wise_sort(matrix: jax.Array, *, ascending: bool = True) -> jax.Array:
+    """Sort each column independently (reference: matrix/col_wise_sort.cuh)."""
+    out = jnp.sort(matrix, axis=0)
+    return out if ascending else out[::-1, :]
+
+
+def reverse(matrix: jax.Array, *, along_rows: bool = True) -> jax.Array:
+    """Reference: matrix/reverse.cuh."""
+    return matrix[:, ::-1] if along_rows else matrix[::-1, :]
+
+
+def sign_flip(matrix: jax.Array) -> jax.Array:
+    """Flip column signs so the max-|.| entry of each column is positive —
+    deterministic eigenvector orientation (reference: matrix/math.cuh signFlip)."""
+    pivot = jnp.take_along_axis(
+        matrix, jnp.argmax(jnp.abs(matrix), axis=0)[None, :], axis=0)
+    return matrix * jnp.where(pivot < 0, -1.0, 1.0).astype(matrix.dtype)
+
+
+def diagonal(matrix: jax.Array) -> jax.Array:
+    """Reference: matrix/diagonal.cuh ``get_diagonal``."""
+    return jnp.diagonal(matrix)
+
+
+def set_diagonal(matrix: jax.Array, vec: jax.Array) -> jax.Array:
+    n = min(matrix.shape)
+    idx = jnp.arange(n)
+    return matrix.at[idx, idx].set(vec[:n])
+
+
+def triangular_upper(matrix: jax.Array) -> jax.Array:
+    """Upper-triangular copy (reference: matrix/triangular.cuh)."""
+    return jnp.triu(matrix)
+
+
+def zero_small_values(matrix: jax.Array, thresh: float) -> jax.Array:
+    """Zero entries below threshold (reference: matrix/threshold.cuh)."""
+    return jnp.where(jnp.abs(matrix) < thresh, 0.0, matrix).astype(matrix.dtype)

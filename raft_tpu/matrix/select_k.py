@@ -1,0 +1,102 @@
+"""Batched top-k selection.
+
+Reference: raft/matrix/select_k.cuh:78 — THE central ANN primitive.  The
+reference dispatches between a radix select (detail/select_radix.cuh, 8/11-bit
+digit passes) and a warp-bitonic sort select (detail/select_warpsort.cuh,
+k<=256) on a heuristic (detail/select_k.cuh:67-89: radix is faster for
+batch>=64 && len>=102400 && k>=128).
+
+TPU-first design: XLA's ``lax.top_k`` / ``lax.approx_max_k`` already lower to
+tuned TPU sort networks — there are no warp shuffles to hand-roll.  We keep the
+reference semantics (select smallest or largest, optional input index payload,
+stable ordering of results) and add a *two-pass tiled* path for very wide
+inputs, mirroring the radix path's role: tile the length dimension, take a
+local top-k per tile (parallel, VMEM-sized), then a final top-k over the
+concatenated candidates.  That caps the sort length at
+``n_tiles * k`` regardless of len.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+# Length beyond which the two-pass tiled path wins (the analogue of the
+# reference's radix_faster heuristic, detail/select_k.cuh:67-89).
+_TILE_LEN = 16384
+
+
+def _top_k_smallest(x: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
+    vals, idx = jax.lax.top_k(-x, k)
+    return -vals, idx
+
+
+def select_k(
+    in_val: jax.Array,
+    k: int,
+    *,
+    in_idx: Optional[jax.Array] = None,
+    select_min: bool = True,
+) -> Tuple[jax.Array, jax.Array]:
+    """Select the k smallest (or largest) values per row, with their indices.
+
+    Parameters mirror matrix/select_k.cuh:78: ``in_val`` is (batch, len);
+    optional ``in_idx`` is a per-element payload of indices (defaults to
+    0..len-1 per row); returns ``(out_val, out_idx)`` each (batch, k), sorted
+    ascending when ``select_min`` else descending.
+    """
+    expects(in_val.ndim == 2, "select_k: (batch, len) input required")
+    batch, length = in_val.shape
+    expects(0 < k <= length, f"select_k: need 0 < k <= len, got k={k}, len={length}")
+    if in_idx is not None:
+        expects(in_idx.shape == in_val.shape, "select_k: in_idx shape mismatch")
+
+    if length > _TILE_LEN and length >= 4 * k:
+        vals, idx = _tiled_select(in_val, k, select_min)
+    else:
+        vals, idx = (_top_k_smallest(in_val, k) if select_min
+                     else jax.lax.top_k(in_val, k))
+
+    if in_idx is not None:
+        idx = jnp.take_along_axis(in_idx, idx, axis=1)
+    return vals, idx
+
+
+def _tiled_select(in_val: jax.Array, k: int, select_min: bool
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Two-pass selection: per-tile top-k, then top-k of candidates.
+
+    Plays the role of the radix path (detail/select_radix.cuh): avoids sorting
+    the full length at once.  Padding uses +/-inf sentinels so partial tiles
+    never win.
+    """
+    batch, length = in_val.shape
+    n_tiles = -(-length // _TILE_LEN)
+    padded = n_tiles * _TILE_LEN
+    sentinel = jnp.inf if select_min else -jnp.inf
+    x = jnp.pad(in_val, ((0, 0), (0, padded - length)),
+                constant_values=sentinel)
+    x = x.reshape(batch, n_tiles, _TILE_LEN)
+
+    kk = min(k, _TILE_LEN)
+    if select_min:
+        tile_vals, tile_idx = jax.lax.top_k(-x, kk)
+        tile_vals = -tile_vals
+    else:
+        tile_vals, tile_idx = jax.lax.top_k(x, kk)
+    # global index of each candidate
+    base = (jnp.arange(n_tiles) * _TILE_LEN)[None, :, None]
+    cand_idx = (tile_idx + base).reshape(batch, n_tiles * kk)
+    cand_vals = tile_vals.reshape(batch, n_tiles * kk)
+
+    if select_min:
+        out_vals, pos = jax.lax.top_k(-cand_vals, k)
+        out_vals = -out_vals
+    else:
+        out_vals, pos = jax.lax.top_k(cand_vals, k)
+    out_idx = jnp.take_along_axis(cand_idx, pos, axis=1)
+    return out_vals, out_idx

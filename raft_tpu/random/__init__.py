@@ -1,0 +1,37 @@
+"""Random generation: counter-based RNG + dataset generators.
+
+Reference: cpp/include/raft/random/ (SURVEY.md §2.8) — ``RngState`` with
+Philox/PCG counter-based device generators (rng_state.hpp:28-52,
+rng_device.cuh:30-31), a distribution suite (rng.cuh), and data generators
+(make_blobs, make_regression, rmat, sample_without_replacement, permute,
+multi_variable_gaussian).
+
+JAX's threefry PRNG is already counter-based — the reference's whole
+"seed + subsequence" design maps directly onto jax keys + fold_in.
+"""
+
+from raft_tpu.random.rng import (  # noqa: F401
+    RngState,
+    GeneratorType,
+    uniform,
+    uniformInt,
+    normal,
+    normalInt,
+    lognormal,
+    gumbel,
+    laplace,
+    logistic,
+    exponential,
+    rayleigh,
+    bernoulli,
+    scaled_bernoulli,
+    discrete,
+)
+from raft_tpu.random.generators import (  # noqa: F401
+    make_blobs,
+    make_regression,
+    rmat_rectangular_generator,
+    sample_without_replacement,
+    permute,
+    multi_variable_gaussian,
+)

@@ -1,0 +1,135 @@
+"""Counter-based RNG state + distributions.
+
+Reference: raft/random/rng_state.hpp:28-52 (``RngState{seed, base_subsequence,
+type}``), rng_device.cuh (Philox / PCG generators), rng.cuh (distribution
+suite).  jax.random is counter-based (threefry) with explicit keys, which is
+exactly the reference's design goal — so ``RngState`` here is a thin
+deterministic key chain and each distribution is a pure function of a state.
+
+Every distribution advances the state (matching the reference, where each call
+bumps the subsequence so successive calls are independent).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+
+class GeneratorType:
+    """Reference: rng_state.hpp ``GeneratorType`` (GenPhilox/GenPC). jax's
+    threefry plays both roles; the tag is kept for API parity."""
+
+    GenDefault = "threefry"
+    GenPhilox = "threefry"
+    GenPC = "threefry"
+
+
+class RngState:
+    """Deterministic RNG state (reference: rng_state.hpp:28-52).
+
+    ``advance`` mirrors ``RngState::advance`` — it bumps the subsequence so the
+    next draw is independent.
+    """
+
+    def __init__(self, seed: int = 0,
+                 gen_type: str = GeneratorType.GenDefault) -> None:
+        self.seed = seed
+        self.base_subsequence = 0
+        self.type = gen_type
+
+    def advance(self, n: int = 1) -> None:
+        self.base_subsequence += n
+
+    def next_key(self) -> jax.Array:
+        key = jax.random.fold_in(jax.random.key(self.seed), self.base_subsequence)
+        self.advance()
+        return key
+
+
+def _as_state(rng: Union[RngState, int, jax.Array]) -> jax.Array:
+    """Accept an RngState, an int seed, or a raw jax key."""
+    if isinstance(rng, RngState):
+        return rng.next_key()
+    if isinstance(rng, int):
+        return jax.random.key(rng)
+    return rng
+
+
+def uniform(rng, shape, *, low: float = 0.0, high: float = 1.0,
+            dtype=jnp.float32) -> jax.Array:
+    """Reference: rng.cuh ``uniform``."""
+    return jax.random.uniform(_as_state(rng), shape, dtype=dtype,
+                              minval=low, maxval=high)
+
+
+def uniformInt(rng, shape, *, low: int = 0, high: int = 2**31 - 1,
+               dtype=jnp.int32) -> jax.Array:
+    """Reference: rng.cuh ``uniformInt`` (end-exclusive)."""
+    return jax.random.randint(_as_state(rng), shape, low, high, dtype=dtype)
+
+
+def normal(rng, shape, *, mu: float = 0.0, sigma: float = 1.0,
+           dtype=jnp.float32) -> jax.Array:
+    """Reference: rng.cuh ``normal``."""
+    return mu + sigma * jax.random.normal(_as_state(rng), shape, dtype=dtype)
+
+
+def normalInt(rng, shape, *, mu: float = 0.0, sigma: float = 1.0,
+              dtype=jnp.int32) -> jax.Array:
+    """Reference: rng.cuh ``normalInt`` — rounded normal."""
+    x = mu + sigma * jax.random.normal(_as_state(rng), shape)
+    return jnp.round(x).astype(dtype)
+
+
+def lognormal(rng, shape, *, mu: float = 0.0, sigma: float = 1.0,
+              dtype=jnp.float32) -> jax.Array:
+    return jnp.exp(normal(rng, shape, mu=mu, sigma=sigma, dtype=dtype))
+
+
+def gumbel(rng, shape, *, mu: float = 0.0, beta: float = 1.0,
+           dtype=jnp.float32) -> jax.Array:
+    return mu + beta * jax.random.gumbel(_as_state(rng), shape, dtype=dtype)
+
+
+def laplace(rng, shape, *, mu: float = 0.0, scale: float = 1.0,
+            dtype=jnp.float32) -> jax.Array:
+    return mu + scale * jax.random.laplace(_as_state(rng), shape, dtype=dtype)
+
+
+def logistic(rng, shape, *, mu: float = 0.0, scale: float = 1.0,
+             dtype=jnp.float32) -> jax.Array:
+    return mu + scale * jax.random.logistic(_as_state(rng), shape, dtype=dtype)
+
+
+def exponential(rng, shape, *, lam: float = 1.0,
+                dtype=jnp.float32) -> jax.Array:
+    return jax.random.exponential(_as_state(rng), shape, dtype=dtype) / lam
+
+
+def rayleigh(rng, shape, *, sigma: float = 1.0,
+             dtype=jnp.float32) -> jax.Array:
+    u = jax.random.uniform(_as_state(rng), shape, dtype=dtype,
+                           minval=jnp.finfo(dtype).tiny, maxval=1.0)
+    return sigma * jnp.sqrt(-2.0 * jnp.log(u))
+
+
+def bernoulli(rng, shape, *, prob: float = 0.5) -> jax.Array:
+    return jax.random.bernoulli(_as_state(rng), prob, shape)
+
+
+def scaled_bernoulli(rng, shape, *, prob: float = 0.5, scale: float = 1.0,
+                     dtype=jnp.float32) -> jax.Array:
+    """Reference: rng.cuh ``scaled_bernoulli`` — ±scale with prob."""
+    b = jax.random.bernoulli(_as_state(rng), prob, shape)
+    return jnp.where(b, scale, -scale).astype(dtype)
+
+
+def discrete(rng, shape, weights: jax.Array, dtype=jnp.int32) -> jax.Array:
+    """Sample indices proportional to weights (reference: rng.cuh ``discrete``)."""
+    logits = jnp.log(jnp.maximum(weights.astype(jnp.float32), 1e-30))
+    return jax.random.categorical(_as_state(rng), logits, shape=shape).astype(dtype)

@@ -1,0 +1,51 @@
+"""Matmul precision policy.
+
+The reference's distance/linalg stack computes in true fp32 (cuBLAS SGEMM /
+CUTLASS fp32-accumulate — linalg/detail/gemm.hpp).  On TPU the MXU natively
+multiplies bf16 and ``Precision.DEFAULT`` rounds fp32 inputs to bf16 — fast but
+~1e-2 absolute error, which breaks RAFT-parity numerics.  ``HIGHEST`` runs the
+6-pass fp32 emulation.
+
+Policy: raft_tpu defaults to ``highest`` so results match the reference;
+benchmarks and recall-tolerant paths (ANN search) can globally or locally opt
+into faster modes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional, Union
+
+import jax
+
+_NAMES = {
+    "default": jax.lax.Precision.DEFAULT,
+    "high": jax.lax.Precision.HIGH,
+    "highest": jax.lax.Precision.HIGHEST,
+    "bfloat16": jax.lax.Precision.DEFAULT,
+    "float32": jax.lax.Precision.HIGHEST,
+}
+
+_current = jax.lax.Precision.HIGHEST
+
+
+def set_matmul_precision(name: Union[str, jax.lax.Precision]) -> None:
+    """Set the global matmul precision for raft_tpu primitives."""
+    global _current
+    _current = _NAMES[name] if isinstance(name, str) else name
+
+
+def get_matmul_precision() -> jax.lax.Precision:
+    return _current
+
+
+@contextlib.contextmanager
+def matmul_precision(name: Union[str, jax.lax.Precision]) -> Iterator[None]:
+    """Scoped override (host-side; applies to ops traced inside the block)."""
+    global _current
+    prev = _current
+    set_matmul_precision(name)
+    try:
+        yield
+    finally:
+        _current = prev

@@ -1,0 +1,31 @@
+"""Test configuration.
+
+Mirrors the reference's multi-GPU-without-a-cluster strategy (SURVEY.md §4:
+raft-dask's LocalCUDACluster fixture): tests run on a virtual 8-device CPU
+backend so sharded/mesh code paths execute exactly as they would across a TPU
+slice, without hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def res():
+    from raft_tpu import DeviceResources
+    return DeviceResources(seed=42)
+
+
+@pytest.fixture
+def mesh8():
+    devs = np.asarray(jax.devices()[:8])
+    return jax.sharding.Mesh(devs, ("data",))

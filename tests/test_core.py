@@ -1,0 +1,140 @@
+"""Core layer tests (reference test analogue: cpp/test/core/)."""
+
+import io
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import DeviceResources, Resources
+from raft_tpu.core import (
+    LogicError,
+    check_matrix,
+    check_vector,
+    deserialize_mdspan,
+    deserialize_scalar,
+    expects,
+    interruptible,
+    InterruptedException,
+    make_device_matrix,
+    resource_type,
+    serialize_mdspan,
+    serialize_scalar,
+)
+from raft_tpu.core import logger as rlog
+
+
+class TestResources:
+    def test_lazy_factory(self):
+        r = Resources()
+        calls = []
+        r.add_resource_factory("thing", lambda: calls.append(1) or "made")
+        assert not calls
+        assert r.get_resource("thing") == "made"
+        assert r.get_resource("thing") == "made"
+        assert len(calls) == 1
+
+    def test_missing_resource_raises(self):
+        with pytest.raises(LogicError):
+            Resources().get_resource("nope")
+
+    def test_copy_shares_factories_not_instances(self):
+        r = Resources()
+        r.add_resource_factory("x", lambda: object())
+        a = r.get_resource("x")
+        r2 = Resources(r)
+        assert r2.get_resource("x") is not a
+
+    def test_device_resources_defaults(self):
+        res = DeviceResources(seed=7)
+        assert res.device in jax.devices()
+        assert res.mesh.axis_names == ("data",)
+        assert res.workspace_bytes > 0
+
+    def test_prng_chain_deterministic(self):
+        a = DeviceResources(seed=3)
+        b = DeviceResources(seed=3)
+        k1, k2 = a.next_key(), a.next_key()
+        assert not jnp.array_equal(jax.random.key_data(k1),
+                                   jax.random.key_data(k2))
+        assert jnp.array_equal(jax.random.key_data(b.next_key()),
+                               jax.random.key_data(k1))
+
+    def test_comms_slot(self):
+        res = DeviceResources()
+        assert not res.comms_initialized()
+        res.set_comms("comm")
+        assert res.get_comms() == "comm"
+
+
+class TestContracts:
+    def test_check_matrix(self):
+        x = jnp.zeros((3, 4))
+        assert check_matrix(x, rows=3, cols=4) is x
+        with pytest.raises(LogicError):
+            check_matrix(jnp.zeros(3))
+        with pytest.raises(LogicError):
+            check_matrix(x, dtype=jnp.int32)
+
+    def test_check_vector_ingests_numpy(self):
+        v = check_vector(np.arange(5.0), size=5)
+        assert isinstance(v, jax.Array)
+
+    def test_make_device_matrix(self):
+        res = DeviceResources()
+        m = make_device_matrix(res, 2, 3)
+        assert m.shape == (2, 3)
+
+
+class TestSerialize:
+    def test_mdspan_roundtrip(self):
+        buf = io.BytesIO()
+        arr = np.random.default_rng(0).normal(size=(5, 7)).astype(np.float32)
+        serialize_mdspan(None, buf, jnp.asarray(arr))
+        buf.seek(0)
+        out = deserialize_mdspan(None, buf)
+        np.testing.assert_array_equal(out, arr)
+
+    def test_scalar_roundtrip(self):
+        buf = io.BytesIO()
+        serialize_scalar(None, buf, np.int64(42))
+        serialize_scalar(None, buf, np.float32(1.5))
+        buf.seek(0)
+        assert deserialize_scalar(None, buf) == 42
+        assert deserialize_scalar(None, buf) == np.float32(1.5)
+
+
+class TestLogger:
+    def test_callback_sink(self):
+        records = []
+        lg = rlog.Logger.get()
+        lg.set_callback(lambda lvl, msg: records.append((lvl, msg)))
+        try:
+            rlog.log_info("hello %d", 5)
+        finally:
+            lg.set_callback(None)
+        assert any("hello 5" in m for _, m in records)
+
+    def test_level_filtering(self):
+        lg = rlog.Logger.get()
+        old = lg.get_level()
+        lg.set_level(rlog.ERROR)
+        try:
+            assert not lg.should_log_for(rlog.INFO)
+            assert lg.should_log_for(rlog.ERROR)
+        finally:
+            lg.set_level(old)
+
+
+class TestInterruptible:
+    def test_cancel_from_other_thread(self):
+        tok = interruptible.get_token()
+        t = threading.Thread(target=tok.cancel)
+        t.start()
+        t.join()
+        with pytest.raises(InterruptedException):
+            interruptible.synchronize()
+        # token cleared after raise
+        interruptible.synchronize()

@@ -1,0 +1,176 @@
+"""Distance tests — all 20 metrics vs scipy (reference analogue:
+cpp/test/distance/ naive-kernel comparisons; pylibraft test_distance.py uses
+scipy.cdist the same way)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.spatial import distance as sp_dist
+
+from raft_tpu.distance import (
+    DistanceType,
+    fused_l2_nn,
+    gram_matrix,
+    KernelParams,
+    KernelType,
+    masked_l2_nn,
+    pairwise_distance,
+)
+
+RNG = np.random.default_rng(99)
+
+
+def make_xy(m=33, n=47, k=17, positive=False):
+    x = RNG.normal(size=(m, k)).astype(np.float32)
+    y = RNG.normal(size=(n, k)).astype(np.float32)
+    if positive:
+        x, y = np.abs(x) + 0.01, np.abs(y) + 0.01
+    return x, y
+
+
+SCIPY_METRICS = [
+    # expanded forms trade precision for MXU throughput (fp32 cancellation);
+    # the reference's expanded path has the same property
+    (DistanceType.L2SqrtExpanded, "euclidean", False, 5e-3),
+    (DistanceType.L2Expanded, "sqeuclidean", False, 5e-3),
+    (DistanceType.L2SqrtUnexpanded, "euclidean", False, 1e-4),
+    (DistanceType.L2Unexpanded, "sqeuclidean", False, 1e-4),
+    (DistanceType.CosineExpanded, "cosine", False, 5e-3),
+    (DistanceType.CorrelationExpanded, "correlation", False, 5e-3),
+    (DistanceType.L1, "cityblock", False, 1e-4),
+    (DistanceType.Linf, "chebyshev", False, 1e-5),
+    (DistanceType.Canberra, "canberra", False, 1e-4),
+    (DistanceType.BrayCurtis, "braycurtis", True, 1e-4),
+    (DistanceType.JensenShannon, "jensenshannon", True, 1e-3),
+]
+
+
+class TestPairwiseDistance:
+    @pytest.mark.parametrize("metric,scipy_name,positive,tol", SCIPY_METRICS,
+                             ids=[m[1] + "_" + str(int(m[0])) for m in SCIPY_METRICS])
+    def test_vs_scipy(self, metric, scipy_name, positive, tol):
+        x, y = make_xy(positive=positive)
+        if metric == DistanceType.JensenShannon:
+            x /= x.sum(1, keepdims=True)
+            y /= y.sum(1, keepdims=True)
+        out = np.asarray(pairwise_distance(x, y, metric))
+        ref = sp_dist.cdist(x, y, scipy_name)
+        np.testing.assert_allclose(out, ref, rtol=tol, atol=tol)
+
+    def test_minkowski(self):
+        x, y = make_xy()
+        out = np.asarray(pairwise_distance(x, y, DistanceType.LpUnexpanded,
+                                           metric_arg=3.0))
+        ref = sp_dist.cdist(x, y, "minkowski", p=3.0)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    def test_inner_product(self):
+        x, y = make_xy()
+        np.testing.assert_allclose(
+            np.asarray(pairwise_distance(x, y, DistanceType.InnerProduct)),
+            x @ y.T, rtol=1e-4, atol=1e-4)
+
+    def test_hellinger(self):
+        x, y = make_xy(positive=True)
+        x /= x.sum(1, keepdims=True)
+        y /= y.sum(1, keepdims=True)
+        out = np.asarray(pairwise_distance(x, y, DistanceType.HellingerExpanded))
+        ref = np.sqrt(np.maximum(
+            1 - (np.sqrt(x)[:, None, :] * np.sqrt(y)[None, :, :]).sum(-1), 0))
+        np.testing.assert_allclose(out, ref, atol=1e-3)
+
+    def test_kl_divergence(self):
+        x, y = make_xy(positive=True)
+        x /= x.sum(1, keepdims=True)
+        y /= y.sum(1, keepdims=True)
+        out = np.asarray(pairwise_distance(x, y, DistanceType.KLDivergence))
+        ref = (x[:, None, :] * np.log(x[:, None, :] / y[None, :, :])).sum(-1)
+        np.testing.assert_allclose(out, ref, atol=1e-3)
+
+    def test_hamming(self):
+        x = (RNG.random((20, 30)) > 0.5).astype(np.float32)
+        y = (RNG.random((25, 30)) > 0.5).astype(np.float32)
+        out = np.asarray(pairwise_distance(x, y, DistanceType.HammingUnexpanded))
+        ref = sp_dist.cdist(x, y, "hamming")
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    @pytest.mark.parametrize("metric,name", [
+        (DistanceType.JaccardExpanded, "jaccard"),
+        (DistanceType.DiceExpanded, "dice"),
+        (DistanceType.RusselRaoExpanded, "russellrao"),
+    ])
+    def test_boolean_metrics(self, metric, name):
+        x = (RNG.random((20, 32)) > 0.5)
+        y = (RNG.random((22, 32)) > 0.5)
+        out = np.asarray(pairwise_distance(x.astype(np.float32),
+                                           y.astype(np.float32), metric))
+        ref = sp_dist.cdist(x, y, name)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_haversine(self):
+        lat = RNG.uniform(-np.pi / 2, np.pi / 2, size=(10, 1))
+        lon = RNG.uniform(-np.pi, np.pi, size=(10, 1))
+        pts = np.concatenate([lat, lon], 1).astype(np.float32)
+        out = np.asarray(pairwise_distance(pts, pts, DistanceType.Haversine))
+        assert np.allclose(np.diagonal(out), 0, atol=1e-4)
+        assert np.allclose(out, out.T, atol=1e-4)
+
+    def test_metric_names(self):
+        x, y = make_xy(m=5, n=6, k=4)
+        np.testing.assert_allclose(
+            np.asarray(pairwise_distance(x, y, "euclidean")),
+            sp_dist.cdist(x, y, "euclidean"), rtol=1e-3, atol=1e-3)
+
+    def test_shape_validation(self):
+        from raft_tpu.core import LogicError
+        with pytest.raises(LogicError):
+            pairwise_distance(np.zeros((3, 4)), np.zeros((3, 5)))
+
+
+class TestFusedL2NN:
+    def test_matches_bruteforce(self):
+        x, y = make_xy(m=200, n=5000, k=16)
+        d, i = fused_l2_nn(jnp.asarray(x), jnp.asarray(y), tile_n=512)
+        full = sp_dist.cdist(x, y, "sqeuclidean")
+        np.testing.assert_array_equal(np.asarray(i), full.argmin(1))
+        np.testing.assert_allclose(np.asarray(d), full.min(1), rtol=1e-3,
+                                   atol=1e-3)
+
+    def test_sqrt_mode(self):
+        x, y = make_xy(m=20, n=100, k=8)
+        d, _ = fused_l2_nn(jnp.asarray(x), jnp.asarray(y), sqrt=True)
+        full = sp_dist.cdist(x, y, "euclidean")
+        np.testing.assert_allclose(np.asarray(d), full.min(1), rtol=1e-3,
+                                   atol=1e-3)
+
+
+class TestMaskedNN:
+    def test_mask_respected(self):
+        x, y = make_xy(m=10, n=30, k=4)
+        # 3 groups of 10 rows each; end offsets
+        group_idxs = jnp.asarray([10, 20, 30])
+        adj = np.zeros((10, 3), bool)
+        adj[:, 1] = True  # only middle group allowed
+        d, i = masked_l2_nn(jnp.asarray(x), jnp.asarray(y),
+                            jnp.asarray(adj), group_idxs)
+        full = sp_dist.cdist(x, y[10:20], "sqeuclidean")
+        np.testing.assert_array_equal(np.asarray(i), full.argmin(1) + 10)
+        np.testing.assert_allclose(np.asarray(d), full.min(1), rtol=1e-3,
+                                   atol=1e-3)
+
+
+class TestGram:
+    def test_rbf_poly_tanh(self):
+        x, y = make_xy(m=12, n=9, k=5)
+        lin = np.asarray(gram_matrix(jnp.asarray(x), jnp.asarray(y)))
+        np.testing.assert_allclose(lin, x @ y.T, rtol=1e-4, atol=1e-4)
+        rbf = np.asarray(gram_matrix(
+            jnp.asarray(x), jnp.asarray(y),
+            KernelParams(KernelType.RBF, gamma=0.3)))
+        ref = np.exp(-0.3 * sp_dist.cdist(x, y, "sqeuclidean"))
+        np.testing.assert_allclose(rbf, ref, rtol=1e-3, atol=1e-3)
+        poly = np.asarray(gram_matrix(
+            jnp.asarray(x), jnp.asarray(y),
+            KernelParams(KernelType.POLYNOMIAL, degree=2, gamma=0.5, coef0=1.0)))
+        np.testing.assert_allclose(poly, (0.5 * x @ y.T + 1) ** 2, rtol=1e-3,
+                                   atol=1e-3)

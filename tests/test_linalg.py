@@ -1,0 +1,168 @@
+"""Linalg tests (reference analogue: cpp/test/linalg/ — compute-vs-reference
+on random data, numpy as the host reference)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import linalg
+
+RNG = np.random.default_rng(12)
+
+
+def randm(m, n, dtype=np.float32):
+    return RNG.normal(size=(m, n)).astype(dtype)
+
+
+class TestBlas:
+    def test_gemm(self):
+        a, b = randm(17, 9), randm(9, 13)
+        np.testing.assert_allclose(linalg.gemm(a, b), a @ b, rtol=1e-5)
+
+    def test_gemm_trans_alpha_beta(self):
+        a, b, z = randm(9, 17), randm(9, 13), randm(17, 13)
+        out = linalg.gemm(a, b, alpha=2.0, beta=0.5, z=z, trans_x=True)
+        np.testing.assert_allclose(out, 2.0 * a.T @ b + 0.5 * z, rtol=1e-4)
+
+    def test_gemv_axpy_dot(self):
+        A, x = randm(8, 5), RNG.normal(size=5).astype(np.float32)
+        np.testing.assert_allclose(linalg.gemv(A, x), A @ x, rtol=1e-5)
+        y = RNG.normal(size=5).astype(np.float32)
+        np.testing.assert_allclose(linalg.axpy(3.0, x, y), 3 * x + y, rtol=1e-5)
+        np.testing.assert_allclose(linalg.dot(x, y), x @ y, rtol=1e-5)
+
+
+class TestSolvers:
+    def test_eig_dc(self, res):
+        A = randm(12, 12)
+        A = A @ A.T + 12 * np.eye(12, dtype=np.float32)
+        w, v = linalg.eig_dc(res, A)
+        np.testing.assert_allclose(np.asarray(v) @ np.diag(np.asarray(w)) @ np.asarray(v).T,
+                                   A, atol=1e-3)
+        assert np.all(np.diff(np.asarray(w)) >= -1e-5)  # ascending
+
+    def test_svd_returns_v_not_vt(self, res):
+        A = randm(10, 6)
+        u, s, v = linalg.svd(res, A)
+        np.testing.assert_allclose(np.asarray(u) @ np.diag(np.asarray(s)) @ np.asarray(v).T,
+                                   A, atol=1e-4)
+
+    def test_rsvd_approximates(self, res):
+        # low-rank matrix: rsvd should nail it
+        u0 = randm(60, 5)
+        v0 = randm(5, 40)
+        A = u0 @ v0
+        u, s, v = linalg.rsvd(res, jnp.asarray(A), k=5, n_iter=6)
+        recon = np.asarray(u) @ np.diag(np.asarray(s)) @ np.asarray(v).T
+        np.testing.assert_allclose(recon, A, atol=1e-2)
+
+    def test_qr(self, res):
+        A = randm(9, 4)
+        q = linalg.qr_get_q(res, A)
+        np.testing.assert_allclose(np.asarray(q).T @ np.asarray(q), np.eye(4),
+                                   atol=1e-5)
+
+    def test_lstsq(self, res):
+        A, x_true = randm(30, 4), RNG.normal(size=4).astype(np.float32)
+        b = A @ x_true
+        x = linalg.lstsq(res, A, b)
+        np.testing.assert_allclose(np.asarray(x), x_true, atol=1e-3)
+
+    def test_cholesky_rank_one_update(self, res):
+        A = randm(6, 6)
+        A = A @ A.T + 6 * np.eye(6, dtype=np.float32)
+        v = RNG.normal(size=6).astype(np.float32)
+        L = np.linalg.cholesky(A)
+        L_upd = linalg.cholesky_rank_one_update(res, jnp.asarray(L), jnp.asarray(v))
+        expected = np.linalg.cholesky(A + np.outer(v, v))
+        np.testing.assert_allclose(np.asarray(L_upd), expected, atol=1e-3)
+
+
+class TestEltwise:
+    def test_named_ops(self):
+        x, y = randm(4, 5), randm(4, 5)
+        np.testing.assert_allclose(linalg.add(x, y), x + y)
+        np.testing.assert_allclose(linalg.subtract(x, y), x - y)
+        np.testing.assert_allclose(linalg.multiply(x, y), x * y)
+        np.testing.assert_allclose(linalg.divide(x, y), x / y, rtol=1e-5)
+        np.testing.assert_allclose(linalg.eltwise_sqrt(np.abs(x)),
+                                   np.sqrt(np.abs(x)), rtol=1e-6)
+
+    def test_map_reduce(self):
+        x = randm(6, 6)
+        out = linalg.map_reduce(lambda a: a * a, jnp.add, 0.0, jnp.asarray(x))
+        np.testing.assert_allclose(float(out), float((x * x).sum()), rtol=1e-4)
+
+    def test_matrix_vector_op(self):
+        m = randm(5, 3)
+        v = RNG.normal(size=3).astype(np.float32)
+        out = linalg.matrix_vector_op(jnp.asarray(m), jnp.asarray(v), jnp.add)
+        np.testing.assert_allclose(out, m + v[None, :], rtol=1e-6)
+        v2 = RNG.normal(size=5).astype(np.float32)
+        out2 = linalg.matrix_vector_op(jnp.asarray(m), jnp.asarray(v2),
+                                       jnp.multiply, along_rows=False)
+        np.testing.assert_allclose(out2, m * v2[:, None], rtol=1e-6)
+
+    def test_map_offset(self):
+        out = linalg.map_offset(lambda i: i * 2, (3, 4))
+        np.testing.assert_array_equal(np.asarray(out),
+                                      (np.arange(12) * 2).reshape(3, 4))
+
+
+class TestReductions:
+    def test_norms(self):
+        x = randm(7, 5)
+        np.testing.assert_allclose(linalg.row_norm(x), (x * x).sum(1), rtol=1e-5)
+        np.testing.assert_allclose(linalg.row_norm(x, sqrt=True),
+                                   np.sqrt((x * x).sum(1)), rtol=1e-5)
+        np.testing.assert_allclose(linalg.col_norm(x, linalg.NormType.L1Norm),
+                                   np.abs(x).sum(0), rtol=1e-5)
+        np.testing.assert_allclose(
+            linalg.norm(x, linalg.NormType.LinfNorm, along_rows=True),
+            np.abs(x).max(1), rtol=1e-6)
+
+    def test_normalize(self):
+        x = randm(5, 8)
+        out = np.asarray(linalg.normalize(jnp.asarray(x)))
+        np.testing.assert_allclose((out * out).sum(1), np.ones(5), rtol=1e-5)
+
+    def test_reduce_with_ops(self):
+        x = randm(4, 6)
+        out = linalg.reduce(jnp.asarray(x), main_op=jnp.abs, reduce_op="max")
+        np.testing.assert_allclose(out, np.abs(x).max(1), rtol=1e-6)
+
+    def test_reduce_rows_by_key(self):
+        x = randm(10, 3)
+        keys = RNG.integers(0, 4, size=10).astype(np.int32)
+        out = np.asarray(linalg.reduce_rows_by_key(jnp.asarray(x),
+                                                   jnp.asarray(keys), 4))
+        expected = np.zeros((4, 3), np.float32)
+        np.add.at(expected, keys, x)
+        np.testing.assert_allclose(out, expected, atol=1e-5)
+
+    def test_reduce_rows_by_key_weighted(self):
+        x = randm(10, 3)
+        keys = RNG.integers(0, 4, size=10).astype(np.int32)
+        w = RNG.random(10).astype(np.float32)
+        out = np.asarray(linalg.reduce_rows_by_key(jnp.asarray(x),
+                                                   jnp.asarray(keys), 4,
+                                                   weights=jnp.asarray(w)))
+        expected = np.zeros((4, 3), np.float32)
+        np.add.at(expected, keys, x * w[:, None])
+        np.testing.assert_allclose(out, expected, atol=1e-5)
+
+    def test_reduce_cols_by_key(self):
+        x = randm(3, 8)
+        keys = RNG.integers(0, 3, size=8).astype(np.int32)
+        out = np.asarray(linalg.reduce_cols_by_key(jnp.asarray(x),
+                                                   jnp.asarray(keys), 3))
+        expected = np.zeros((3, 3), np.float32)
+        for j, k in enumerate(keys):
+            expected[:, k] += x[:, j]
+        np.testing.assert_allclose(out, expected, atol=1e-5)
+
+    def test_mse(self):
+        a, b = randm(6, 6), randm(6, 6)
+        np.testing.assert_allclose(float(linalg.mean_squared_error(a, b)),
+                                   ((a - b) ** 2).mean(), rtol=1e-5)

@@ -1,0 +1,96 @@
+"""Matrix primitive tests (reference analogue: cpp/test/matrix/, incl. the
+select_k param grids of cpp/internal/raft_internal/matrix/select_k.cuh)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import matrix
+
+RNG = np.random.default_rng(7)
+
+
+class TestSelectK:
+    @pytest.mark.parametrize("batch,length,k", [
+        (1, 10, 1), (4, 100, 5), (16, 1000, 37), (3, 257, 256),
+        (2, 40000, 64),  # exercises the tiled (radix-analogue) path
+    ])
+    @pytest.mark.parametrize("select_min", [True, False])
+    def test_matches_numpy(self, batch, length, k, select_min):
+        x = RNG.normal(size=(batch, length)).astype(np.float32)
+        vals, idx = matrix.select_k(jnp.asarray(x), k, select_min=select_min)
+        vals, idx = np.asarray(vals), np.asarray(idx)
+        ref = np.sort(x, axis=1)[:, :k] if select_min \
+            else -np.sort(-x, axis=1)[:, :k]
+        np.testing.assert_allclose(vals, ref, rtol=1e-6)
+        # indices actually point at the returned values
+        np.testing.assert_allclose(np.take_along_axis(x, idx, axis=1), vals,
+                                   rtol=1e-6)
+
+    def test_in_idx_payload(self):
+        x = RNG.normal(size=(2, 50)).astype(np.float32)
+        payload = RNG.integers(0, 10**6, size=(2, 50)).astype(np.int64)
+        vals, idx = matrix.select_k(jnp.asarray(x), 3,
+                                    in_idx=jnp.asarray(payload))
+        pos = np.argsort(x, axis=1)[:, :3]
+        np.testing.assert_array_equal(np.asarray(idx),
+                                      np.take_along_axis(payload, pos, axis=1))
+
+    def test_sorted_output(self):
+        x = RNG.normal(size=(5, 333)).astype(np.float32)
+        vals, _ = matrix.select_k(jnp.asarray(x), 17)
+        v = np.asarray(vals)
+        assert np.all(np.diff(v, axis=1) >= 0)
+
+
+class TestOps:
+    def test_gather_scatter(self):
+        m = RNG.normal(size=(6, 3)).astype(np.float32)
+        idx = np.array([4, 0, 2], np.int32)
+        np.testing.assert_array_equal(
+            np.asarray(matrix.gather(jnp.asarray(m), jnp.asarray(idx))), m[idx])
+        upd = np.ones((3, 3), np.float32)
+        out = np.asarray(matrix.scatter(jnp.asarray(m), jnp.asarray(idx),
+                                        jnp.asarray(upd)))
+        expected = m.copy()
+        expected[idx] = 1.0
+        np.testing.assert_array_equal(out, expected)
+
+    def test_argminmax(self):
+        m = RNG.normal(size=(5, 9)).astype(np.float32)
+        np.testing.assert_array_equal(np.asarray(matrix.argmax(jnp.asarray(m))),
+                                      m.argmax(1))
+        np.testing.assert_array_equal(np.asarray(matrix.argmin(jnp.asarray(m))),
+                                      m.argmin(1))
+
+    def test_slice_reverse_diag(self):
+        m = RNG.normal(size=(6, 6)).astype(np.float32)
+        np.testing.assert_array_equal(
+            np.asarray(matrix.slice_matrix(jnp.asarray(m), 1, 2, 4, 5)),
+            m[1:4, 2:5])
+        np.testing.assert_array_equal(
+            np.asarray(matrix.reverse(jnp.asarray(m))), m[:, ::-1])
+        np.testing.assert_array_equal(
+            np.asarray(matrix.diagonal(jnp.asarray(m))), np.diagonal(m))
+
+    def test_col_wise_sort(self):
+        m = RNG.normal(size=(8, 4)).astype(np.float32)
+        np.testing.assert_array_equal(
+            np.asarray(matrix.col_wise_sort(jnp.asarray(m))), np.sort(m, axis=0))
+
+    def test_sign_flip(self):
+        m = RNG.normal(size=(7, 3)).astype(np.float32)
+        out = np.asarray(matrix.sign_flip(jnp.asarray(m)))
+        for j in range(3):
+            assert out[np.abs(out[:, j]).argmax(), j] >= 0
+        np.testing.assert_allclose(np.abs(out), np.abs(m), rtol=1e-6)
+
+    def test_linewise_zero_threshold(self):
+        m = RNG.normal(size=(4, 6)).astype(np.float32)
+        v = RNG.normal(size=6).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(matrix.linewise_op(jnp.asarray(m), jnp.add,
+                                          jnp.asarray(v))),
+            m + v[None, :], rtol=1e-6)
+        out = np.asarray(matrix.zero_small_values(jnp.asarray(m), 0.5))
+        assert np.all((np.abs(out) >= 0.5) | (out == 0))

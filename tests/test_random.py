@@ -1,0 +1,115 @@
+"""Random module tests (reference analogue: cpp/test/random/)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import random as rrandom
+
+
+class TestRngState:
+    def test_deterministic_and_advancing(self):
+        s1 = rrandom.RngState(5)
+        s2 = rrandom.RngState(5)
+        a = np.asarray(rrandom.uniform(s1, (100,)))
+        b = np.asarray(rrandom.uniform(s2, (100,)))
+        np.testing.assert_array_equal(a, b)
+        c = np.asarray(rrandom.uniform(s1, (100,)))
+        assert not np.array_equal(a, c)  # state advanced
+
+
+class TestDistributions:
+    def test_uniform_range(self):
+        x = np.asarray(rrandom.uniform(0, (10000,), low=2.0, high=5.0))
+        assert x.min() >= 2.0 and x.max() < 5.0
+        assert abs(x.mean() - 3.5) < 0.05
+
+    def test_uniform_int(self):
+        x = np.asarray(rrandom.uniformInt(0, (10000,), low=3, high=9))
+        assert x.min() >= 3 and x.max() < 9
+
+    def test_normal_moments(self):
+        x = np.asarray(rrandom.normal(1, (50000,), mu=2.0, sigma=3.0))
+        assert abs(x.mean() - 2.0) < 0.1
+        assert abs(x.std() - 3.0) < 0.1
+
+    def test_bernoulli(self):
+        x = np.asarray(rrandom.bernoulli(2, (20000,), prob=0.3))
+        assert abs(x.mean() - 0.3) < 0.02
+
+    def test_exponential(self):
+        x = np.asarray(rrandom.exponential(3, (50000,), lam=2.0))
+        assert abs(x.mean() - 0.5) < 0.02
+
+    def test_discrete_weights(self):
+        w = jnp.asarray([0.1, 0.0, 0.9])
+        x = np.asarray(rrandom.discrete(4, (20000,), w))
+        assert not (x == 1).any()
+        assert abs((x == 2).mean() - 0.9) < 0.02
+
+
+class TestGenerators:
+    def test_make_blobs_separable(self):
+        X, y = rrandom.make_blobs(500, 8, n_clusters=3, cluster_std=0.1,
+                                  seed=0)
+        X, y = np.asarray(X), np.asarray(y)
+        assert X.shape == (500, 8) and y.shape == (500,)
+        assert set(np.unique(y)) <= {0, 1, 2}
+        # within-cluster distance should be far below between-cluster
+        centers = np.stack([X[y == i].mean(0) for i in range(3)])
+        within = max(np.abs(X[y == i] - centers[i]).max() for i in range(3))
+        between = np.abs(centers[0] - centers[1]).max()
+        assert within < between
+
+    def test_make_blobs_given_centers(self):
+        centers = np.array([[0.0, 0.0], [100.0, 100.0]], np.float32)
+        X, y = rrandom.make_blobs(200, 2, centers=centers, cluster_std=0.5,
+                                  seed=1)
+        X, y = np.asarray(X), np.asarray(y)
+        np.testing.assert_allclose(X[y == 1].mean(0), [100, 100], atol=1.0)
+
+    def test_make_regression_recoverable(self):
+        X, y, coef = rrandom.make_regression(200, 5, noise=0.0, seed=2,
+                                             shuffle=False)
+        X, y, coef = np.asarray(X), np.asarray(y), np.asarray(coef)
+        np.testing.assert_allclose(X @ coef[:, 0], y, rtol=1e-3, atol=1e-2)
+
+    def test_sample_without_replacement_distinct(self):
+        idx = np.asarray(rrandom.sample_without_replacement(3, 1000, 100))
+        assert len(np.unique(idx)) == 100
+        assert idx.max() < 1000
+
+    def test_weighted_sampling_prefers_heavy(self):
+        w = jnp.asarray(np.r_[np.full(50, 100.0), np.full(950, 0.001)])
+        idx = np.asarray(rrandom.sample_without_replacement(5, 1000, 50,
+                                                            weights=w))
+        assert (idx < 50).mean() > 0.8
+
+    def test_permute_is_permutation(self):
+        data = np.arange(50, dtype=np.float32).reshape(50, 1)
+        out, perm = rrandom.permute(6, jnp.asarray(data))
+        np.testing.assert_array_equal(np.sort(np.asarray(out)[:, 0]),
+                                      data[:, 0])
+        np.testing.assert_array_equal(np.asarray(out)[:, 0], data[perm, 0])
+
+    def test_rmat_shapes_and_bounds(self):
+        theta = np.full((10, 4), 0.25, np.float32)
+        src, dst = rrandom.rmat_rectangular_generator(7, theta, 8, 6, 1000)
+        src, dst = np.asarray(src), np.asarray(dst)
+        assert src.shape == (1000,) and dst.shape == (1000,)
+        assert src.max() < 2**8 and dst.max() < 2**6
+        assert src.min() >= 0 and dst.min() >= 0
+
+    def test_rmat_skew(self):
+        # heavily skewed theta → most edges land in low quadrant
+        theta = np.tile(np.array([[0.9, 0.05, 0.04, 0.01]], np.float32),
+                        (8, 1))
+        src, dst = rrandom.rmat_rectangular_generator(8, theta, 8, 8, 5000)
+        assert np.asarray(src).mean() < 50
+
+    def test_multi_variable_gaussian(self):
+        mean = jnp.asarray([1.0, -2.0])
+        cov = jnp.asarray([[2.0, 0.6], [0.6, 1.0]])
+        x = np.asarray(rrandom.multi_variable_gaussian(9, mean, cov, 30000))
+        np.testing.assert_allclose(x.mean(0), [1, -2], atol=0.05)
+        np.testing.assert_allclose(np.cov(x.T), np.asarray(cov), atol=0.1)
