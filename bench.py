@@ -1,0 +1,77 @@
+"""Benchmark harness — prints ONE JSON line with the headline metric.
+
+Modeled on the reference's ANN bench summary metrics (cpp/bench/ann/scripts/
+eval.pl:26: QPS at recall=0.9/0.95) and the driver's north-star
+(BASELINE.md): IVF QPS@recall95 on a SIFT-like workload (128-dim, batch 5000,
+k=10 — cpp/bench/ann/conf/sift-128-euclidean.json search_basic_param).
+
+Until IVF-PQ lands this measures IVF-Flat, the closest built stage of the
+flagship pipeline.  ``vs_baseline`` is QPS / 2000 — the reference harness's
+own "recall at QPS=2000" operating point (eval.pl:26) used as the provisional
+scale until driver-recorded baselines exist (BASELINE.json ``published`` is
+``{}``).
+"""
+
+import json
+import time
+
+import jax
+import numpy as np
+
+N_DB = int(100_000)
+N_QUERIES = 5_000
+DIM = 128
+K = 10
+N_LISTS = 1024
+N_PROBES = 32
+MIN_RECALL = 0.95
+QPS_REFERENCE_POINT = 2000.0  # eval.pl:26 "recall at QPS=2000" condition
+
+
+def main() -> None:
+    from raft_tpu import DeviceResources
+    from raft_tpu.neighbors import brute_force, ivf_flat
+    from raft_tpu.random import make_blobs
+
+    res = DeviceResources(seed=0)
+    X, _ = make_blobs(N_DB + N_QUERIES, DIM, n_clusters=1000,
+                      cluster_std=4.0, seed=0)
+    db, queries = X[:N_DB], X[N_DB:]
+    db.block_until_ready()
+
+    # ground truth for recall (the bench's naive_knn analogue)
+    gt_d, gt_i = brute_force.knn(res, db, queries, K)
+    gt_i = np.asarray(gt_i)
+
+    params = ivf_flat.IndexParams(n_lists=N_LISTS, kmeans_n_iters=20)
+    index = ivf_flat.build(res, params, db)
+
+    sp = ivf_flat.SearchParams(n_probes=N_PROBES)
+    # warmup (compile)
+    d, i = ivf_flat.search(res, sp, index, queries, K)
+    i.block_until_ready()
+
+    runs = 3  # run_count=3, sift-128-euclidean.json
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        d, i = ivf_flat.search(res, sp, index, queries, K)
+    i.block_until_ready()
+    elapsed = (time.perf_counter() - t0) / runs
+
+    found = np.asarray(i)
+    hits = sum(len(set(f) & set(t)) for f, t in zip(found, gt_i))
+    recall = hits / gt_i.size
+    qps = N_QUERIES / elapsed
+
+    print(json.dumps({
+        "metric": f"ivf_flat_qps@recall{MIN_RECALL:.2f}"
+                  if recall >= MIN_RECALL else
+                  f"ivf_flat_qps@recall={recall:.3f}(below_target)",
+        "value": round(qps, 1),
+        "unit": "queries/s",
+        "vs_baseline": round(qps / QPS_REFERENCE_POINT, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

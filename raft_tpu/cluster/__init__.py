@@ -1,0 +1,15 @@
+"""Clustering algorithms.
+
+Reference: cpp/include/raft/cluster/ (SURVEY.md §2.7) — Lloyd k-means with
+k-means++ init (cluster/kmeans.cuh), balanced hierarchical k-means used as the
+ANN coarse quantizer (cluster/kmeans_balanced.cuh), and single-linkage
+agglomerative clustering (cluster/single_linkage.cuh).
+"""
+
+from raft_tpu.cluster import kmeans  # noqa: F401
+from raft_tpu.cluster import kmeans_balanced  # noqa: F401
+from raft_tpu.cluster.kmeans_types import (  # noqa: F401
+    InitMethod,
+    KMeansParams,
+    KMeansBalancedParams,
+)

@@ -1,0 +1,351 @@
+"""k-means clustering (Lloyd's algorithm, k-means++ init).
+
+Reference: raft/cluster/kmeans.cuh:87 ``fit``, :151 ``predict``, :214
+``fit_predict``, :243 ``transform``, plus the publicly exposed building blocks
+``sample_centroids`` :339, ``update_centroids`` :392,
+``min_cluster_and_distance`` :495, ``shuffle_and_gather`` :530; internals in
+cluster/detail/kmeans.cuh (``initRandom`` :62, ``kmeansPlusPlus`` :88,
+``update_centroids`` :285, ``kmeans_fit_main`` :359).
+
+TPU design notes:
+
+- The Lloyd loop is a single ``lax.while_loop`` jitted end-to-end — assignment
+  (fused L2 1-NN, the reference's hot ``minClusterAndDistanceCompute`` path),
+  centroid update (``segment_sum``) and the convergence check all stay on
+  device; no per-iteration host sync (the reference syncs each iter).
+- k-means++ follows the reference's n_trials candidate scheme
+  (detail/kmeans.cuh:88): each round draws ``n_trials`` candidates with
+  probability proportional to the current min-distance-squared (Gumbel top-k
+  trick) and keeps the candidate with the lowest resulting cost.
+- Empty clusters keep their previous centroid (the reference's
+  update_centroids divides by max(count, 1) and copies the old center back —
+  detail/kmeans.cuh:285).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.cluster.kmeans_types import InitMethod, KMeansParams
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import ensure_array
+from raft_tpu.core.tracing import range as named_range
+from raft_tpu.distance.fused_l2_nn import fused_l2_nn
+from raft_tpu.distance.pairwise import pairwise_distance
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.utils.precision import get_matmul_precision
+
+
+# ---------------------------------------------------------------------------
+# building blocks (public in the reference: kmeans.cuh:339-616)
+# ---------------------------------------------------------------------------
+
+def min_cluster_and_distance(
+    X: jax.Array,
+    centroids: jax.Array,
+    *,
+    metric: int = DistanceType.L2Expanded,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-sample (label, distance) to the nearest centroid.
+
+    Reference: kmeans.cuh:495 ``min_cluster_and_distance`` (KeyValuePair out),
+    backed by fusedL2NN for L2 (detail/kmeans.cuh:432).  Returns
+    ``(labels int32 (n,), distances (n,))``; distances are squared-L2 for the
+    L2 metrics, raw metric values otherwise.
+    """
+    if metric in (DistanceType.L2Expanded, DistanceType.L2Unexpanded):
+        d, i = fused_l2_nn(X, centroids)
+        return i, d
+    if metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded):
+        d, i = fused_l2_nn(X, centroids, sqrt=True)
+        return i, d
+    dmat = pairwise_distance(X, centroids, metric)
+    return jnp.argmin(dmat, axis=1).astype(jnp.int32), jnp.min(dmat, axis=1)
+
+
+def update_centroids(
+    X: jax.Array,
+    labels: jax.Array,
+    n_clusters: int,
+    *,
+    sample_weight: Optional[jax.Array] = None,
+    old_centroids: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Weighted per-cluster mean; empty clusters keep ``old_centroids``.
+
+    Reference: kmeans.cuh:392 / detail/kmeans.cuh:285 (reduce_rows_by_key +
+    weighted mean + empty-cluster copy-back).  Returns (centroids, counts).
+    """
+    w = (jnp.ones(X.shape[0], X.dtype) if sample_weight is None
+         else sample_weight.astype(X.dtype))
+    acc = jnp.promote_types(X.dtype, jnp.float32)
+    sums = jax.ops.segment_sum((X.astype(acc) * w[:, None].astype(acc)),
+                               labels, num_segments=n_clusters)
+    counts = jax.ops.segment_sum(w.astype(acc), labels,
+                                 num_segments=n_clusters)
+    means = sums / jnp.maximum(counts, 1.0)[:, None]
+    if old_centroids is not None:
+        means = jnp.where((counts > 0)[:, None], means,
+                          old_centroids.astype(acc))
+    return means.astype(X.dtype), counts
+
+
+def sample_centroids(res, X: jax.Array, n_to_sample: int,
+                     *, key: Optional[jax.Array] = None) -> jax.Array:
+    """Uniformly sample rows as centroids (reference: kmeans.cuh:339)."""
+    if key is None:
+        key = res.next_key()
+    n = X.shape[0]
+    expects(n_to_sample <= n, "sample_centroids: more samples than rows")
+    idx = jax.random.choice(key, n, (n_to_sample,), replace=False)
+    return X[idx]
+
+
+def shuffle_and_gather(res, X: jax.Array, n_to_gather: int,
+                       *, key: Optional[jax.Array] = None) -> jax.Array:
+    """Random subset of rows via permutation (reference: kmeans.cuh:530)."""
+    if key is None:
+        key = res.next_key()
+    perm = jax.random.permutation(key, X.shape[0])
+    return X[perm[:n_to_gather]]
+
+
+def cluster_cost(X: jax.Array, centroids: jax.Array,
+                 *, metric: int = DistanceType.L2Expanded) -> jax.Array:
+    """Total cost (inertia) of an assignment.
+
+    Reference: raft_runtime/cluster/kmeans.hpp:79 ``cluster_cost``.
+    """
+    _, d = min_cluster_and_distance(X, centroids, metric=metric)
+    return jnp.sum(d)
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+def init_plus_plus(
+    res,
+    X: jax.Array,
+    n_clusters: int,
+    *,
+    key: Optional[jax.Array] = None,
+    n_trials: int = 0,
+) -> jax.Array:
+    """k-means++ with n_trials candidate sampling per round.
+
+    Reference: detail/kmeans.cuh:88 ``kmeansPlusPlus`` (candidate sampling,
+    cost evaluated via fusedL2NN, best candidate kept);
+    raft_runtime/cluster/kmeans.hpp:69 ``init_plus_plus``.
+    """
+    X = ensure_array(X, "X")
+    n, dim = X.shape
+    expects(n_clusters <= n, "init_plus_plus: n_clusters > n_samples")
+    if key is None:
+        key = res.next_key()
+    if n_trials <= 0:
+        n_trials = 2 + int(jnp.ceil(jnp.log(jnp.asarray(float(n_clusters)))))
+
+    xf = X.astype(jnp.float32)
+    x_sq = jnp.sum(xf * xf, axis=1)
+
+    def sq_dists_to(points):  # (t, d) -> (t, n)
+        ip = jax.lax.dot_general(points, xf, (((1,), (1,)), ((), ())),
+                                 precision=get_matmul_precision(),
+                                 preferred_element_type=jnp.float32)
+        p_sq = jnp.sum(points * points, axis=1)
+        return jnp.maximum(p_sq[:, None] + x_sq[None, :] - 2.0 * ip, 0.0)
+
+    k0, key = jax.random.split(key)
+    first = jax.random.randint(k0, (), 0, n)
+    centroids0 = jnp.zeros((n_clusters, dim), jnp.float32)
+    centroids0 = centroids0.at[0].set(xf[first])
+    min_d0 = sq_dists_to(xf[first][None, :])[0]
+
+    def round_body(i, carry):
+        centroids, min_d, key = carry
+        key, kc = jax.random.split(key)
+        # Gumbel top-n_trials == sampling n_trials candidates w/o replacement
+        # with prob ∝ min_d (the D^2 weighting of k-means++).
+        logits = jnp.where(min_d > 0, jnp.log(jnp.maximum(min_d, 1e-30)),
+                           -jnp.inf)
+        g = jax.random.gumbel(kc, (n,))
+        _, cand = jax.lax.top_k(logits + g, n_trials)
+        cand_d = sq_dists_to(xf[cand])              # (n_trials, n)
+        new_min = jnp.minimum(cand_d, min_d[None, :])
+        costs = jnp.sum(new_min, axis=1)
+        best = jnp.argmin(costs)
+        centroids = centroids.at[i].set(xf[cand[best]])
+        return centroids, new_min[best], key
+
+    centroids, _, _ = jax.lax.fori_loop(
+        1, n_clusters, round_body, (centroids0, min_d0, key))
+    return centroids.astype(X.dtype)
+
+
+def init_random(res, X: jax.Array, n_clusters: int,
+                *, key: Optional[jax.Array] = None) -> jax.Array:
+    """Random-row init (reference: detail/kmeans.cuh:62 ``initRandom``)."""
+    return sample_centroids(res, X, n_clusters, key=key)
+
+
+# ---------------------------------------------------------------------------
+# fit / predict
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("n_clusters", "max_iter",
+                                             "metric"))
+def _lloyd(X, centroids0, sample_weight, tol, n_clusters, max_iter, metric):
+    """Jitted Lloyd loop (reference: detail/kmeans.cuh:359 kmeans_fit_main).
+
+    Converges on centroid shift: sum ||c_new - c_old||^2 < tol (the reference
+    checks sqrdNorm of the centroid delta against tol each iteration).
+    """
+
+    def cond(carry):
+        _, it, shift = carry
+        return jnp.logical_and(it < max_iter, shift >= tol)
+
+    def body(carry):
+        centroids, it, _ = carry
+        labels, _ = min_cluster_and_distance(X, centroids, metric=metric)
+        new_c, _ = update_centroids(X, labels, n_clusters,
+                                    sample_weight=sample_weight,
+                                    old_centroids=centroids)
+        shift = jnp.sum((new_c.astype(jnp.float32)
+                         - centroids.astype(jnp.float32)) ** 2)
+        return new_c, it + 1, shift
+
+    init = (centroids0, jnp.int32(0), jnp.float32(jnp.inf))
+    centroids, n_iter, _ = jax.lax.while_loop(cond, body, init)
+    # final assignment cost for the returned centroids
+    labels, dists = min_cluster_and_distance(X, centroids, metric=metric)
+    inertia = jnp.sum(dists * sample_weight)
+    return centroids, inertia, n_iter, labels
+
+
+def fit(
+    res,
+    params: KMeansParams,
+    X,
+    sample_weight: Optional[jax.Array] = None,
+    centroids: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Fit k-means; returns ``(centroids, inertia, n_iter)``.
+
+    Reference: cluster/kmeans.cuh:87 ``kmeans::fit`` (centroids may carry the
+    init when ``params.init == InitMethod.Array``).  ``n_init`` restarts keep
+    the lowest-inertia run, as in the reference/sklearn convention.
+    """
+    with named_range("kmeans::fit"):
+        X = ensure_array(X, "X")
+        expects(X.ndim == 2, "kmeans.fit: 2-D X required")
+        expects(params.n_clusters <= X.shape[0],
+                "kmeans.fit: n_clusters > n_samples")
+        w = (jnp.ones(X.shape[0], jnp.float32) if sample_weight is None
+             else jnp.asarray(sample_weight, jnp.float32))
+
+        best = None
+        # Array init is deterministic — restarts would be bit-identical.
+        n_init = 1 if params.init == InitMethod.Array else max(1, params.n_init)
+        for restart in range(n_init):
+            key = jax.random.fold_in(jax.random.key(params.seed), restart)
+            if params.init == InitMethod.Array:
+                expects(centroids is not None,
+                        "InitMethod.Array requires centroids")
+                c0 = jnp.asarray(centroids, X.dtype)
+            elif params.init == InitMethod.Random:
+                c0 = init_random(res, X, params.n_clusters, key=key)
+            else:
+                c0 = init_plus_plus(res, X, params.n_clusters, key=key)
+            c, inertia, n_iter, _ = _lloyd(
+                X, c0, w, jnp.float32(params.tol), params.n_clusters,
+                params.max_iter, params.metric)
+            if best is None or float(inertia) < float(best[1]):
+                best = (c, inertia, n_iter)
+        return best
+
+
+def predict(
+    res,
+    params: KMeansParams,
+    X,
+    centroids,
+    *,
+    sample_weight: Optional[jax.Array] = None,
+    normalize_weight: bool = True,
+) -> Tuple[jax.Array, jax.Array]:
+    """Assign samples to centroids; returns ``(labels, inertia)``.
+
+    Reference: cluster/kmeans.cuh:151.
+    """
+    X = ensure_array(X, "X")
+    centroids = ensure_array(centroids, "centroids")
+    labels, dists = min_cluster_and_distance(X, centroids,
+                                             metric=params.metric)
+    w = (jnp.ones(X.shape[0], jnp.float32) if sample_weight is None
+         else jnp.asarray(sample_weight, jnp.float32))
+    return labels, jnp.sum(dists * w)
+
+
+def fit_predict(res, params: KMeansParams, X,
+                sample_weight: Optional[jax.Array] = None,
+                centroids: Optional[jax.Array] = None):
+    """Reference: cluster/kmeans.cuh:214.  Returns (labels, centroids, inertia, n_iter)."""
+    centroids, inertia, n_iter = fit(res, params, X, sample_weight, centroids)
+    labels, inertia = predict(res, params, X, centroids,
+                              sample_weight=sample_weight)
+    return labels, centroids, inertia, n_iter
+
+
+def transform(res, params: KMeansParams, X, centroids) -> jax.Array:
+    """Distance from every sample to every centroid (reference: kmeans.cuh:243)."""
+    return pairwise_distance(ensure_array(X, "X"),
+                             ensure_array(centroids, "centroids"),
+                             params.metric)
+
+
+def find_k(
+    res,
+    X,
+    *,
+    k_max: int = 20,
+    k_min: int = 2,
+    max_iter: int = 100,
+    tol: float = 1e-3,
+) -> Tuple[int, jax.Array, jax.Array]:
+    """Auto-find k by binary search on inertia elbow.
+
+    Reference: detail/kmeans_auto_find_k.cuh (``find_k``) — evaluates fit
+    quality across k via a bisection on the cost curve.  Returns
+    ``(best_k, centroids, inertia)``.
+    """
+    X = ensure_array(X, "X")
+
+    def fit_k(k):
+        p = KMeansParams(n_clusters=k, max_iter=max_iter, tol=tol)
+        c, inertia, _ = fit(res, p, X)
+        return c, float(inertia)
+
+    # Coarse scan then local refine — the reference bisects the elbow of the
+    # cost-vs-k curve; a small scan is equivalent at these k ranges.
+    ks, results = [], {}
+    k = k_min
+    while k <= k_max:
+        ks.append(k)
+        results[k] = fit_k(k)
+        k = max(k + 1, int(k * 1.5))
+    # pick the elbow: largest second difference of cost
+    if len(ks) >= 3:
+        costs = [results[k][1] for k in ks]
+        curv = [costs[i - 1] - 2 * costs[i] + costs[i + 1]
+                for i in range(1, len(ks) - 1)]
+        best_k = ks[1 + int(jnp.argmax(jnp.asarray(curv)))]
+    else:
+        best_k = min(ks, key=lambda k: results[k][1])
+    c, inertia = results[best_k]
+    return best_k, c, jnp.asarray(inertia)

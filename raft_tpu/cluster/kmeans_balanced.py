@@ -1,0 +1,176 @@
+"""Balanced hierarchical k-means — the ANN coarse quantizer.
+
+Reference: raft/cluster/kmeans_balanced.cuh:75 ``fit``, :133 ``predict``, :198
+``fit_predict``; helpers ``build_clusters`` :257 and
+``calc_centers_and_sizes`` :336; impl cluster/detail/kmeans_balanced.cuh
+(mesocluster split/balance loop, minibatched predict, L2Expanded or
+InnerProduct metric only).
+
+The reference's goal is not the k-means optimum but *roughly balanced* cluster
+sizes, because the clusters become IVF inverted lists whose occupancy drives
+search cost.  Its mechanism is an iterative loop with a center-adjustment step
+that re-seeds under-populated clusters from the data.  TPU design: one jitted
+``lax.fori_loop`` — assignment via the fused-L2-1NN scan (MXU), centroid
+update via ``segment_sum``, then a balancing step that re-seeds every cluster
+whose size falls below ``avg/ratio`` to a data point drawn with probability
+proportional to its distance-to-centroid (a k-means++-style re-seed, playing
+the role of the reference's ``adjust_centers``).  All shapes static; no host
+round-trips inside the loop.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.cluster.kmeans_types import KMeansBalancedParams
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import ensure_array
+from raft_tpu.core.tracing import range as named_range
+from raft_tpu.distance.fused_l2_nn import fused_l2_nn
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.utils.precision import get_matmul_precision
+
+# Clusters smaller than avg_size / _BALANCE_RATIO get re-seeded each round
+# (reference: detail/kmeans_balanced.cuh adjust_centers threshold).
+_BALANCE_RATIO = 8.0
+
+
+def _assign(X: jax.Array, centroids: jax.Array, metric: int
+            ) -> Tuple[jax.Array, jax.Array]:
+    """(labels, distances).  L2 path is the fused scan; InnerProduct is a
+    plain argmax over the gram matrix (reference predicts in minibatches)."""
+    if metric == DistanceType.InnerProduct:
+        ip = jax.lax.dot_general(
+            X.astype(jnp.float32), centroids.astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            precision=get_matmul_precision(),
+            preferred_element_type=jnp.float32)
+        return jnp.argmax(ip, axis=1).astype(jnp.int32), -jnp.max(ip, axis=1)
+    return tuple(reversed(fused_l2_nn(X, centroids)))
+
+
+def calc_centers_and_sizes(
+    X: jax.Array,
+    labels: jax.Array,
+    n_clusters: int,
+    *,
+    old_centroids: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-cluster mean + population (reference: kmeans_balanced.cuh:336)."""
+    acc = jnp.promote_types(X.dtype, jnp.float32)
+    sums = jax.ops.segment_sum(X.astype(acc), labels,
+                               num_segments=n_clusters)
+    sizes = jax.ops.segment_sum(jnp.ones(X.shape[0], acc), labels,
+                                num_segments=n_clusters)
+    centers = sums / jnp.maximum(sizes, 1.0)[:, None]
+    if old_centroids is not None:
+        centers = jnp.where((sizes > 0)[:, None], centers,
+                            old_centroids.astype(acc))
+    return centers.astype(jnp.float32), sizes.astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("n_clusters", "n_iters",
+                                             "metric"))
+def _balanced_loop(X, centroids0, key, n_clusters, n_iters, metric):
+    xf = X.astype(jnp.float32)
+    n = xf.shape[0]
+
+    def body(it, carry):
+        centroids, key = carry
+        labels, dists = _assign(xf, centroids, metric)
+        centers, sizes = calc_centers_and_sizes(xf, labels, n_clusters,
+                                                old_centroids=centroids)
+        # balancing: re-seed under-populated clusters from far-away points
+        # (the adjust_centers analogue, detail/kmeans_balanced.cuh)
+        avg = jnp.float32(n) / n_clusters
+        small = sizes.astype(jnp.float32) < (avg / _BALANCE_RATIO)
+        key, kc = jax.random.split(key)
+        # one candidate point per cluster, drawn ∝ assignment distance
+        w = jnp.maximum(dists - jnp.min(dists), 0.0) + 1e-6
+        logits = jnp.log(w)
+        g = jax.random.gumbel(kc, (n_clusters, n))
+        cand = jnp.argmax(logits[None, :] + g, axis=1)
+        centers = jnp.where(small[:, None], xf[cand], centers)
+        if metric == DistanceType.InnerProduct:
+            # spherical k-means: keep centroids on the unit sphere
+            norms = jnp.linalg.norm(centers, axis=1, keepdims=True)
+            centers = centers / jnp.maximum(norms, 1e-12)
+        return centers, key
+
+    centroids, _ = jax.lax.fori_loop(0, n_iters, body, (centroids0, key))
+    labels, _ = _assign(xf, centroids, metric)
+    return centroids, labels
+
+
+def fit(
+    res,
+    params: KMeansBalancedParams,
+    X,
+    n_clusters: int,
+    *,
+    key: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Train balanced centroids; returns (n_clusters, dim) float32.
+
+    Reference: cluster/kmeans_balanced.cuh:75.
+    """
+    with named_range("kmeans_balanced::fit"):
+        X = ensure_array(X, "X")
+        n, _ = X.shape
+        expects(n_clusters <= n, "kmeans_balanced.fit: n_clusters > n_samples")
+        expects(params.metric in (DistanceType.L2Expanded,
+                                  DistanceType.InnerProduct),
+                "kmeans_balanced supports L2Expanded / InnerProduct only "
+                "(as the reference does)")
+        if key is None:
+            key = res.next_key()
+        # evenly-strided init over the (caller-shuffled) trainset — the
+        # reference seeds from strided trainset rows.
+        stride = max(n // n_clusters, 1)
+        c0 = X[::stride][:n_clusters].astype(jnp.float32)
+        if c0.shape[0] < n_clusters:
+            c0 = jnp.pad(c0, ((0, n_clusters - c0.shape[0]), (0, 0)),
+                         mode="edge")
+        if params.metric == DistanceType.InnerProduct:
+            c0 = c0 / jnp.maximum(jnp.linalg.norm(c0, axis=1, keepdims=True),
+                                  1e-12)
+        centroids, _ = _balanced_loop(X, c0, key, n_clusters,
+                                      params.n_iters, params.metric)
+        return centroids
+
+
+def predict(res, params: KMeansBalancedParams, X, centroids) -> jax.Array:
+    """Nearest-centroid labels (reference: kmeans_balanced.cuh:133)."""
+    X = ensure_array(X, "X")
+    labels, _ = _assign(X.astype(jnp.float32),
+                        ensure_array(centroids, "centroids"), params.metric)
+    return labels
+
+
+def fit_predict(res, params: KMeansBalancedParams, X, n_clusters: int,
+                *, key: Optional[jax.Array] = None
+                ) -> Tuple[jax.Array, jax.Array]:
+    """Reference: cluster/kmeans_balanced.cuh:198."""
+    centroids = fit(res, params, X, n_clusters, key=key)
+    return centroids, predict(res, params, X, centroids)
+
+
+def build_clusters(
+    res,
+    params: KMeansBalancedParams,
+    X,
+    n_clusters: int,
+    *,
+    key: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Train + assign + sizes in one call (reference: kmeans_balanced.cuh:257
+    ``helpers::build_clusters`` — the IVF-PQ codebook trainer entry).
+    Returns (centroids, labels, sizes)."""
+    centroids, labels = fit_predict(res, params, X, n_clusters, key=key)
+    _, sizes = calc_centers_and_sizes(ensure_array(X, "X").astype(jnp.float32),
+                                      labels, n_clusters)
+    return centroids, labels, sizes

@@ -1,0 +1,57 @@
+"""k-means parameter structs.
+
+Reference: raft/cluster/kmeans_types.hpp (``KMeansParams``) and
+raft/cluster/kmeans_balanced_types.hpp (``kmeans_balanced_params``).
+Plain dataclasses, mirroring the reference's POD param-struct idiom
+(SURVEY.md §5 config system level 2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from raft_tpu.distance.types import DistanceType
+
+
+class InitMethod:
+    """Reference: kmeans_types.hpp ``InitMethod`` enum."""
+
+    KMeansPlusPlus = 0
+    Random = 1
+    Array = 2
+
+
+@dataclasses.dataclass
+class KMeansParams:
+    """Reference: cluster/kmeans_types.hpp ``KMeansParams``.
+
+    Attributes mirror the reference fields; ``batch_samples``/``batch_centroids``
+    bound the per-step working set exactly as the reference's memory-constrained
+    batching does.
+    """
+
+    n_clusters: int = 8
+    init: int = InitMethod.KMeansPlusPlus
+    max_iter: int = 300
+    tol: float = 1e-4
+    verbosity: int = 0
+    seed: int = 0
+    metric: int = DistanceType.L2Expanded
+    n_init: int = 1
+    oversampling_factor: float = 2.0
+    batch_samples: int = 1 << 15
+    batch_centroids: int = 0  # 0 == use all
+    inertia_check: bool = False
+
+
+@dataclasses.dataclass
+class KMeansBalancedParams:
+    """Reference: cluster/kmeans_balanced_types.hpp ``kmeans_balanced_params``.
+
+    ``metric`` must be L2Expanded or InnerProduct (the reference supports only
+    these for the balanced variant — detail/kmeans_balanced.cuh).
+    """
+
+    n_iters: int = 20
+    metric: int = DistanceType.L2Expanded

@@ -35,19 +35,27 @@ class interruptible:
     # -- token registry (reference: get_token / get_token(thread_id)) --------
     @classmethod
     def get_token(cls, thread_id: Optional[int] = None) -> "interruptible":
+        # Tokens persist for the process lifetime: a token may legitimately be
+        # created for a thread that has not started yet (the reference's
+        # cross-thread pattern), so liveness-based pruning would lose pending
+        # cancellations.  The registry is bounded by the number of distinct
+        # thread ids; a thread that consumed an interruption clears its own
+        # flag (yield_no_wait), so id reuse never inherits a stale cancel
+        # after the flag was observed.
         tid = thread_id if thread_id is not None else threading.get_ident()
         with cls._lock:
-            # prune tokens of dead threads so reused thread ids never inherit
-            # a stale cancellation (reference stores weak_ptr for the same
-            # reason, interruptible.hpp)
-            live = {t.ident for t in threading.enumerate()}
-            for dead in [k for k in cls._tokens if k not in live]:
-                del cls._tokens[dead]
             tok = cls._tokens.get(tid)
             if tok is None:
                 tok = interruptible()
                 cls._tokens[tid] = tok
             return tok
+
+    @classmethod
+    def release_token(cls, thread_id: Optional[int] = None) -> None:
+        """Drop a thread's token (call when a worker thread retires)."""
+        tid = thread_id if thread_id is not None else threading.get_ident()
+        with cls._lock:
+            cls._tokens.pop(tid, None)
 
     def cancel(self) -> None:
         """Flag the owning thread for interruption (reference: :cancel)."""

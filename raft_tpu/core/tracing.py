@@ -10,6 +10,7 @@ we expose the same surface.
 from __future__ import annotations
 
 import contextlib
+import threading
 from typing import Any, Iterator, List
 
 import jax
@@ -22,7 +23,15 @@ class domain:
     raft = "raft_tpu"
 
 
-_range_stack: List[Any] = []
+class _RangeStack(threading.local):
+    """Per-thread stack — jax.named_scope is thread-local, so imperative
+    push/pop must be too (the reference's nvtx ranges are per-thread)."""
+
+    def __init__(self) -> None:
+        self.items: List[Any] = []
+
+
+_range_stack = _RangeStack()
 
 
 @contextlib.contextmanager
@@ -44,11 +53,11 @@ def push_range(name: str, *fmt_args: Any) -> None:
     """Imperative begin-range (reference: core/nvtx.hpp ``push_range``)."""
     cm = range(name, *fmt_args)
     cm.__enter__()
-    _range_stack.append(cm)
+    _range_stack.items.append(cm)
 
 
 def pop_range() -> None:
     """Imperative end-range (reference: core/nvtx.hpp ``pop_range``)."""
-    if _range_stack:
-        cm = _range_stack.pop()
+    if _range_stack.items:
+        cm = _range_stack.items.pop()
         cm.__exit__(None, None, None)

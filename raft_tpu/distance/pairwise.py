@@ -83,13 +83,17 @@ def _hellinger(x, y):
 
 
 def _kl_divergence(x, y):
-    # sum_i x_i * log(x_i / y_i) = sum x log x - x . log y  (matmul form)
+    # sum_i x_i * log(x_i / y_i) = sum x log x - x . log y  (matmul form).
+    # y_i == 0 contributes zero to the cross term, matching the reference
+    # (detail/distance_ops/kl_divergence.cuh:66 zeroes log(y) at y==0 rather
+    # than clamping it).
     acc = _acc_t(x, y)
     xf = x.astype(acc)
     yf = y.astype(acc)
     x_log_x = jnp.sum(jnp.where(xf > 0, xf * jnp.log(jnp.maximum(xf, 1e-30)), 0.0),
                       axis=1)
-    cross = _inner(jnp.where(xf > 0, xf, 0.0), jnp.log(jnp.maximum(yf, 1e-30)))
+    log_y = jnp.where(yf > 0, jnp.log(jnp.maximum(yf, 1e-30)), 0.0)
+    cross = _inner(jnp.where(xf > 0, xf, 0.0), log_y)
     return x_log_x[:, None] - cross
 
 

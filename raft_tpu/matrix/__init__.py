@@ -5,7 +5,7 @@ Reference: cpp/include/raft/matrix/ (SURVEY.md §2.4) — headlined by
 every ANN search path, plus gather/argmin/slice/sort/linewise utilities.
 """
 
-from raft_tpu.matrix.select_k import select_k  # noqa: F401
+from raft_tpu.matrix.select_k import select_k, merge_topk  # noqa: F401
 from raft_tpu.matrix.ops import (  # noqa: F401
     gather,
     gather_if,

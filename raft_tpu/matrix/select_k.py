@@ -66,6 +66,31 @@ def select_k(
     return vals, idx
 
 
+def merge_topk(
+    best_val: jax.Array,
+    best_idx: jax.Array,
+    new_val: jax.Array,
+    new_idx: jax.Array,
+    *,
+    select_min: bool = True,
+) -> Tuple[jax.Array, jax.Array]:
+    """Merge a running (batch, k) top-k with (batch, kt) new candidates.
+
+    The shared streaming-selection step used by brute-force kNN tiling and the
+    IVF probe scans (the role detail/knn_merge_parts.cuh's kernel plays in the
+    reference): concatenate, re-select k, gather payloads.
+    """
+    k = best_val.shape[1]
+    cat_v = jnp.concatenate([best_val, new_val], axis=1)
+    cat_i = jnp.concatenate([best_idx, new_idx], axis=1)
+    if select_min:
+        vals, pos = jax.lax.top_k(-cat_v, k)
+        vals = -vals
+    else:
+        vals, pos = jax.lax.top_k(cat_v, k)
+    return vals, jnp.take_along_axis(cat_i, pos, axis=1)
+
+
 def _tiled_select(in_val: jax.Array, k: int, select_min: bool
                   ) -> Tuple[jax.Array, jax.Array]:
     """Two-pass selection: per-tile top-k, then top-k of candidates.

@@ -1,0 +1,13 @@
+"""Nearest-neighbor search — the flagship layer.
+
+Reference: cpp/include/raft/neighbors/ (SURVEY.md §2.6) — brute-force kNN
+(+ partitioned-result merge), IVF-Flat, IVF-PQ, CAGRA, refinement, ball cover,
+epsilon neighborhood, and versioned index serialization.
+"""
+
+from raft_tpu.neighbors import brute_force  # noqa: F401
+from raft_tpu.neighbors.brute_force import knn, knn_merge_parts  # noqa: F401
+from raft_tpu.neighbors.refine import refine  # noqa: F401
+from raft_tpu.neighbors.epsilon_neighborhood import (  # noqa: F401
+    eps_neighbors_l2sq,
+)

@@ -1,0 +1,145 @@
+"""Brute-force (exact) k-nearest-neighbor search.
+
+Reference: raft/neighbors/brute_force.cuh:150 ``knn`` (tiled pairwise distance
++ select_k, detail/knn_brute_force.cuh) and :80 ``knn_merge_parts``
+(merge of row-partitioned kNN results, detail/knn_merge_parts.cuh); the fused
+L2 kNN kernel lives at spatial/knn/detail/fused_l2_knn.cuh.
+
+TPU design: a ``lax.scan`` over database tiles.  Each step computes one
+(n_queries, tile_n) distance block — a single MXU gemm + fused epilogue for
+the expanded metrics — takes the block's local top-k, and merges it into the
+running top-k by re-selecting over the 2k concatenated candidates.  HBM
+traffic is O(q·d + n·d + q·k) and peak memory O(q·tile_n), the same bound the
+reference's tiling buys (detail/knn_brute_force.cuh tiles queries×db).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import ensure_array
+from raft_tpu.core.tracing import range as named_range
+from raft_tpu.distance.pairwise import pairwise_distance
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.matrix.select_k import merge_topk, select_k
+
+_TILE_N = 8192
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric", "tile_n"))
+def _knn_impl(database, queries, k, metric, metric_arg, tile_n):
+    n, dim = database.shape
+    nq = queries.shape[0]
+    select_min = metric != DistanceType.InnerProduct
+    n_tiles = -(-n // tile_n)
+    padded = n_tiles * tile_n
+    db = jnp.pad(database, ((0, padded - n), (0, 0)))
+    db_tiles = db.reshape(n_tiles, tile_n, dim)
+
+    worst = jnp.inf if select_min else -jnp.inf
+    init = (jnp.full((nq, k), worst, jnp.float32),
+            jnp.full((nq, k), -1, jnp.int32))
+
+    def step(carry, xs):
+        best_d, best_i = carry
+        tile, t = xs
+        d = pairwise_distance(queries, tile, metric,
+                              metric_arg=metric_arg).astype(jnp.float32)
+        valid = (t * tile_n + jnp.arange(tile_n)) < n
+        d = jnp.where(valid[None, :], d, worst)
+        kt = min(k, tile_n)
+        td, ti = select_k(d, kt, select_min=select_min)
+        ti = ti.astype(jnp.int32) + t * tile_n
+        return merge_topk(best_d, best_i, td, ti,
+                          select_min=select_min), None
+
+    (best_d, best_i), _ = jax.lax.scan(
+        step, init, (db_tiles, jnp.arange(n_tiles)))
+    return best_d, best_i
+
+
+def knn(
+    res,
+    database,
+    queries,
+    k: int,
+    *,
+    metric: int = DistanceType.L2Unexpanded,
+    metric_arg: float = 2.0,
+    global_id_offset: int = 0,
+    tile_n: int = _TILE_N,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact kNN of ``queries`` (q, d) against ``database`` (n, d).
+
+    Reference: neighbors/brute_force.cuh:150 ``knn``.  Returns
+    ``(distances (q, k), indices (q, k) int32)`` sorted best-first;
+    ``global_id_offset`` shifts returned ids (the reference's translation
+    argument for row-partitioned databases).
+    """
+    with named_range("brute_force::knn"):
+        database = ensure_array(database, "database")
+        queries = ensure_array(queries, "queries")
+        expects(database.ndim == 2 and queries.ndim == 2
+                and database.shape[1] == queries.shape[1],
+                "knn: (n,d) database and (q,d) queries required")
+        expects(0 < k <= database.shape[0], "knn: need 0 < k <= n")
+        tile = min(tile_n, database.shape[0])
+        d, i = _knn_impl(database, queries, k, metric, metric_arg, tile)
+        if global_id_offset:
+            i = i + global_id_offset
+        return d, i
+
+
+def knn_merge_parts(
+    in_keys: jax.Array,
+    in_values: jax.Array,
+    *,
+    n_samples: Optional[int] = None,
+    translations: Optional[jax.Array] = None,
+    select_min: bool = True,
+) -> Tuple[jax.Array, jax.Array]:
+    """Merge kNN results from row-partitioned database parts.
+
+    Reference: neighbors/brute_force.cuh:80 ``knn_merge_parts``
+    (detail/knn_merge_parts.cuh) — the scale-out seam for sharded search:
+    each of ``n_parts`` shards contributes a (q, k) result; the merge is a
+    top-k over the union with per-part id translations.
+
+    ``in_keys``/``in_values``: (n_parts, q, k) distances / indices.
+    ``translations``: optional (n_parts,) id offsets (defaults to the
+    reference's uniform-partition offsets ``part * n_samples``).
+    """
+    expects(in_keys.ndim == 3 and in_values.shape == in_keys.shape,
+            "knn_merge_parts: (n_parts, q, k) inputs required")
+    n_parts, nq, k = in_keys.shape
+    # id dtype follows the caller's index dtype: int32 by default (JAX's
+    # default int), int64 when the caller passes int64 ids with x64 enabled —
+    # silently requesting int64 under x64-disabled JAX would truncate.
+    idx_t = in_values.dtype
+    if translations is None:
+        expects(n_samples is not None,
+                "knn_merge_parts: need n_samples or translations")
+        expects(np.int64(n_parts - 1) * np.int64(n_samples)
+                <= np.iinfo(idx_t).max,
+                "knn_merge_parts: global ids overflow the index dtype; pass "
+                "int64 in_values (with jax x64 enabled) or explicit "
+                "translations")
+        translations = jnp.arange(n_parts, dtype=idx_t) * n_samples
+    else:
+        translations = translations.astype(idx_t)
+    ids = in_values + translations[:, None, None]
+    keys = jnp.transpose(in_keys, (1, 0, 2)).reshape(nq, n_parts * k)
+    vals = jnp.transpose(ids, (1, 0, 2)).reshape(nq, n_parts * k)
+    return select_k(keys, k, in_idx=vals, select_min=select_min)
+
+
+def tiled_brute_force_knn(res, database, queries, k, **kw):
+    """Alias for :func:`knn` (reference: detail/knn_brute_force.cuh
+    ``tiled_brute_force_knn`` — tiling is always on here)."""
+    return knn(res, database, queries, k, **kw)

@@ -1,0 +1,41 @@
+"""Epsilon neighborhood: all pairs within distance eps.
+
+Reference: raft/neighbors/epsilon_neighborhood.cuh:121
+``epsUnexpL2SqNeighborhood`` — boolean adjacency of ``||x - y||^2 < eps^2``
+plus per-row neighbor counts (vertex degrees), used by DBSCAN-style
+algorithms.
+
+TPU design: the (m, n) squared-L2 block is one MXU gemm + epilogue; the
+comparison and degree reduction fuse into it.  For large m the caller tiles
+rows (the adjacency output itself is O(m·n) either way, as in the reference).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.mdarray import ensure_array
+from raft_tpu.distance.pairwise import pairwise_distance
+from raft_tpu.distance.types import DistanceType
+
+
+def eps_neighbors_l2sq(
+    res,
+    x,
+    y,
+    eps_sq: float,
+) -> Tuple[jax.Array, jax.Array]:
+    """Adjacency (m, n) bool of ``||x_i - y_j||^2 < eps_sq`` + degrees (m,).
+
+    Reference: epsilon_neighborhood.cuh:121 (adj + vd outputs; vd's last
+    element there is the total count — we return degrees only, total is
+    ``degrees.sum()``).
+    """
+    x = ensure_array(x, "x")
+    y = ensure_array(y, "y")
+    d = pairwise_distance(x, y, DistanceType.L2Unexpanded)
+    adj = d < eps_sq
+    return adj, jnp.sum(adj, axis=1).astype(jnp.int32)

@@ -1,0 +1,332 @@
+"""IVF-Flat: inverted-file index over a balanced-k-means coarse quantizer.
+
+Reference: raft/neighbors/ivf_flat.cuh:65 ``build``, :201 ``extend``, :389
+``search``; types ivf_flat_types.hpp:44 (index_params), :76 (search_params),
+:126 (index).  Build internals: detail/ivf_flat_build.cuh (kmeans_balanced fit
+:336-339, predict + calc_centers_and_sizes :180-204); search:
+detail/ivf_flat_search.cuh:670 ``interleaved_scan_kernel`` + select_k.
+
+TPU design — the central impedance mismatch is the reference's *ragged*
+inverted lists vs XLA's static shapes (SURVEY.md §7 "hard parts"):
+
+- lists are stored **padded to one shared capacity** (rounded to a multiple of
+  32, like the reference rounds list allocations — ivf_flat_types.hpp /
+  ivf_list.hpp); slot validity comes from ``list_indices >= 0``;
+- balanced k-means keeps the padding overhead bounded (that is *why* the
+  reference uses a balanced quantizer: list occupancy = search cost);
+- search scans the ``n_probes`` probed lists with a ``lax.scan``, each step
+  one gathered (q, capacity, d) block → a batched-matmul distance + masked
+  top-k merge.  The gather+matmul per probe is the TPU analogue of the
+  interleaved-scan kernel: MXU does the FLOPs, the mask replaces list length.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import BinaryIO, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.cluster.kmeans_types import KMeansBalancedParams
+from raft_tpu.core import serialize as ser
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import ensure_array
+from raft_tpu.core.tracing import range as named_range
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.matrix.select_k import merge_topk, select_k
+from raft_tpu.utils.precision import get_matmul_precision
+
+_LIST_ALIGN = 32  # reference: list sizes rounded to warp multiples (ivf_list.hpp)
+
+
+@dataclasses.dataclass
+class IndexParams:
+    """Reference: ivf_flat_types.hpp:44 ``index_params``."""
+
+    n_lists: int = 1024
+    metric: int = DistanceType.L2Expanded
+    kmeans_n_iters: int = 20
+    kmeans_trainset_fraction: float = 0.5
+    adaptive_centers: bool = False
+    add_data_on_build: bool = True
+
+
+@dataclasses.dataclass
+class SearchParams:
+    """Reference: ivf_flat_types.hpp:76 ``search_params``."""
+
+    n_probes: int = 20
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Index:
+    """Reference: ivf_flat_types.hpp:126 ``index`` (centers + per-list data
+    + per-list source ids + sizes).  ``list_data`` is (n_lists, capacity, dim)
+    with invalid slots zero; ``list_indices`` is (n_lists, capacity) int32
+    with -1 marking empty slots."""
+
+    centers: jax.Array          # (n_lists, dim) f32
+    list_data: jax.Array        # (n_lists, capacity, dim)
+    list_indices: jax.Array     # (n_lists, capacity) int32
+    list_sizes: jax.Array       # (n_lists,) int32
+    metric: int = DistanceType.L2Expanded
+    adaptive_centers: bool = False
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+    @property
+    def capacity(self) -> int:
+        return self.list_data.shape[1]
+
+    @property
+    def size(self) -> int:
+        return int(jnp.sum(self.list_sizes))
+
+    def tree_flatten(self):
+        leaves = (self.centers, self.list_data, self.list_indices,
+                  self.list_sizes)
+        return leaves, (self.metric, self.adaptive_centers)
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves, metric=aux[0], adaptive_centers=aux[1])
+
+
+def _round_up(x: int, align: int) -> int:
+    return -(-x // align) * align
+
+
+def _pack_lists(dataset: jax.Array, labels: jax.Array, source_ids: jax.Array,
+                n_lists: int, capacity: int
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Scatter rows into padded per-list storage.
+
+    The TPU analogue of the reference's list layout + fill kernels
+    (detail/ivf_flat_build.cuh; codepacking in ivf_pq does the same dance):
+    sort by label, compute each row's rank within its list, one scatter.
+    """
+    n = dataset.shape[0]
+    order = jnp.argsort(labels)
+    sorted_labels = labels[order]
+    sizes = jax.ops.segment_sum(jnp.ones(n, jnp.int32), labels,
+                                num_segments=n_lists)
+    starts = jnp.cumsum(sizes) - sizes
+    rank = jnp.arange(n) - starts[sorted_labels]
+    list_data = jnp.zeros((n_lists, capacity, dataset.shape[1]),
+                          dataset.dtype)
+    list_idx = jnp.full((n_lists, capacity), -1, jnp.int32)
+    list_data = list_data.at[sorted_labels, rank].set(dataset[order])
+    list_idx = list_idx.at[sorted_labels, rank].set(
+        source_ids[order].astype(jnp.int32))
+    return list_data, list_idx, sizes
+
+
+def build(res, params: IndexParams, dataset) -> Index:
+    """Build an IVF-Flat index (reference: ivf_flat.cuh:65).
+
+    Trains the balanced coarse quantizer on a subsample
+    (``kmeans_trainset_fraction``, as detail/ivf_flat_build.cuh:336), then
+    assigns and packs all rows.
+    """
+    with named_range("ivf_flat::build"):
+        dataset = ensure_array(dataset, "dataset")
+        expects(dataset.ndim == 2, "ivf_flat.build: 2-D dataset required")
+        n, dim = dataset.shape
+        expects(params.n_lists <= n, "ivf_flat.build: n_lists > n_rows")
+
+        n_train = max(params.n_lists,
+                      int(n * params.kmeans_trainset_fraction))
+        if n_train < n:
+            key = res.next_key()
+            sel = jax.random.choice(key, n, (n_train,), replace=False)
+            trainset = dataset[sel]
+        else:
+            trainset = dataset
+        bal = KMeansBalancedParams(n_iters=params.kmeans_n_iters,
+                                   metric=params.metric
+                                   if params.metric == DistanceType.InnerProduct
+                                   else DistanceType.L2Expanded)
+        centers = kmeans_balanced.fit(res, bal, trainset, params.n_lists)
+
+        index = Index(centers=centers,
+                      list_data=jnp.zeros((params.n_lists, _LIST_ALIGN, dim),
+                                          dataset.dtype),
+                      list_indices=jnp.full((params.n_lists, _LIST_ALIGN), -1,
+                                            jnp.int32),
+                      list_sizes=jnp.zeros(params.n_lists, jnp.int32),
+                      metric=params.metric,
+                      adaptive_centers=params.adaptive_centers)
+        if params.add_data_on_build:
+            index = extend(res, index, dataset,
+                           jnp.arange(n, dtype=jnp.int32))
+        return index
+
+
+def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
+    """Add vectors to an index (reference: ivf_flat.cuh:201 ``extend``).
+
+    Rebuilds the padded list storage at the new capacity (the reference
+    reallocates lists that outgrow their capacity too — ivf_list.hpp); the
+    coarse centers optionally drift when ``adaptive_centers`` is set
+    (ivf_flat_types.hpp adaptive_centers semantics).
+    """
+    with named_range("ivf_flat::extend"):
+        new_vectors = ensure_array(new_vectors, "new_vectors")
+        expects(new_vectors.ndim == 2 and new_vectors.shape[1] == index.dim,
+                "ivf_flat.extend: dim mismatch")
+        n_new = new_vectors.shape[0]
+        if new_indices is None:
+            new_indices = index.size + jnp.arange(n_new, dtype=jnp.int32)
+        else:
+            new_indices = ensure_array(new_indices, "new_indices")
+
+        bal = KMeansBalancedParams(metric=index.metric
+                                   if index.metric == DistanceType.InnerProduct
+                                   else DistanceType.L2Expanded)
+        new_labels = kmeans_balanced.predict(res, bal, new_vectors,
+                                             index.centers)
+
+        # existing rows, flattened back out of the padded storage
+        old_valid = index.list_indices >= 0
+        old_labels = jnp.repeat(jnp.arange(index.n_lists, dtype=jnp.int32),
+                                index.capacity)[old_valid.ravel()]
+        old_vecs = index.list_data.reshape(-1, index.dim)[old_valid.ravel()]
+        old_ids = index.list_indices.ravel()[old_valid.ravel()]
+
+        all_vecs = jnp.concatenate([old_vecs, new_vectors.astype(
+            index.list_data.dtype)], axis=0)
+        all_ids = jnp.concatenate([old_ids, new_indices.astype(jnp.int32)])
+        all_labels = jnp.concatenate([old_labels, new_labels])
+
+        sizes = jax.ops.segment_sum(
+            jnp.ones(all_labels.shape[0], jnp.int32), all_labels,
+            num_segments=index.n_lists)
+        capacity = _round_up(max(int(jnp.max(sizes)), _LIST_ALIGN),
+                             _LIST_ALIGN)
+        list_data, list_idx, sizes = _pack_lists(
+            all_vecs, all_labels, all_ids, index.n_lists, capacity)
+
+        centers = index.centers
+        if index.adaptive_centers:
+            # drift centers toward the new per-list means (reference:
+            # ivf_flat_build extend updates centers when adaptive)
+            sums = jax.ops.segment_sum(all_vecs.astype(jnp.float32),
+                                       all_labels,
+                                       num_segments=index.n_lists)
+            means = sums / jnp.maximum(sizes, 1)[:, None]
+            centers = jnp.where((sizes > 0)[:, None], means, centers)
+
+        return Index(centers=centers, list_data=list_data,
+                     list_indices=list_idx, list_sizes=sizes,
+                     metric=index.metric,
+                     adaptive_centers=index.adaptive_centers)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "n_probes", "metric"))
+def _search_impl(centers, list_data, list_indices, queries, k, n_probes,
+                 metric):
+    nq = queries.shape[0]
+    qf = queries.astype(jnp.float32)
+    cf = centers.astype(jnp.float32)
+    ip_metric = metric == DistanceType.InnerProduct
+
+    # ---- coarse: pick n_probes lists per query (select_clusters analogue) --
+    q_dot_c = jax.lax.dot_general(qf, cf, (((1,), (1,)), ((), ())),
+                                  precision=get_matmul_precision(),
+                                  preferred_element_type=jnp.float32)
+    if ip_metric:
+        coarse = q_dot_c
+        _, probes = jax.lax.top_k(coarse, n_probes)
+    else:
+        c_sq = jnp.sum(cf * cf, axis=1)
+        coarse = c_sq[None, :] - 2.0 * q_dot_c  # + q² is rank-invariant
+        _, probes = jax.lax.top_k(-coarse, n_probes)
+
+    # ---- fine: scan probed lists, merge running top-k --------------------
+    worst = -jnp.inf if ip_metric else jnp.inf
+    q_sq = jnp.sum(qf * qf, axis=1)
+    init = (jnp.full((nq, k), worst, jnp.float32),
+            jnp.full((nq, k), -1, jnp.int32))
+
+    def probe_step(carry, p):
+        best_d, best_i = carry
+        lists = probes[:, p]                        # (q,)
+        data = list_data[lists].astype(jnp.float32)  # (q, cap, d)
+        ids = list_indices[lists]                   # (q, cap)
+        ip = jnp.einsum("qd,qcd->qc", qf, data,
+                        precision=get_matmul_precision())
+        if ip_metric:
+            d = jnp.where(ids >= 0, ip, worst)
+        else:
+            d_sq = jnp.sum(data * data, axis=-1)
+            d = jnp.maximum(q_sq[:, None] + d_sq - 2.0 * ip, 0.0)
+            d = jnp.where(ids >= 0, d, worst)
+        kt = min(k, d.shape[1])
+        td, ti = select_k(d, kt, in_idx=ids, select_min=not ip_metric)
+        return merge_topk(best_d, best_i, td, ti,
+                          select_min=not ip_metric), None
+
+    (best_d, best_i), _ = jax.lax.scan(probe_step, init,
+                                       jnp.arange(n_probes))
+    if metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded):
+        best_d = jnp.sqrt(jnp.maximum(best_d, 0.0))
+    return best_d, best_i
+
+
+def search(res, params: SearchParams, index: Index, queries, k: int
+           ) -> Tuple[jax.Array, jax.Array]:
+    """Search the index (reference: ivf_flat.cuh:389).
+
+    Returns ``(distances (q, k), indices (q, k) int32)``; unfilled slots
+    (fewer than k valid candidates in the probed lists) carry id -1 and
+    +inf / -inf distance, matching the reference's sentinel behavior.
+    """
+    with named_range("ivf_flat::search"):
+        queries = ensure_array(queries, "queries")
+        expects(queries.ndim == 2 and queries.shape[1] == index.dim,
+                "ivf_flat.search: query dim mismatch")
+        n_probes = min(params.n_probes, index.n_lists)
+        return _search_impl(index.centers, index.list_data,
+                            index.list_indices, queries, k, n_probes,
+                            index.metric)
+
+
+# ---------------------------------------------------------------------------
+# serialization (reference: ivf_flat_serialize.cuh; version hard-checked)
+# ---------------------------------------------------------------------------
+
+_SERIALIZATION_VERSION = 1
+
+
+def serialize(res, stream: BinaryIO, index: Index) -> None:
+    """Versioned index dump (reference: detail/ivf_flat_serialize.cuh)."""
+    ser.serialize_scalar(res, stream, np.int32(_SERIALIZATION_VERSION))
+    ser.serialize_scalar(res, stream, np.int32(index.metric))
+    ser.serialize_scalar(res, stream, np.int32(index.adaptive_centers))
+    for arr in (index.centers, index.list_data, index.list_indices,
+                index.list_sizes):
+        ser.serialize_mdspan(res, stream, arr)
+
+
+def deserialize(res, stream: BinaryIO) -> Index:
+    version = int(ser.deserialize_scalar(res, stream))
+    if version != _SERIALIZATION_VERSION:
+        raise ValueError(
+            f"ivf_flat serialization version mismatch: got {version}, "
+            f"expected {_SERIALIZATION_VERSION}")  # reference hard-fails too
+    metric = int(ser.deserialize_scalar(res, stream))
+    adaptive = bool(ser.deserialize_scalar(res, stream))
+    arrays = [jnp.asarray(ser.deserialize_mdspan(res, stream))
+              for _ in range(4)]
+    return Index(*arrays, metric=metric, adaptive_centers=adaptive)

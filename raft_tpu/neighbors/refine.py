@@ -1,0 +1,87 @@
+"""Candidate refinement (exact re-ranking).
+
+Reference: raft/neighbors/refine.cuh:105 ``refine`` — given approximate
+candidate neighbors (e.g. from IVF-PQ or CAGRA's graph build), recompute exact
+distances to the candidates and keep the best k (detail/refine.cuh; the host
+path ``refine_host`` is what CAGRA's build uses).
+
+TPU design: one gather of the candidate vectors (q, n_cand, d) + a batched
+distance einsum + top-k — entirely fused by XLA; invalid candidate slots
+(id < 0, the reference's out-of-list marker) are masked to +inf.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import ensure_array
+from raft_tpu.core.tracing import range as named_range
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.matrix.select_k import select_k
+from raft_tpu.utils.precision import get_matmul_precision
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def _refine_impl(dataset, queries, candidates, k, metric):
+    nq, n_cand = candidates.shape
+    valid = candidates >= 0
+    safe = jnp.where(valid, candidates, 0)
+    cand_vecs = dataset[safe]                       # (q, n_cand, d)
+    qf = queries.astype(jnp.float32)
+    cf = cand_vecs.astype(jnp.float32)
+
+    if metric == DistanceType.InnerProduct:
+        ip = jnp.einsum("qd,qcd->qc", qf, cf,
+                        precision=get_matmul_precision())
+        d = jnp.where(valid, ip, -jnp.inf)
+        vals, pos = jax.lax.top_k(d, k)
+    else:
+        # squared L2 (sqrt applied for the sqrt metrics below)
+        diff2 = jnp.sum(cf * cf, axis=-1) - 2.0 * jnp.einsum(
+            "qd,qcd->qc", qf, cf, precision=get_matmul_precision())
+        d = jnp.maximum(diff2 + jnp.sum(qf * qf, axis=-1, keepdims=True), 0.0)
+        if metric in (DistanceType.L2SqrtExpanded,
+                      DistanceType.L2SqrtUnexpanded):
+            d = jnp.sqrt(d)
+        d = jnp.where(valid, d, jnp.inf)
+        vals, pos = select_k(d, k, select_min=True)
+    idx = jnp.take_along_axis(candidates, pos, axis=1)
+    return vals, idx
+
+
+def refine(
+    res,
+    dataset,
+    queries,
+    candidates,
+    k: int,
+    *,
+    metric: int = DistanceType.L2Unexpanded,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact re-rank of candidate ids; returns (distances, indices) (q, k).
+
+    Reference: neighbors/refine.cuh:105 (metric limited to L2/IP families
+    there too).  ``candidates`` is (q, n_candidates) int ids into ``dataset``;
+    negative ids are treated as empty slots.
+    """
+    with named_range("refine"):
+        dataset = ensure_array(dataset, "dataset")
+        queries = ensure_array(queries, "queries")
+        candidates = ensure_array(candidates, "candidates")
+        expects(candidates.ndim == 2
+                and candidates.shape[0] == queries.shape[0],
+                "refine: (q, n_candidates) ids required")
+        expects(k <= candidates.shape[1],
+                "refine: k exceeds candidate count")
+        expects(metric in (DistanceType.L2Expanded,
+                           DistanceType.L2SqrtExpanded,
+                           DistanceType.L2Unexpanded,
+                           DistanceType.L2SqrtUnexpanded,
+                           DistanceType.InnerProduct),
+                "refine: L2 / InnerProduct metrics only (as the reference)")
+        return _refine_impl(dataset, queries, candidates, k, metric)

@@ -27,5 +27,12 @@ def res():
 
 @pytest.fixture
 def mesh8():
-    devs = np.asarray(jax.devices()[:8])
-    return jax.sharding.Mesh(devs, ("data",))
+    devs = jax.devices()
+    if len(devs) < 8:
+        # the axon tunnel exposes one real TPU; fall back to the virtual
+        # 8-device CPU backend for mesh tests
+        devs = jax.devices("cpu")
+    if len(devs) < 8:
+        pytest.skip("needs 8 devices (set "
+                    "XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    return jax.sharding.Mesh(np.asarray(devs[:8]), ("data",))

@@ -1,0 +1,150 @@
+"""Cluster layer tests.
+
+Mirrors the reference's compute-vs-reference strategy (SURVEY.md §4):
+inputs from raft_tpu.random.make_blobs, results checked against known cluster
+structure and against a naive numpy Lloyd implementation.
+Reference tests: cpp/test/cluster/kmeans.cu, kmeans_balanced.cu.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.cluster import (
+    InitMethod,
+    KMeansBalancedParams,
+    KMeansParams,
+    kmeans,
+    kmeans_balanced,
+)
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.random import make_blobs
+
+
+def _blobs(res, n=600, d=8, k=5, std=0.3, seed=0):
+    X, labels = make_blobs(n, d, n_clusters=k, cluster_std=std, seed=seed,
+                           shuffle=True)
+    return np.asarray(X), np.asarray(labels)
+
+
+def _naive_lloyd(X, c0, iters=50):
+    c = c0.copy()
+    for _ in range(iters):
+        d = ((X[:, None, :] - c[None, :, :]) ** 2).sum(-1)
+        lab = d.argmin(1)
+        for j in range(c.shape[0]):
+            if (lab == j).any():
+                c[j] = X[lab == j].mean(0)
+    d = ((X[:, None, :] - c[None, :, :]) ** 2).sum(-1)
+    return c, lab, d.min(1).sum()
+
+
+class TestKMeans:
+    def test_fit_recovers_blobs(self, res):
+        X, true_labels = _blobs(res, k=5)
+        params = KMeansParams(n_clusters=5, max_iter=100, tol=1e-6, seed=3)
+        centroids, inertia, n_iter = kmeans.fit(res, params, X)
+        assert centroids.shape == (5, X.shape[1])
+        assert int(n_iter) >= 1
+        labels, _ = kmeans.predict(res, params, X, centroids)
+        # same-blob points should land in the same cluster (ARI-style check)
+        labels = np.asarray(labels)
+        for b in range(5):
+            blob = labels[true_labels == b]
+            # dominant assignment covers nearly the whole blob
+            frac = np.bincount(blob, minlength=5).max() / blob.size
+            assert frac > 0.95
+
+    def test_inertia_close_to_naive(self, res):
+        X, _ = _blobs(res, n=400, d=4, k=3)
+        params = KMeansParams(n_clusters=3, max_iter=100, tol=1e-8,
+                              n_init=3, seed=0)
+        _, inertia, _ = kmeans.fit(res, params, X)
+        # naive Lloyd from a decent start
+        rng = np.random.default_rng(0)
+        best = np.inf
+        for s in range(3):
+            c0 = X[rng.choice(X.shape[0], 3, replace=False)]
+            _, _, cost = _naive_lloyd(X, c0)
+            best = min(best, cost)
+        assert float(inertia) <= best * 1.05 + 1e-6
+
+    def test_init_array(self, res):
+        X, _ = _blobs(res, n=300, d=4, k=3)
+        c0 = X[:3].copy()
+        params = KMeansParams(n_clusters=3, init=InitMethod.Array,
+                              max_iter=50)
+        centroids, inertia, _ = kmeans.fit(res, params, X, centroids=c0)
+        assert np.isfinite(float(inertia))
+
+    def test_predict_and_transform_shapes(self, res):
+        X, _ = _blobs(res, n=200, d=6, k=4)
+        params = KMeansParams(n_clusters=4, max_iter=30)
+        centroids, _, _ = kmeans.fit(res, params, X)
+        labels, inertia = kmeans.predict(res, params, X, centroids)
+        assert labels.shape == (200,) and labels.dtype == jnp.int32
+        t = kmeans.transform(res, params, X, centroids)
+        assert t.shape == (200, 4)
+        # transform distances consistent with labels
+        assert np.array_equal(np.asarray(t).argmin(1), np.asarray(labels))
+
+    def test_update_centroids_empty_cluster(self, res):
+        X = np.random.default_rng(0).normal(size=(50, 3)).astype(np.float32)
+        labels = jnp.zeros(50, jnp.int32)  # all in cluster 0; cluster 1 empty
+        old = jnp.asarray(np.ones((2, 3), np.float32) * 7)
+        c, counts = kmeans.update_centroids(jnp.asarray(X), labels, 2,
+                                            old_centroids=old)
+        np.testing.assert_allclose(np.asarray(c[0]), X.mean(0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(c[1]), 7 * np.ones(3))
+        assert int(counts[1]) == 0
+
+    def test_cluster_cost(self, res):
+        X, _ = _blobs(res, n=100, d=4, k=2)
+        c = jnp.asarray(X[:2])
+        cost = kmeans.cluster_cost(jnp.asarray(X), c)
+        d = ((X[:, None, :] - X[None, :2, :]) ** 2).sum(-1).min(1).sum()
+        np.testing.assert_allclose(float(cost), d, rtol=1e-4)
+
+    def test_find_k(self, res):
+        X, _ = _blobs(res, n=400, d=4, k=4, std=0.2, seed=7)
+        best_k, c, inertia = kmeans.find_k(res, X, k_max=8, k_min=2)
+        assert 3 <= best_k <= 6
+
+
+class TestKMeansBalanced:
+    def test_fit_predict_balanced(self, res):
+        X, _ = _blobs(res, n=1024, d=8, k=8, std=0.5)
+        params = KMeansBalancedParams(n_iters=20)
+        centroids, labels = kmeans_balanced.fit_predict(res, params, X, 16)
+        assert centroids.shape == (16, 8)
+        sizes = np.bincount(np.asarray(labels), minlength=16)
+        # balance property: no cluster hugely overloaded, few empty
+        assert sizes.max() <= X.shape[0] // 2
+        assert (sizes > 0).sum() >= 12
+
+    def test_predict_matches_nearest(self, res):
+        X, _ = _blobs(res, n=200, d=4, k=4)
+        params = KMeansBalancedParams(n_iters=10)
+        centroids = kmeans_balanced.fit(res, params, X, 4)
+        labels = np.asarray(kmeans_balanced.predict(res, params, X, centroids))
+        d = ((X[:, None, :] - np.asarray(centroids)[None, :, :]) ** 2).sum(-1)
+        np.testing.assert_array_equal(labels, d.argmin(1))
+
+    def test_inner_product_metric(self, res):
+        X, _ = _blobs(res, n=300, d=8, k=4)
+        X = X / np.linalg.norm(X, axis=1, keepdims=True)
+        params = KMeansBalancedParams(n_iters=10,
+                                      metric=DistanceType.InnerProduct)
+        centroids, labels = kmeans_balanced.fit_predict(res, params, X, 4)
+        # centroids unit-norm (spherical k-means)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(centroids), axis=1), 1.0, atol=1e-4)
+
+    def test_build_clusters(self, res):
+        X, _ = _blobs(res, n=256, d=4, k=4)
+        params = KMeansBalancedParams(n_iters=5)
+        c, labels, sizes = kmeans_balanced.build_clusters(res, params, X, 8)
+        assert int(jnp.sum(sizes)) == 256
+        np.testing.assert_array_equal(
+            np.asarray(sizes),
+            np.bincount(np.asarray(labels), minlength=8))

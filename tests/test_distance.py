@@ -87,6 +87,16 @@ class TestPairwiseDistance:
         ref = (x[:, None, :] * np.log(x[:, None, :] / y[None, :, :])).sum(-1)
         np.testing.assert_allclose(out, ref, atol=1e-3)
 
+    def test_kl_divergence_zero_y(self):
+        # y_j == 0 contributes nothing to the cross term (reference:
+        # distance_ops/kl_divergence.cuh:66 zeroes log(y) at y==0)
+        x = np.asarray([[0.5, 0.5, 0.0]], np.float32)
+        y = np.asarray([[0.5, 0.0, 0.5]], np.float32)
+        out = np.asarray(pairwise_distance(x, y, DistanceType.KLDivergence))
+        # x log x = log(0.5); cross keeps only j=0 (x_1>0 but y_1==0 dropped,
+        # x_2==0 dropped) = 0.5*log(0.5); result = 0.5*log(0.5)
+        np.testing.assert_allclose(out[0, 0], 0.5 * np.log(0.5), atol=1e-5)
+
     def test_hamming(self):
         x = (RNG.random((20, 30)) > 0.5).astype(np.float32)
         y = (RNG.random((25, 30)) > 0.5).astype(np.float32)

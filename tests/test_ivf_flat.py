@@ -1,0 +1,125 @@
+"""IVF-Flat tests — recall-based, mirroring the reference's ANN test pattern
+(cpp/test/neighbors/ann_ivf_flat.cuh: ground truth from naive_knn, assertion
+``eval_neighbours(min_recall)``), plus serialization round-trip in-test.
+"""
+
+import io
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.random import make_blobs
+
+
+def naive_knn(db, q, k):
+    d = ((q[:, None, :] - db[None, :, :]) ** 2).sum(-1)
+    idx = np.argsort(d, axis=1, kind="stable")[:, :k]
+    return np.take_along_axis(d, idx, axis=1), idx
+
+
+def recall(found, truth):
+    hits = sum(len(set(f) & set(t)) for f, t in zip(found, truth))
+    return hits / truth.size
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    X, _ = make_blobs(4000, 16, n_clusters=50, cluster_std=1.0, seed=0)
+    db = np.asarray(X[:3800])
+    q = np.asarray(X[3800:3850])
+    return db, q
+
+
+class TestIvfFlat:
+    def test_build_shapes(self, res, dataset):
+        db, _ = dataset
+        params = ivf_flat.IndexParams(n_lists=32, kmeans_n_iters=10)
+        index = ivf_flat.build(res, params, db)
+        assert index.n_lists == 32
+        assert index.dim == db.shape[1]
+        assert index.size == db.shape[0]
+        assert index.capacity % 32 == 0
+        # every row landed exactly once
+        ids = np.asarray(index.list_indices)
+        valid = ids[ids >= 0]
+        assert sorted(valid.tolist()) == list(range(db.shape[0]))
+
+    def test_search_recall(self, res, dataset):
+        db, q = dataset
+        params = ivf_flat.IndexParams(n_lists=32, kmeans_n_iters=10)
+        index = ivf_flat.build(res, params, db)
+        d, i = ivf_flat.search(res, ivf_flat.SearchParams(n_probes=8),
+                               index, q, 10)
+        _, ti = naive_knn(db, q, 10)
+        assert recall(np.asarray(i), ti) > 0.9
+
+    def test_full_probe_is_exact(self, res, dataset):
+        db, q = dataset
+        params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=10)
+        index = ivf_flat.build(res, params, db)
+        d, i = ivf_flat.search(res, ivf_flat.SearchParams(n_probes=16),
+                               index, q, 10)
+        td, ti = naive_knn(db, q, 10)
+        assert recall(np.asarray(i), ti) > 0.99
+        np.testing.assert_allclose(np.asarray(d), td, rtol=1e-3, atol=1e-2)
+
+    def test_extend(self, res, dataset):
+        db, q = dataset
+        params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=5,
+                                      add_data_on_build=False)
+        index = ivf_flat.build(res, params, db)
+        assert index.size == 0
+        index = ivf_flat.extend(res, index, db[:2000],
+                                jnp.arange(2000, dtype=jnp.int32))
+        index = ivf_flat.extend(
+            res, index, db[2000:],
+            jnp.arange(2000, db.shape[0], dtype=jnp.int32))
+        assert index.size == db.shape[0]
+        _, i = ivf_flat.search(res, ivf_flat.SearchParams(n_probes=16),
+                               index, q, 10)
+        _, ti = naive_knn(db, q, 10)
+        assert recall(np.asarray(i), ti) > 0.99
+
+    def test_inner_product(self, res, dataset):
+        db, q = dataset
+        dbn = db / np.linalg.norm(db, axis=1, keepdims=True)
+        qn = q / np.linalg.norm(q, axis=1, keepdims=True)
+        params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=10,
+                                      metric=DistanceType.InnerProduct)
+        index = ivf_flat.build(res, params, dbn)
+        d, i = ivf_flat.search(res, ivf_flat.SearchParams(n_probes=16),
+                               index, qn, 5)
+        ip = qn @ dbn.T
+        ti = np.argsort(-ip, axis=1)[:, :5]
+        assert recall(np.asarray(i), ti) > 0.95
+
+    def test_serialize_roundtrip(self, res, dataset):
+        db, q = dataset
+        params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=5)
+        index = ivf_flat.build(res, params, db)
+        buf = io.BytesIO()
+        ivf_flat.serialize(res, buf, index)
+        buf.seek(0)
+        index2 = ivf_flat.deserialize(res, buf)
+        d1, i1 = ivf_flat.search(res, ivf_flat.SearchParams(n_probes=4),
+                                 index, q, 5)
+        d2, i2 = ivf_flat.search(res, ivf_flat.SearchParams(n_probes=4),
+                                 index2, q, 5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2))
+
+    def test_version_mismatch_fails(self, res, dataset):
+        db, _ = dataset
+        params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=2)
+        index = ivf_flat.build(res, params, db)
+        buf = io.BytesIO()
+        ivf_flat.serialize(res, buf, index)
+        raw = bytearray(buf.getvalue())
+        # corrupt the version scalar payload (after 4-byte magic + 1 len +
+        # dtype str '<i4')
+        raw[8] = 99
+        with pytest.raises(ValueError, match="version"):
+            ivf_flat.deserialize(res, io.BytesIO(bytes(raw)))

@@ -1,0 +1,153 @@
+"""Neighbors layer tests.
+
+Reference test strategy (SURVEY.md §4): random inputs, compare against a naive
+reference implementation (cpp/internal/raft_internal/neighbors/naive_knn.cuh);
+ANN results asserted on recall with a margin
+(cpp/test/neighbors/ann_utils.cuh:125-166 ``eval_neighbours``).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.neighbors import (
+    brute_force,
+    eps_neighbors_l2sq,
+    knn_merge_parts,
+    refine,
+)
+
+
+def naive_knn(db, q, k, metric="sqeuclidean"):
+    """The naive_knn reference oracle (naive_knn.cuh:85), in numpy."""
+    if metric == "inner_product":
+        d = -(q @ db.T)
+    else:
+        d = ((q[:, None, :] - db[None, :, :]) ** 2).sum(-1)
+    idx = np.argsort(d, axis=1, kind="stable")[:, :k]
+    return np.take_along_axis(d, idx, axis=1), idx
+
+
+def recall(found, truth):
+    hits = sum(len(set(f) & set(t)) for f, t in zip(found, truth))
+    return hits / truth.size
+
+
+@pytest.fixture
+def data():
+    rng = np.random.default_rng(42)
+    db = rng.normal(size=(1000, 16)).astype(np.float32)
+    q = rng.normal(size=(50, 16)).astype(np.float32)
+    return db, q
+
+
+class TestBruteForce:
+    def test_exact_l2(self, res, data):
+        db, q = data
+        d, i = brute_force.knn(res, db, q, 10)
+        td, ti = naive_knn(db, q, 10)
+        assert recall(np.asarray(i), ti) > 0.99
+        np.testing.assert_allclose(np.asarray(d), td, rtol=1e-3, atol=1e-3)
+
+    def test_tiled_matches_untiled(self, res, data):
+        db, q = data
+        d1, i1 = brute_force.knn(res, db, q, 8, tile_n=128)
+        d2, i2 = brute_force.knn(res, db, q, 8, tile_n=4096)
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2),
+                                   rtol=1e-4, atol=1e-4)
+        assert recall(np.asarray(i1), np.asarray(i2)) > 0.99
+
+    def test_inner_product(self, res, data):
+        db, q = data
+        d, i = brute_force.knn(res, db, q, 5,
+                               metric=DistanceType.InnerProduct)
+        _, ti = naive_knn(db, q, 5, metric="inner_product")
+        assert recall(np.asarray(i), ti) > 0.99
+        # IP results sorted descending
+        dd = np.asarray(d)
+        assert (np.diff(dd, axis=1) <= 1e-5).all()
+
+    def test_global_id_offset(self, res, data):
+        db, q = data
+        _, i0 = brute_force.knn(res, db, q, 3)
+        _, i1 = brute_force.knn(res, db, q, 3, global_id_offset=1000)
+        np.testing.assert_array_equal(np.asarray(i0) + 1000, np.asarray(i1))
+
+    def test_sqrt_metric(self, res, data):
+        db, q = data
+        d, _ = brute_force.knn(res, db, q, 4,
+                               metric=DistanceType.L2SqrtExpanded)
+        d2, _ = brute_force.knn(res, db, q, 4, metric=DistanceType.L2Expanded)
+        np.testing.assert_allclose(np.asarray(d), np.sqrt(np.asarray(d2)),
+                                   rtol=1e-3, atol=1e-3)
+
+
+class TestMergeParts:
+    def test_merge_equals_full(self, res, data):
+        db, q = data
+        n_parts = 4
+        part = db.shape[0] // n_parts
+        keys, vals = [], []
+        for p in range(n_parts):
+            shard = db[p * part:(p + 1) * part]
+            d, i = brute_force.knn(res, shard, q, 6)
+            keys.append(np.asarray(d))
+            vals.append(np.asarray(i))
+        md, mi = knn_merge_parts(jnp.asarray(np.stack(keys)),
+                                 jnp.asarray(np.stack(vals)),
+                                 n_samples=part)
+        fd, fi = brute_force.knn(res, db, q, 6)
+        np.testing.assert_allclose(np.asarray(md), np.asarray(fd),
+                                   rtol=1e-3, atol=1e-3)
+        assert recall(np.asarray(mi), np.asarray(fi)) > 0.99
+
+    def test_translations(self, res):
+        keys = jnp.asarray([[[0.1, 0.2]], [[0.05, 0.3]]])  # (2 parts, 1q, k=2)
+        vals = jnp.asarray([[[0, 1]], [[0, 1]]])
+        d, i = knn_merge_parts(keys, vals,
+                               translations=jnp.asarray([100, 200]))
+        np.testing.assert_allclose(np.asarray(d[0]), [0.05, 0.1])
+        np.testing.assert_array_equal(np.asarray(i[0]), [200, 100])
+
+
+class TestRefine:
+    def test_refine_improves_candidates(self, res, data):
+        db, q = data
+        # corrupt candidates: true top-30 shuffled
+        _, cand = naive_knn(db, q, 30)
+        rng = np.random.default_rng(0)
+        cand = np.take_along_axis(
+            cand, rng.permuted(np.tile(np.arange(30), (q.shape[0], 1)),
+                               axis=1), axis=1)
+        d, i = refine(res, db, q, jnp.asarray(cand), 10,
+                      metric=DistanceType.L2Expanded)
+        td, ti = naive_knn(db, q, 10)
+        assert recall(np.asarray(i), ti) > 0.99
+        np.testing.assert_allclose(np.asarray(d), td, rtol=1e-3, atol=1e-3)
+
+    def test_refine_masks_invalid(self, res, data):
+        db, q = data
+        _, cand = naive_knn(db, q, 10)
+        cand[:, 5:] = -1  # only 5 valid candidates
+        d, i = refine(res, db, q, jnp.asarray(cand), 5,
+                      metric=DistanceType.L2Expanded)
+        assert (np.asarray(i) >= 0).all()
+
+    def test_refine_inner_product(self, res, data):
+        db, q = data
+        _, cand = naive_knn(db, q, 20, metric="inner_product")
+        d, i = refine(res, db, q, jnp.asarray(cand), 5,
+                      metric=DistanceType.InnerProduct)
+        _, ti = naive_knn(db, q, 5, metric="inner_product")
+        assert recall(np.asarray(i), ti) > 0.99
+
+
+class TestEpsNeighborhood:
+    def test_adjacency(self, res):
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(100, 4)).astype(np.float32)
+        adj, vd = eps_neighbors_l2sq(res, x, x, 1.5)
+        d = ((x[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+        np.testing.assert_array_equal(np.asarray(adj), d < 1.5)
+        np.testing.assert_array_equal(np.asarray(vd), (d < 1.5).sum(1))
