@@ -1,0 +1,156 @@
+"""Single-linkage agglomerative clustering.
+
+Reference: raft/cluster/single_linkage.cuh:112 — pipeline (SURVEY.md §2.7):
+``detail/connectivities.cuh`` (kNN-graph connectivity), ``detail/mst.cuh:194``
+(Boruvka MST + ``connect_components`` fix-up for disconnected kNN graphs),
+``detail/agglomerative.cuh`` (dendrogram build + cluster-cut labeling —
+union-find ON HOST in the reference too).
+
+TPU design: graph + MST run on device (sparse.knn_graph / sparse.mst); the
+final dendrogram labeling is the same O(n α(n)) host union-find the reference
+uses — it is inherently sequential and tiny.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import ensure_array
+from raft_tpu.core.tracing import range as named_range
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.sparse.formats import CooMatrix
+from raft_tpu.sparse.linalg import symmetrize
+from raft_tpu.sparse.neighbors import connect_components, knn_graph
+from raft_tpu.sparse.solver import mst
+
+
+class LinkageDistance:
+    """Reference: single_linkage.cuh ``LinkageDistance`` enum."""
+
+    PAIRWISE = 0
+    KNN_GRAPH = 1
+
+
+@dataclasses.dataclass
+class SingleLinkageOutput:
+    """Reference: single_linkage.cuh ``linkage_output``."""
+
+    labels: np.ndarray          # (n,)
+    dendrogram: np.ndarray      # (n-1, 2) merged children
+    distances: np.ndarray       # (n-1,) merge heights
+    n_clusters: int
+
+
+def _host_union_find_labels(src, dst, w, n, n_clusters
+                            ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Sort MST edges by weight, union in order, stop at n_clusters
+    components (reference: detail/agglomerative.cuh build_dendrogram_host +
+    extract_flattened_clusters)."""
+    order = np.argsort(w, kind="stable")
+    parent = np.arange(n)
+
+    def find(x):
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    dendrogram, heights = [], []
+    merges_needed = n - n_clusters
+    for e in order:
+        if len(dendrogram) >= merges_needed:
+            break
+        a, b = find(int(src[e])), find(int(dst[e]))
+        if a == b:
+            continue
+        parent[max(a, b)] = min(a, b)
+        dendrogram.append((int(src[e]), int(dst[e])))
+        heights.append(float(w[e]))
+    roots = np.asarray([find(i) for i in range(n)])
+    _, labels = np.unique(roots, return_inverse=True)
+    return (labels.astype(np.int32),
+            np.asarray(dendrogram, np.int32).reshape(-1, 2),
+            np.asarray(heights, np.float32))
+
+
+def single_linkage(
+    res,
+    X,
+    *,
+    n_clusters: int,
+    metric: int = DistanceType.L2SqrtExpanded,
+    linkage: int = LinkageDistance.KNN_GRAPH,
+    c: int = 15,
+) -> SingleLinkageOutput:
+    """Single-linkage clustering (reference: single_linkage.cuh:112; ``c``
+    controls kNN-graph degree like the reference's ``c`` neighborhood knob).
+    """
+    with named_range("single_linkage"):
+        X = ensure_array(X, "X")
+        n = X.shape[0]
+        expects(2 <= n_clusters <= n,
+                "single_linkage: need 2 <= n_clusters <= n")
+
+        if linkage == LinkageDistance.KNN_GRAPH:
+            k = min(max(c, 2), n - 1)
+            graph = knn_graph(res, X, k, metric=metric)
+        else:
+            # PAIRWISE: full dense distances as a (dense->coo) graph — the
+            # reference's pairwise connectivity path
+            from raft_tpu.distance.pairwise import pairwise_distance
+            from raft_tpu.sparse.formats import dense_to_coo
+            d = pairwise_distance(X, X, metric)
+            d = d.at[jnp.arange(n), jnp.arange(n)].set(0.0)
+            graph = dense_to_coo(d)
+
+        src, dst, w, color = mst(res, graph)
+        src_h = np.asarray(src)
+        dst_h = np.asarray(dst)
+        w_h = np.asarray(w)
+        valid = src_h >= 0
+        src_h, dst_h, w_h = src_h[valid], dst_h[valid], w_h[valid]
+
+        # fix-up for disconnected kNN graphs (reference: mst.cuh:194
+        # connect_components loop)
+        colors = np.asarray(color)
+        guard = 0
+        while len(np.unique(colors)) > 1 and guard < 32:
+            cc_src, cc_dst, cc_d = connect_components(
+                res, X, jnp.asarray(colors),
+                metric=DistanceType.L2Expanded)
+            cs, cd, cw = (np.asarray(cc_src), np.asarray(cc_dst),
+                          np.asarray(cc_d))
+            ok = cs >= 0
+            src_h = np.concatenate([src_h, cs[ok]])
+            dst_h = np.concatenate([dst_h, cd[ok]])
+            w_h = np.concatenate([w_h, np.sqrt(np.maximum(cw[ok], 0))
+                                  if metric in (DistanceType.L2SqrtExpanded,
+                                                DistanceType.L2SqrtUnexpanded)
+                                  else cw[ok]])
+            # recompute components on host union-find over current edges
+            parent = np.arange(n)
+
+            def find(x):
+                while parent[x] != x:
+                    parent[x] = parent[parent[x]]
+                    x = parent[x]
+                return x
+
+            for a, b in zip(src_h, dst_h):
+                ra, rb = find(int(a)), find(int(b))
+                if ra != rb:
+                    parent[max(ra, rb)] = min(ra, rb)
+            colors = np.asarray([find(i) for i in range(n)])
+            guard += 1
+
+        labels, dendrogram, heights = _host_union_find_labels(
+            src_h, dst_h, w_h, n, n_clusters)
+        return SingleLinkageOutput(labels=labels, dendrogram=dendrogram,
+                                   distances=heights,
+                                   n_clusters=int(labels.max()) + 1)

@@ -1,0 +1,17 @@
+"""Communicator fabric over XLA mesh collectives.
+
+Reference: cpp/include/raft/comms/ + core/comms.hpp (SURVEY.md §2.9) — the
+``comms_t`` interface with NCCL/UCX (``std_comms``) and MPI (``mpi_comms``)
+backends, injected into handles and bootstrapped by raft-dask.
+
+TPU-native: one backend — XLA collectives on a ``jax.sharding.Mesh`` (ICI
+within a slice, DCN across slices); process bootstrap via jax.distributed.
+"""
+
+from raft_tpu.comms.comms import Comms, op_t, status_t  # noqa: F401
+from raft_tpu.comms.session import (  # noqa: F401
+    CommsSession,
+    inject_comms_on_handle,
+    local_handle,
+)
+from raft_tpu.comms import self_test  # noqa: F401

@@ -1,0 +1,94 @@
+"""Comms session bootstrap — the raft-dask ``Comms`` analogue.
+
+Reference: python/raft-dask/raft_dask/common/comms.py:37 ``Comms`` (init
+:170 → NCCL uniqueId rendezvous → per-worker ``_func_init_all`` :424 →
+``inject_comms_on_handle`` storing a ``comms_t`` into each worker's handle),
+``local_handle(sessionId)`` :245 retrieving it inside submitted tasks.
+
+TPU-native: the NCCL rendezvous + Dask orchestration collapse into
+``jax.distributed.initialize`` (multi-host process bootstrap, done once by
+the launcher) + a ``jax.sharding.Mesh`` over the global device set.  A
+session pins (mesh, axis) and injects a :class:`raft_tpu.comms.Comms` into a
+:class:`~raft_tpu.core.resources.DeviceResources` handle, which algorithms
+retrieve via ``handle.get_comms()`` — the same wiring the reference's
+``inject_comms_on_handle`` does.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+
+from raft_tpu.comms.comms import Comms
+from raft_tpu.core.error import expects
+from raft_tpu.core.resources import DeviceResources
+
+_sessions: Dict[str, "CommsSession"] = {}
+
+
+def inject_comms_on_handle(handle: DeviceResources, comms: Comms,
+                           mesh: jax.sharding.Mesh) -> None:
+    """Store a communicator + its mesh in a handle (reference:
+    comms_utils.pyx ``inject_comms_on_handle`` → handle COMMUNICATOR slot)."""
+    handle.set_comms(comms)
+    handle.add_resource_factory("mesh", lambda: mesh)
+
+
+class CommsSession:
+    """Session wiring a mesh + axis to worker handles (reference:
+    raft_dask/common/comms.py:37 ``Comms``)."""
+
+    def __init__(
+        self,
+        devices: Optional[Sequence[jax.Device]] = None,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        axis_name: str = "data",
+    ) -> None:
+        if mesh is None:
+            devs = list(devices) if devices is not None else jax.devices()
+            mesh = jax.sharding.Mesh(np.asarray(devs), (axis_name,))
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.session_id = uuid.uuid4().hex
+        self._initialized = False
+
+    @property
+    def nccl_initialized(self) -> bool:  # API-parity alias
+        return self._initialized
+
+    def init(self) -> "CommsSession":
+        """Register the session (reference: Comms.init :170).  Rendezvous is
+        jax.distributed (done at process start for multi-host); here we
+        validate the mesh and publish the session."""
+        expects(self.axis_name in self.mesh.axis_names,
+                f"axis '{self.axis_name}' not in mesh {self.mesh.axis_names}")
+        _sessions[self.session_id] = self
+        self._initialized = True
+        return self
+
+    def comms(self) -> Comms:
+        size = int(np.prod([self.mesh.shape[a]
+                            for a in (self.axis_name,)]))
+        return Comms(axis_name=self.axis_name, _size=size)
+
+    def worker_handle(self, seed: int = 0) -> DeviceResources:
+        """A handle with comms injected (reference: _func_build_handle :517
+        + inject_comms_on_handle)."""
+        handle = DeviceResources(mesh=self.mesh, seed=seed)
+        inject_comms_on_handle(handle, self.comms(), self.mesh)
+        return handle
+
+    def destroy(self) -> None:
+        """Tear down (reference: Comms.destroy)."""
+        _sessions.pop(self.session_id, None)
+        self._initialized = False
+
+
+def local_handle(session_id: str, seed: int = 0) -> DeviceResources:
+    """Fetch a handle bound to a registered session (reference:
+    raft_dask/common/comms.py:245 ``local_handle``)."""
+    expects(session_id in _sessions, f"no comms session '{session_id}'")
+    return _sessions[session_id].worker_handle(seed=seed)

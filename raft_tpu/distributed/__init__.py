@@ -1,0 +1,10 @@
+"""Distributed (MNMG) algorithms over the comms fabric.
+
+The reference ships only the fabric (SURVEY.md §2.9: "there are no
+distributed algorithms in RAFT itself" — cuML/cuGraph build them on top);
+the BASELINE configs require the algorithms too, so raft_tpu ships
+reference-quality MNMG k-means and kNN natively.
+"""
+
+from raft_tpu.distributed import kmeans  # noqa: F401
+from raft_tpu.distributed import knn  # noqa: F401

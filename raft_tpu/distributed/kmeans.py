@@ -1,0 +1,139 @@
+"""Multi-device (MNMG) k-means.
+
+The reference ships no distributed *algorithms* — it ships the comms fabric
+and pylibraft exposes the per-partition building blocks
+(``compute_new_centroids``, kmeans.pyx:54) that cuML's Dask k-means drives
+with a centroid allreduce per iteration (SURVEY.md §3.3).  BASELINE.md
+config 5 requires the loop itself, so raft_tpu ships it natively.
+
+TPU design: the whole Lloyd loop runs inside ONE jitted shard_map over the
+session mesh — per-shard assignment (fused L2 1-NN) and partial sums, a
+``comms.allreduce`` (psum over ICI) for sums/counts/shift, and the
+convergence test replicated on every shard.  One compilation, zero
+per-iteration host round-trips, collectives ride ICI — this is the pattern
+the reference approximates with NCCL allreduce per Dask task.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.cluster.kmeans import init_plus_plus
+from raft_tpu.cluster.kmeans_types import InitMethod, KMeansParams
+from raft_tpu.comms.comms import Comms, op_t
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import ensure_array
+from raft_tpu.core.tracing import range as named_range
+from raft_tpu.distance.fused_l2_nn import fused_l2_nn
+
+P = jax.sharding.PartitionSpec
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_clusters", "max_iter", "axis_name",
+                                    "mesh"))
+def _dist_lloyd(X, centroids0, tol, n_clusters, max_iter, axis_name, mesh):
+    comms = Comms(axis_name=axis_name)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(axis_name, None), P()),
+                       out_specs=(P(), P(), P()),
+                       check_vma=False)
+    def run(x_shard, c0):
+        def cond(carry):
+            _, it, shift = carry
+            return jnp.logical_and(it < max_iter, shift >= tol)
+
+        def body(carry):
+            c, it, _ = carry
+            d, labels = fused_l2_nn(x_shard, c)
+            part_sums = jax.ops.segment_sum(
+                x_shard.astype(jnp.float32), labels,
+                num_segments=n_clusters)
+            part_counts = jax.ops.segment_sum(
+                jnp.ones(x_shard.shape[0], jnp.float32), labels,
+                num_segments=n_clusters)
+            # the MNMG allreduce step (cuML dask-kmeans pattern, SURVEY §3.3)
+            sums = comms.allreduce(part_sums, op_t.SUM)
+            counts = comms.allreduce(part_counts, op_t.SUM)
+            new_c = jnp.where((counts > 0)[:, None],
+                              sums / jnp.maximum(counts, 1.0)[:, None], c)
+            shift = jnp.sum((new_c - c) ** 2)
+            return new_c, it + 1, shift
+
+        c, n_iter, _ = jax.lax.while_loop(
+            cond, body, (c0.astype(jnp.float32), jnp.int32(0),
+                         jnp.float32(jnp.inf)))
+        d, labels = fused_l2_nn(x_shard, c)
+        inertia = comms.allreduce(jnp.sum(d), op_t.SUM)
+        return c, inertia, n_iter
+
+    return run(X, centroids0)
+
+
+def fit(
+    handle,
+    params: KMeansParams,
+    X,
+    *,
+    centroids: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Distributed k-means fit over the handle's mesh.
+
+    ``handle`` must carry comms (see :class:`raft_tpu.comms.CommsSession`);
+    ``X`` is (n, d) — resharded row-wise over the mesh axis if not already.
+    Returns (centroids, inertia, n_iter), replicated.
+    """
+    with named_range("distributed::kmeans::fit"):
+        expects(handle.comms_initialized(),
+                "distributed.kmeans.fit: handle has no comms (use "
+                "CommsSession.worker_handle())")
+        comms = handle.get_comms()
+        mesh = handle.mesh
+        X = ensure_array(X, "X")
+        n = X.shape[0]
+        n_dev = mesh.shape[comms.axis_name]
+        expects(n % n_dev == 0,
+                f"distributed.kmeans.fit: n ({n}) must divide evenly over "
+                f"{n_dev} devices (pad the input)")
+        X = jax.device_put(
+            X, jax.sharding.NamedSharding(mesh, P(comms.axis_name, None)))
+
+        if params.init == InitMethod.Array:
+            expects(centroids is not None,
+                    "InitMethod.Array requires centroids")
+            c0 = jnp.asarray(centroids)
+        else:
+            # init on a subsample (replicated); ++ on the full set would
+            # need the distributed variant — subsampling matches the
+            # reference's trainset-fraction approach for big-n builds
+            take = min(n, max(params.n_clusters * 64, 16384))
+            c0 = init_plus_plus(handle, X[:take], params.n_clusters,
+                                key=jax.random.key(params.seed))
+        return _dist_lloyd(X, c0, jnp.float32(params.tol),
+                           params.n_clusters, params.max_iter,
+                           comms.axis_name, mesh)
+
+
+def predict(handle, params: KMeansParams, X, centroids) -> jax.Array:
+    """Distributed predict: per-shard nearest centroid (labels gathered)."""
+    comms = handle.get_comms()
+    mesh = handle.mesh
+    X = ensure_array(X, "X")
+    X = jax.device_put(
+        X, jax.sharding.NamedSharding(mesh, P(comms.axis_name, None)))
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(comms.axis_name, None), P()),
+                       out_specs=P(comms.axis_name),
+                       check_vma=False)
+    def run(x_shard, c):
+        _, labels = fused_l2_nn(x_shard, c)
+        return labels
+
+    return jax.jit(run)(X, jnp.asarray(centroids))

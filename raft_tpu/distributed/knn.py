@@ -1,0 +1,100 @@
+"""Multi-device (MNMG) brute-force kNN.
+
+The reference's scale-out seam: shard the database row-wise, per-shard exact
+kNN, then merge with per-part id translations
+(``knn_merge_parts``, neighbors/brute_force.cuh:80 — SURVEY.md §5
+"long-context analogue": shard → local select_k → allgather → merge-select).
+
+TPU design: one shard_map — each device scans only its database shard
+(queries replicated), local top-k, ``all_gather`` of the (k)-sized
+candidates (tiny payload over ICI), merged top-k computed replicated.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import ensure_array
+from raft_tpu.core.tracing import range as named_range
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.matrix.select_k import select_k
+from raft_tpu.utils.precision import get_matmul_precision
+
+P = jax.sharding.PartitionSpec
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric", "axis_name",
+                                             "mesh"))
+def _dist_knn(db, queries, k, metric, axis_name, mesh):
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(axis_name, None), P()),
+                       out_specs=(P(), P()),
+                       check_vma=False)
+    def run(db_shard, q):
+        n_local = db_shard.shape[0]
+        qf = q.astype(jnp.float32)
+        dbf = db_shard.astype(jnp.float32)
+        ip = jax.lax.dot_general(qf, dbf, (((1,), (1,)), ((), ())),
+                                 precision=get_matmul_precision(),
+                                 preferred_element_type=jnp.float32)
+        if metric == DistanceType.InnerProduct:
+            d = ip
+            select_min = False
+        else:
+            qsq = jnp.sum(qf * qf, axis=1)
+            dsq = jnp.sum(dbf * dbf, axis=1)
+            d = jnp.maximum(qsq[:, None] + dsq[None, :] - 2.0 * ip, 0.0)
+            select_min = True
+        kk = min(k, n_local)
+        ld, li = select_k(d, kk, select_min=select_min)
+        # translate to global ids (knn_merge_parts' translations)
+        li = li + jax.lax.axis_index(axis_name) * n_local
+        all_d = jax.lax.all_gather(ld, axis_name)   # (n_dev, q, kk)
+        all_i = jax.lax.all_gather(li, axis_name)
+        nq = q.shape[0]
+        md, mi = select_k(
+            jnp.transpose(all_d, (1, 0, 2)).reshape(nq, -1), k,
+            in_idx=jnp.transpose(all_i, (1, 0, 2)).reshape(nq, -1),
+            select_min=select_min)
+        if metric in (DistanceType.L2SqrtExpanded,
+                      DistanceType.L2SqrtUnexpanded):
+            md = jnp.sqrt(jnp.maximum(md, 0.0))
+        return md, mi
+
+    return run(db, queries)
+
+
+def knn(
+    handle,
+    database,
+    queries,
+    k: int,
+    *,
+    metric: int = DistanceType.L2Expanded,
+) -> Tuple[jax.Array, jax.Array]:
+    """Sharded exact kNN over the handle's mesh; returns replicated
+    (distances, global indices) of shape (q, k)."""
+    with named_range("distributed::knn"):
+        expects(handle.comms_initialized(),
+                "distributed.knn: handle has no comms (use "
+                "CommsSession.worker_handle())")
+        comms = handle.get_comms()
+        mesh = handle.mesh
+        database = ensure_array(database, "database")
+        queries = ensure_array(queries, "queries")
+        n = database.shape[0]
+        n_dev = mesh.shape[comms.axis_name]
+        expects(n % n_dev == 0,
+                f"distributed.knn: n ({n}) must divide evenly over "
+                f"{n_dev} devices (pad the input)")
+        expects(k <= n // n_dev,
+                "distributed.knn: k must be <= rows per shard")
+        database = jax.device_put(
+            database,
+            jax.sharding.NamedSharding(mesh, P(comms.axis_name, None)))
+        return _dist_knn(database, queries, k, metric, comms.axis_name, mesh)

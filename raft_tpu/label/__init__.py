@@ -1,0 +1,13 @@
+"""Label utilities.
+
+Reference: cpp/include/raft/label/ (~551 LoC, SURVEY.md §2.8) —
+``classlabels.cuh`` (getUniquelabels / make_monotonic) and
+``merge_labels.cuh`` (union of labelings via label propagation, used by
+connected components).
+"""
+
+from raft_tpu.label.classlabels import (  # noqa: F401
+    get_unique_labels,
+    make_monotonic,
+)
+from raft_tpu.label.merge_labels import merge_labels  # noqa: F401

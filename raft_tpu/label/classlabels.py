@@ -1,0 +1,46 @@
+"""Class-label utilities.
+
+Reference: raft/label/classlabels.cuh — ``getUniquelabels`` (sorted distinct
+labels) and ``make_monotonic`` (remap arbitrary labels to 0..k-1 in sorted
+order).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.mdarray import ensure_array
+
+
+def get_unique_labels(labels, *, max_labels: int = 0
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Sorted distinct labels (reference: getUniquelabels).
+
+    XLA needs a static output size: returns ``(unique (m,), count)`` where
+    ``m = max_labels or n``; slots past ``count`` repeat the largest label.
+    """
+    labels = ensure_array(labels, "labels")
+    n = labels.shape[0]
+    m = max_labels or n
+    s = jnp.sort(labels)
+    first = jnp.concatenate([jnp.ones(1, jnp.bool_), s[1:] != s[:-1]])
+    count = jnp.sum(first.astype(jnp.int32))
+    # compact the firsts to the front (stable, preserving sorted order)
+    order = jnp.argsort(~first, stable=True)
+    uniq = s[order][:m]
+    return uniq, count
+
+
+def make_monotonic(labels, *, max_labels: int = 0,
+                   zero_based: bool = True) -> jax.Array:
+    """Remap labels to dense 0..k-1 (1..k when not zero_based, matching the
+    reference's default) in sorted-label order (reference: make_monotonic)."""
+    labels = ensure_array(labels, "labels")
+    uniq, _ = get_unique_labels(labels, max_labels=max_labels)
+    # padding repeats the largest label; searchsorted-left still lands every
+    # label on its first (correct) slot
+    idx = jnp.searchsorted(uniq, labels, side="left").astype(jnp.int32)
+    return idx if zero_based else idx + 1

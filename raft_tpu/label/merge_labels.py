@@ -1,0 +1,46 @@
+"""Merge two labelings (union over a mask).
+
+Reference: raft/label/merge_labels.cuh ``merge_labels`` — given labels_a and
+labels_b plus a core-point mask, iteratively propagates the minimum label
+across rows where both labelings connect them (the connected-components
+union step in cuML's DBSCAN).  The reference loops a min-propagation kernel
+to fixpoint; here it's a jitted ``lax.while_loop``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.mdarray import ensure_array
+
+
+def merge_labels(labels_a, labels_b, mask) -> jax.Array:
+    """Union-merge: rows sharing a label in EITHER labeling (restricted to
+    ``mask``) end up with the same (minimum) label of their merged group.
+    Shapes: all (n,); returns int32 (n,).
+    """
+    a = ensure_array(labels_a, "labels_a").astype(jnp.int32)
+    b = ensure_array(labels_b, "labels_b").astype(jnp.int32)
+    m = ensure_array(mask, "mask").astype(jnp.bool_)
+    n = a.shape[0]
+
+    def min_over_groups(vals, groups):
+        """For each row: min of vals over rows sharing its group id."""
+        gmin = jax.ops.segment_min(jnp.where(m, vals, jnp.int32(n)),
+                                   groups, num_segments=n)
+        return jnp.where(m, jnp.minimum(vals, gmin[groups]), vals)
+
+    def cond(state):
+        cur, prev = state
+        return jnp.any(cur != prev)
+
+    def body(state):
+        cur, _ = state
+        nxt = min_over_groups(cur, a)
+        nxt = min_over_groups(nxt, b)
+        return nxt, cur
+
+    init = jnp.where(m, a, a)  # start from labels_a
+    out, _ = jax.lax.while_loop(cond, body, (init, init - 1))
+    return out

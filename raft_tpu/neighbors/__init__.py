@@ -6,6 +6,8 @@ epsilon neighborhood, and versioned index serialization.
 """
 
 from raft_tpu.neighbors import brute_force  # noqa: F401
+from raft_tpu.neighbors import ivf_flat  # noqa: F401
+from raft_tpu.neighbors import ivf_pq  # noqa: F401
 from raft_tpu.neighbors.brute_force import knn, knn_merge_parts  # noqa: F401
 from raft_tpu.neighbors.refine import refine  # noqa: F401
 from raft_tpu.neighbors.epsilon_neighborhood import (  # noqa: F401

@@ -1,0 +1,390 @@
+"""CAGRA: graph-based ANN — build a kNN graph, prune it to a fixed-degree
+search graph, answer queries by greedy graph walk.
+
+Reference: raft/neighbors/cagra.cuh:77 ``build_knn_graph``, :109 ``prune``
+(renamed ``optimize`` upstream), :205 ``search``; types cagra_types.hpp:41,55,
+114.  Build: detail/cagra/cagra_build.cuh:43 (ivf_pq::build :91 + batched
+search with gpu_top_k = 2×degree :104-160, then ``refine_host`` exact re-rank
+:171).  Prune: detail/cagra/graph_core.cuh:415 (rank-based edge pruning +
+reverse-edge addition).  Search: detail/cagra/factory.cuh dispatching
+single-cta / multi-cta / multi-kernel greedy-walk kernels with a bitonic
+top-M buffer and a hashmap visited set.
+
+TPU design (SURVEY.md §7 flags this as the XLA-hostile one):
+
+- **build** composes the existing IVF-PQ + refine exactly like the reference;
+- **prune** keeps the reference's *rank-based detour* criterion in vectorized
+  form: edge (i→j) is detourable if some higher-ranked neighbor k of i has j
+  among ITS higher-ranked neighbors (a 2-hop path of strictly stronger
+  edges).  One batched membership test per node block — no host loops.
+  Reverse edges then fill remaining degree slots (graph_core.cuh's
+  reverse-edge pass);
+- **search** replaces the data-dependent walk + hashmap with a
+  fixed-iteration ``lax.while_loop`` over a static (q, itopk) candidate
+  buffer: each step expands the best unvisited candidates' adjacency rows
+  (one gather + one MXU distance block), suppresses duplicates by masked
+  membership test against the buffer (the visited-hashmap analogue), and
+  re-selects top-itopk.  Termination: all buffered candidates visited, or
+  max_iterations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import BinaryIO, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core import serialize as ser
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import ensure_array
+from raft_tpu.core.tracing import range as named_range
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.matrix.select_k import merge_topk, select_k
+from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
+from raft_tpu.neighbors.refine import refine
+from raft_tpu.utils.precision import get_matmul_precision
+
+
+@dataclasses.dataclass
+class IndexParams:
+    """Reference: cagra_types.hpp:41 ``index_params``."""
+
+    intermediate_graph_degree: int = 128
+    graph_degree: int = 64
+    metric: int = DistanceType.L2Expanded
+    build_pq_bits: int = 8
+    build_pq_dim: int = 0
+    build_n_lists: int = 0        # 0 -> auto sqrt(n)-scaled
+    build_n_probes: int = 32
+    build_refine_rate: float = 2.0
+
+
+@dataclasses.dataclass
+class SearchParams:
+    """Reference: cagra_types.hpp:55 ``search_params`` (itopk_size,
+    search_width, max_iterations)."""
+
+    max_iterations: int = 0       # 0 -> auto
+    itopk_size: int = 64
+    search_width: int = 1
+    num_random_samplings: int = 1
+    rand_xor_mask: int = 0x128394
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Index:
+    """Reference: cagra_types.hpp:114 ``index`` — dataset + fixed-degree
+    graph (row i holds the neighbor ids of node i)."""
+
+    dataset: jax.Array            # (n, dim)
+    graph: jax.Array              # (n, graph_degree) int32
+    metric: int = DistanceType.L2Expanded
+
+    @property
+    def size(self) -> int:
+        return self.dataset.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.dataset.shape[1]
+
+    @property
+    def graph_degree(self) -> int:
+        return self.graph.shape[1]
+
+    def tree_flatten(self):
+        return (self.dataset, self.graph), (self.metric,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves, metric=aux[0])
+
+
+# ---------------------------------------------------------------------------
+# build
+# ---------------------------------------------------------------------------
+
+def build_knn_graph(
+    res,
+    dataset,
+    intermediate_degree: int,
+    *,
+    params: Optional[IndexParams] = None,
+    batch: int = 2048,
+) -> jax.Array:
+    """All-nodes kNN graph via IVF-PQ + exact refine
+    (reference: cagra.cuh:77 → cagra_build.cuh:43-171).
+    Returns (n, intermediate_degree) int32 (self-edges removed).
+    """
+    with named_range("cagra::build_knn_graph"):
+        dataset = ensure_array(dataset, "dataset")
+        n, dim = dataset.shape
+        p = params or IndexParams()
+        n_lists = p.build_n_lists or max(min(n // 64, 4 * int(np.sqrt(n))), 8)
+        pq_params = ivf_pq_mod.IndexParams(
+            n_lists=n_lists, metric=p.metric, pq_bits=p.build_pq_bits,
+            pq_dim=p.build_pq_dim, kmeans_n_iters=10)
+        pq_index = ivf_pq_mod.build(res, pq_params, dataset)
+        sp = ivf_pq_mod.SearchParams(n_probes=min(p.build_n_probes, n_lists))
+
+        # gpu_top_k = refine_rate × degree oversampling, +1 for self hit
+        top_k = min(int(p.build_refine_rate * intermediate_degree) + 1, n)
+        rows = []
+        for start in range(0, n, batch):
+            q = dataset[start:start + batch]
+            _, cand = ivf_pq_mod.search(res, sp, pq_index, q, top_k)
+            _, idx = refine(res, dataset, q, cand,
+                            min(intermediate_degree + 1, top_k),
+                            metric=DistanceType.L2Expanded
+                            if p.metric != DistanceType.InnerProduct
+                            else p.metric)
+            rows.append(idx)
+        knn = jnp.concatenate(rows, axis=0)           # (n, deg+1)
+
+        # drop self-edges: shift left where the first column is the node
+        ids = jnp.arange(n, dtype=knn.dtype)[:, None]
+        is_self = knn == ids
+        # stable partition: non-self first
+        order = jnp.argsort(is_self, axis=1, stable=True)
+        knn = jnp.take_along_axis(knn, order, axis=1)
+        return knn[:, :intermediate_degree].astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("graph_degree",))
+def _prune_impl(knn_graph, graph_degree):
+    """Rank-based detour pruning (graph_core.cuh:415 ``prune``).
+
+    Edge i→knn[i,r] is *detourable* when ∃ r' < r with knn[i,r'] = k and
+    knn[i,r] ∈ knn[k, :r''] for small r'' — i.e. a 2-hop path through a
+    stronger edge on both hops.  We count, for each edge (i, r), how many
+    higher-ranked neighbors k of i contain j in their own top ranks; edges
+    with the fewest detours win the degree slots (ties → lower rank wins,
+    preserving the reference's rank ordering).
+    """
+    n, deg = knn_graph.shape
+    # detour_count[i, r] = #{r' < r : j_r ∈ knn[knn[i, r'], :]}
+    neigh_of_neigh = knn_graph[knn_graph]            # (n, deg, deg)
+    j = knn_graph[:, :, None, None]                  # (n, deg, 1, 1)
+    # membership of j_r in the lists of i's stronger neighbors:
+    hit = (neigh_of_neigh[:, None, :, :] == j)       # (n, deg_r, deg_r', deg)
+    rank = jnp.arange(deg)
+    stronger = rank[None, :, None] > rank[None, None, :]  # r > r'
+    detours = jnp.sum(jnp.any(hit, axis=-1) & stronger[..., :],
+                      axis=-1)                       # (n, deg)
+    # order edges by (detour_count, original rank)
+    score = detours * deg + rank[None, :]
+    order = jnp.argsort(score, axis=1)
+    pruned = jnp.take_along_axis(knn_graph, order[:, :graph_degree], axis=1)
+    return pruned
+
+
+def prune(res, knn_graph, graph_degree: int) -> jax.Array:
+    """Prune an intermediate kNN graph to ``graph_degree`` with detour
+    counting + reverse-edge fill (reference: cagra.cuh:109 ``prune``,
+    graph_core.cuh:415)."""
+    with named_range("cagra::prune"):
+        knn_graph = ensure_array(knn_graph, "knn_graph")
+        n, deg = knn_graph.shape
+        expects(graph_degree <= deg,
+                "cagra.prune: graph_degree > intermediate degree")
+        forward = _prune_impl(knn_graph, max(graph_degree // 2, 1)
+                              if graph_degree < deg else graph_degree)
+        if forward.shape[1] == graph_degree:
+            return forward
+        # reverse-edge pass (graph_core.cuh rev_graph): nodes pointed *at*
+        # point back, filling the remaining slots
+        half = forward.shape[1]
+        rev_lists = np.full((n, graph_degree - half), -1, np.int32)
+        rev_count = np.zeros(n, np.int32)
+        fwd = np.asarray(forward)
+        for i in range(n):
+            for j in fwd[i]:
+                if 0 <= j < n and rev_count[j] < rev_lists.shape[1]:
+                    rev_lists[j, rev_count[j]] = i
+                    rev_count[j] += 1
+        out = np.concatenate([fwd, rev_lists], axis=1)
+        # fill any -1 slots with wrap-around of forward edges
+        for i in range(n):
+            fill = fwd[i, 0]
+            out[i][out[i] < 0] = fill
+        return jnp.asarray(out, jnp.int32)
+
+
+def build(res, params: IndexParams, dataset) -> Index:
+    """Full CAGRA build (reference: cagra.cuh ``build`` = build_knn_graph +
+    prune)."""
+    dataset = ensure_array(dataset, "dataset")
+    knn = build_knn_graph(res, dataset, params.intermediate_graph_degree,
+                          params=params)
+    graph = prune(res, knn, params.graph_degree)
+    return Index(dataset=dataset, graph=graph, metric=params.metric)
+
+
+# ---------------------------------------------------------------------------
+# search
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=(
+    "k", "itopk", "search_width", "max_iterations", "metric"))
+def _search_impl(dataset, graph, queries, seed_ids, k, itopk, search_width,
+                 max_iterations, metric):
+    nq = queries.shape[0]
+    n, dim = dataset.shape
+    degree = graph.shape[1]
+    qf = queries.astype(jnp.float32)
+    ip_metric = metric == DistanceType.InnerProduct
+    worst = -jnp.inf if ip_metric else jnp.inf
+
+    def dists_to(ids):
+        """(q, m) ids -> (q, m) distances to the query."""
+        vecs = dataset[ids].astype(jnp.float32)       # (q, m, d)
+        ip = jnp.einsum("qd,qmd->qm", qf, vecs,
+                        precision=get_matmul_precision())
+        if ip_metric:
+            return ip
+        sq = jnp.sum(vecs * vecs, axis=-1)
+        qsq = jnp.sum(qf * qf, axis=-1, keepdims=True)
+        return jnp.maximum(qsq + sq - 2.0 * ip, 0.0)
+
+    # ---- init buffer: best itopk of the random probe set -----------------
+    # (the reference's random-sampling buffer fill: probing more random
+    # candidates than itopk prevents the greedy walk from starting in the
+    # wrong region and never escaping — cluster-structured data needs it)
+    seed_d = dists_to(seed_ids)
+    # dedupe random draws: a node sampled twice would occupy two buffer slots
+    sorted_seeds = jnp.sort(seed_ids, axis=1)
+    dup_sorted = jnp.concatenate(
+        [jnp.zeros((nq, 1), jnp.bool_),
+         sorted_seeds[:, 1:] == sorted_seeds[:, :-1]], axis=1)
+    rank = jnp.argsort(jnp.argsort(seed_ids, axis=1), axis=1)
+    seed_dup = jnp.take_along_axis(dup_sorted, rank, axis=1)
+    seed_d = jnp.where(seed_dup, worst, seed_d)
+    if ip_metric:
+        buf_d, pos = jax.lax.top_k(seed_d, itopk)
+    else:
+        buf_d, pos = jax.lax.top_k(-seed_d, itopk)
+        buf_d = -buf_d
+    buf_i = jnp.take_along_axis(seed_ids, pos, axis=1)
+    buf_i = jnp.where(jnp.isinf(buf_d), -1, buf_i)
+    visited = jnp.zeros((nq, itopk), jnp.bool_)
+
+    def cond(state):
+        _, _, visited, it = state
+        return jnp.logical_and(it < max_iterations,
+                               jnp.logical_not(jnp.all(visited)))
+
+    def body(state):
+        buf_d, buf_i, visited, it = state
+        # pick the search_width best unvisited candidates
+        masked = jnp.where(visited | (buf_i < 0), worst, buf_d)
+        if ip_metric:
+            _, sel = jax.lax.top_k(masked, search_width)
+        else:
+            _, sel = jax.lax.top_k(-masked, search_width)
+        sel_ids = jnp.take_along_axis(buf_i, sel, axis=1)  # (q, w)
+        visited = visited.at[jnp.arange(nq)[:, None], sel].set(True)
+
+        # expand adjacency of selected nodes
+        nbrs = graph[jnp.where(sel_ids >= 0, sel_ids, 0)]  # (q, w, degree)
+        nbrs = nbrs.reshape(nq, search_width * degree)
+        nbrs = jnp.where(jnp.repeat(sel_ids >= 0, degree, axis=1), nbrs, -1)
+        nd = dists_to(jnp.where(nbrs >= 0, nbrs, 0))
+        nd = jnp.where(nbrs < 0, worst, nd)
+
+        cat_d = jnp.concatenate([buf_d, nd], axis=1)
+        cat_i = jnp.concatenate([buf_i, nbrs], axis=1)
+        cat_v = jnp.concatenate(
+            [visited, jnp.zeros_like(nd, jnp.bool_)], axis=1)
+
+        # duplicate suppression (the hashmap visited-set analogue): the same
+        # node may appear in the buffer AND in several expansions; keep one
+        # copy per id — sort by distance (stable), then by id (stable): the
+        # first slot of each id-group is its best copy, and for equal
+        # distances the buffer copy (with its visited flag) wins.
+        sort_d = -cat_d if ip_metric else cat_d
+        ord_d = jnp.argsort(sort_d, axis=1, stable=True)
+        i1 = jnp.take_along_axis(cat_i, ord_d, axis=1)
+        d1 = jnp.take_along_axis(cat_d, ord_d, axis=1)
+        v1 = jnp.take_along_axis(cat_v, ord_d, axis=1)
+        ord_i = jnp.argsort(i1, axis=1, stable=True)
+        i2 = jnp.take_along_axis(i1, ord_i, axis=1)
+        d2 = jnp.take_along_axis(d1, ord_i, axis=1)
+        v2 = jnp.take_along_axis(v1, ord_i, axis=1)
+        dup = jnp.concatenate(
+            [jnp.zeros((nq, 1), jnp.bool_), i2[:, 1:] == i2[:, :-1]], axis=1)
+        d2 = jnp.where(dup, worst, d2)
+        i2 = jnp.where(dup, -1, i2)
+
+        if ip_metric:
+            new_d, pos = jax.lax.top_k(d2, itopk)
+        else:
+            new_d, pos = jax.lax.top_k(-d2, itopk)
+            new_d = -new_d
+        new_i = jnp.take_along_axis(i2, pos, axis=1)
+        new_v = jnp.take_along_axis(v2, pos, axis=1)
+        return new_d, new_i, new_v, it + 1
+
+    buf_d, buf_i, visited, _ = jax.lax.while_loop(
+        cond, body, (buf_d, buf_i, visited, jnp.int32(0)))
+
+    out_d, pos = (jax.lax.top_k(buf_d, k) if ip_metric
+                  else (lambda v, p: (-v, p))(*jax.lax.top_k(-buf_d, k)))
+    out_i = jnp.take_along_axis(buf_i, pos, axis=1)
+    if metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded):
+        out_d = jnp.sqrt(jnp.maximum(out_d, 0.0))
+    return out_d, out_i
+
+
+def search(res, params: SearchParams, index: Index, queries, k: int
+           ) -> Tuple[jax.Array, jax.Array]:
+    """Greedy graph-walk search (reference: cagra.cuh:205)."""
+    with named_range("cagra::search"):
+        queries = ensure_array(queries, "queries")
+        expects(queries.ndim == 2 and queries.shape[1] == index.dim,
+                "cagra.search: query dim mismatch")
+        itopk = max(params.itopk_size, k)
+        # probe 4×itopk random nodes (min 128) and keep the best itopk —
+        # the reference's random-sampling buffer init scaled the same way
+        n_seeds = max(itopk,
+                      min(index.size,
+                          max(params.num_random_samplings * 4 * itopk, 128)))
+        key = res.next_key()
+        seed_ids = jax.random.randint(
+            key, (queries.shape[0], n_seeds), 0, index.size,
+            dtype=jnp.int32)
+        max_iter = params.max_iterations or (
+            10 + itopk // max(params.search_width, 1))
+        return _search_impl(index.dataset, index.graph, queries, seed_ids,
+                            k, itopk, params.search_width, max_iter,
+                            index.metric)
+
+
+# ---------------------------------------------------------------------------
+# serialization (reference: cagra_serialize.cuh)
+# ---------------------------------------------------------------------------
+
+_SERIALIZATION_VERSION = 1
+
+
+def serialize(res, stream: BinaryIO, index: Index) -> None:
+    ser.serialize_scalar(res, stream, np.int32(_SERIALIZATION_VERSION))
+    ser.serialize_scalar(res, stream, np.int32(index.metric))
+    ser.serialize_mdspan(res, stream, index.dataset)
+    ser.serialize_mdspan(res, stream, index.graph)
+
+
+def deserialize(res, stream: BinaryIO) -> Index:
+    version = int(ser.deserialize_scalar(res, stream))
+    if version != _SERIALIZATION_VERSION:
+        raise ValueError(
+            f"cagra serialization version mismatch: got {version}, "
+            f"expected {_SERIALIZATION_VERSION}")
+    metric = int(ser.deserialize_scalar(res, stream))
+    dataset = jnp.asarray(ser.deserialize_mdspan(res, stream))
+    graph = jnp.asarray(ser.deserialize_mdspan(res, stream))
+    return Index(dataset=dataset, graph=graph, metric=metric)

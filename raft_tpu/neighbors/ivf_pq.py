@@ -1,0 +1,476 @@
+"""IVF-PQ: inverted-file index with product-quantized residuals — the
+performance flagship (BASELINE.md north-star workload).
+
+Reference: raft/neighbors/ivf_pq.cuh:224 ``build``, :266 ``extend``, :342
+``search``; params/types ivf_pq_types.hpp:48 (index_params: pq_bits, pq_dim,
+codebook_kind, force_random_rotation), :110 (search_params: n_probes,
+lut_dtype, internal_distance_dtype), :264 (index).  Build internals:
+detail/ivf_pq_build.cuh:337 ``train_per_subset``, :417 ``train_per_cluster``
+(both via kmeans_balanced), :944 ``process_and_fill_codes_kernel``; search:
+detail/ivf_pq_search.cuh:133 ``select_clusters``, :611
+``compute_similarity_kernel`` (shared-memory LUT), :373
+``postprocess_neighbors``; code packing detail/ivf_pq_codepacking.cuh.
+
+TPU design:
+
+- **codebook training** is a ``vmap`` of the balanced-k-means loop over the
+  ``pq_dim`` subspaces — one compilation, all books trained in parallel on
+  the MXU (the reference loops build_clusters per subspace);
+- **encoding** is a single batched argmin over (n, pq_dim, book) distances —
+  the ``process_and_fill_codes`` analogue is the same scatter used by
+  IVF-Flat's list packer (static-shape padded lists, SURVEY.md §7);
+- **search** scans probed lists like IVF-Flat, but each step builds the
+  per-(query, probe) look-up table on the fly — an einsum against the
+  codebooks (MXU) — then accumulates code distances with a
+  ``take_along_axis`` gather over the book axis (VPU).  The LUT never leaves
+  VMEM-scale shapes: (q_tile, pq_dim, 2^pq_bits).  ``lut_dtype=bf16``
+  halves LUT bandwidth, mirroring the reference's fp8/half LutT option
+  (ivf_pq_search.cuh:70).
+- the optional **random rotation** (force_random_rotation /
+  dim-padding rotation in the reference) is a fixed orthonormal matrix from
+  QR of a seeded normal draw, applied before subspace splitting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import BinaryIO, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.cluster.kmeans_types import KMeansBalancedParams
+from raft_tpu.core import serialize as ser
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import ensure_array
+from raft_tpu.core.tracing import range as named_range
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.matrix.select_k import merge_topk, select_k
+from raft_tpu.neighbors.ivf_flat import _pack_lists, _round_up, _LIST_ALIGN
+from raft_tpu.utils.precision import get_matmul_precision
+
+
+class CodebookKind:
+    """Reference: ivf_pq_types.hpp ``codebook_gen`` enum."""
+
+    PER_SUBSPACE = 0
+    PER_CLUSTER = 1
+
+
+@dataclasses.dataclass
+class IndexParams:
+    """Reference: ivf_pq_types.hpp:48 ``index_params``."""
+
+    n_lists: int = 1024
+    metric: int = DistanceType.L2Expanded
+    kmeans_n_iters: int = 20
+    kmeans_trainset_fraction: float = 0.5
+    pq_bits: int = 8          # 4..8 supported in the reference
+    pq_dim: int = 0           # 0 -> auto: dim/4 rounded (reference heuristic)
+    codebook_kind: int = CodebookKind.PER_SUBSPACE
+    force_random_rotation: bool = False
+    add_data_on_build: bool = True
+
+
+@dataclasses.dataclass
+class SearchParams:
+    """Reference: ivf_pq_types.hpp:110 ``search_params``."""
+
+    n_probes: int = 20
+    lut_dtype: object = jnp.float32         # fp32 | bf16 (fp8 analogue)
+    internal_distance_dtype: object = jnp.float32
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Index:
+    """Reference: ivf_pq_types.hpp:264 ``index``.
+
+    ``codebooks``: PER_SUBSPACE (pq_dim, book, pq_len);
+                   PER_CLUSTER (n_lists, book, pq_len).
+    ``list_codes``: (n_lists, capacity, pq_dim) uint8 PQ codes;
+    ``rotation``: (dim, rot_dim) orthonormal (identity when not rotated).
+    """
+
+    centers: jax.Array
+    codebooks: jax.Array
+    list_codes: jax.Array
+    list_indices: jax.Array
+    list_sizes: jax.Array
+    rotation: jax.Array
+    metric: int = DistanceType.L2Expanded
+    codebook_kind: int = CodebookKind.PER_SUBSPACE
+    pq_bits: int = 8
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.rotation.shape[0]
+
+    @property
+    def rot_dim(self) -> int:
+        return self.rotation.shape[1]
+
+    @property
+    def pq_dim(self) -> int:
+        return self.list_codes.shape[2]
+
+    @property
+    def pq_len(self) -> int:
+        return self.codebooks.shape[2]
+
+    @property
+    def pq_book_size(self) -> int:
+        return 1 << self.pq_bits
+
+    @property
+    def capacity(self) -> int:
+        return self.list_codes.shape[1]
+
+    @property
+    def size(self) -> int:
+        return int(jnp.sum(self.list_sizes))
+
+    def tree_flatten(self):
+        leaves = (self.centers, self.codebooks, self.list_codes,
+                  self.list_indices, self.list_sizes, self.rotation)
+        return leaves, (self.metric, self.codebook_kind, self.pq_bits)
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves, metric=aux[0], codebook_kind=aux[1],
+                   pq_bits=aux[2])
+
+
+# ---------------------------------------------------------------------------
+# build
+# ---------------------------------------------------------------------------
+
+def _make_rotation(dim: int, rot_dim: int, random: bool, seed: int
+                   ) -> jax.Array:
+    """Orthonormal (dim, rot_dim) transform.  The reference composes
+    dim-padding + optional random rotation (ivf_pq_build.cuh rotation matrix);
+    identity-pad when not random."""
+    if not random and dim == rot_dim:
+        return jnp.eye(dim, dtype=jnp.float32)
+    key = jax.random.key(seed)
+    g = jax.random.normal(key, (dim, rot_dim), jnp.float32) if dim >= rot_dim \
+        else jax.random.normal(key, (rot_dim, dim), jnp.float32).T
+    q, _ = jnp.linalg.qr(jnp.pad(g, ((0, max(0, rot_dim - dim)), (0, 0))))
+    return q[:dim, :rot_dim]
+
+
+def _subspace_split(x: jax.Array, pq_dim: int) -> jax.Array:
+    """(n, rot_dim) -> (n, pq_dim, pq_len)."""
+    n, rd = x.shape
+    return x.reshape(n, pq_dim, rd // pq_dim)
+
+
+@functools.partial(jax.jit, static_argnames=("book_size", "n_iters"))
+def _train_books_per_subspace(resid_sub, keys, book_size, n_iters):
+    """vmap balanced k-means over subspaces.
+
+    resid_sub: (pq_dim, n, pq_len) -> codebooks (pq_dim, book, pq_len).
+    Reference: train_per_subset (ivf_pq_build.cuh:337) loops
+    build_clusters per subspace; here one vmapped compilation.
+    """
+    def one(sub, key):
+        n = sub.shape[0]
+        stride = max(n // book_size, 1)
+        c0 = sub[::stride][:book_size]
+        c0 = jnp.pad(c0, ((0, book_size - c0.shape[0]), (0, 0)), mode="edge")
+        centers, _ = kmeans_balanced._balanced_loop(
+            sub, c0, key, book_size, n_iters, DistanceType.L2Expanded)
+        return centers
+
+    return jax.vmap(one)(resid_sub, keys)
+
+
+def _encode(codebooks, resid, codebook_kind, labels=None):
+    """PQ-encode residuals (n, pq_dim, pq_len) -> (n, pq_dim) uint8.
+
+    Reference: process_and_fill_codes_kernel (ivf_pq_build.cuh:944) — the
+    per-subspace argmin over the codebook.
+    """
+    if codebook_kind == CodebookKind.PER_SUBSPACE:
+        # d[n, j, k] = ||resid[n,j,:] - cb[j,k,:]||^2; argmin over k
+        ip = jnp.einsum("njl,jkl->njk", resid, codebooks,
+                        precision=get_matmul_precision())
+        cb_sq = jnp.sum(codebooks * codebooks, axis=-1)  # (j, k)
+        d = cb_sq[None, :, :] - 2.0 * ip
+    else:
+        cb = codebooks[labels]                            # (n, book, pq_len)
+        ip = jnp.einsum("njl,nkl->njk", resid, cb,
+                        precision=get_matmul_precision())
+        cb_sq = jnp.sum(cb * cb, axis=-1)                 # (n, k)
+        d = cb_sq[:, None, :] - 2.0 * ip
+    return jnp.argmin(d, axis=-1).astype(jnp.uint8)
+
+
+def build(res, params: IndexParams, dataset) -> Index:
+    """Build an IVF-PQ index (reference: ivf_pq.cuh:224)."""
+    with named_range("ivf_pq::build"):
+        dataset = ensure_array(dataset, "dataset")
+        expects(dataset.ndim == 2, "ivf_pq.build: 2-D dataset required")
+        n, dim = dataset.shape
+        expects(params.n_lists <= n, "ivf_pq.build: n_lists > n_rows")
+        expects(4 <= params.pq_bits <= 8,
+                "ivf_pq.build: pq_bits in [4, 8] (as the reference)")
+
+        pq_dim = params.pq_dim or max(dim // 4, 1)
+        rot_dim = _round_up(dim, pq_dim)
+        rotation = _make_rotation(dim, rot_dim,
+                                  params.force_random_rotation or
+                                  rot_dim != dim, seed=7)
+
+        # ---- coarse quantizer (rotated space) --------------------------
+        n_train = max(params.n_lists,
+                      int(n * params.kmeans_trainset_fraction))
+        if n_train < n:
+            sel = jax.random.choice(res.next_key(), n, (n_train,),
+                                    replace=False)
+            trainset = dataset[sel]
+        else:
+            trainset = dataset
+        train_rot = trainset.astype(jnp.float32) @ rotation
+        bal = KMeansBalancedParams(n_iters=params.kmeans_n_iters)
+        centers = kmeans_balanced.fit(res, bal, train_rot, params.n_lists)
+
+        # ---- codebooks over residuals ----------------------------------
+        labels_t = kmeans_balanced.predict(res, bal, train_rot, centers)
+        resid = _subspace_split(train_rot - centers[labels_t], pq_dim)
+        book = 1 << params.pq_bits
+        if params.codebook_kind == CodebookKind.PER_SUBSPACE:
+            keys = jax.random.split(res.next_key(), pq_dim)
+            codebooks = _train_books_per_subspace(
+                jnp.transpose(resid, (1, 0, 2)), keys, book,
+                params.kmeans_n_iters)
+        else:
+            # per-cluster: one book per coarse list over all its residual
+            # subvectors (train_per_cluster, ivf_pq_build.cuh:417)
+            flat = resid.reshape(-1, rot_dim // pq_dim)
+            flat_labels = jnp.repeat(labels_t, pq_dim)
+            codebooks = _train_books_per_cluster(
+                res, flat, flat_labels, params.n_lists, book,
+                params.kmeans_n_iters)
+
+        index = Index(
+            centers=centers, codebooks=codebooks,
+            list_codes=jnp.zeros((params.n_lists, _LIST_ALIGN, pq_dim),
+                                 jnp.uint8),
+            list_indices=jnp.full((params.n_lists, _LIST_ALIGN), -1,
+                                  jnp.int32),
+            list_sizes=jnp.zeros(params.n_lists, jnp.int32),
+            rotation=rotation, metric=params.metric,
+            codebook_kind=params.codebook_kind, pq_bits=params.pq_bits)
+        if params.add_data_on_build:
+            index = extend(res, index, dataset,
+                           jnp.arange(n, dtype=jnp.int32))
+        return index
+
+
+def _train_books_per_cluster(res, flat, flat_labels, n_lists, book, n_iters):
+    """Per-cluster codebooks: k-means over each list's residual subvectors.
+
+    XLA-friendly approximation of train_per_cluster (ivf_pq_build.cuh:417):
+    rather than ragged per-cluster trainsets, run the vmapped balanced loop
+    over per-cluster *resampled* fixed-size subsets.
+    """
+    n = flat.shape[0]
+    per = max(book * 4, 256)
+    # sample `per` member rows per cluster (with replacement via gumbel over
+    # membership mask)
+    key = res.next_key()
+    g = jax.random.gumbel(key, (n_lists, n))
+    member = (flat_labels[None, :] == jnp.arange(n_lists)[:, None])
+    scores = jnp.where(member, g, -jnp.inf)
+    _, idx = jax.lax.top_k(scores, per)               # (n_lists, per)
+    subsets = flat[idx]                               # (n_lists, per, len)
+    keys = jax.random.split(res.next_key(), n_lists)
+
+    def one(sub, k):
+        stride = max(per // book, 1)
+        c0 = sub[::stride][:book]
+        c0 = jnp.pad(c0, ((0, book - c0.shape[0]), (0, 0)), mode="edge")
+        centers, _ = kmeans_balanced._balanced_loop(
+            sub, c0, k, book, n_iters, DistanceType.L2Expanded)
+        return centers
+
+    return jax.vmap(one)(subsets, keys)
+
+
+def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
+    """Encode + add vectors (reference: ivf_pq.cuh:266 ``extend``)."""
+    with named_range("ivf_pq::extend"):
+        new_vectors = ensure_array(new_vectors, "new_vectors")
+        expects(new_vectors.ndim == 2 and new_vectors.shape[1] == index.dim,
+                "ivf_pq.extend: dim mismatch")
+        n_new = new_vectors.shape[0]
+        if new_indices is None:
+            new_indices = index.size + jnp.arange(n_new, dtype=jnp.int32)
+        else:
+            new_indices = ensure_array(new_indices, "new_indices")
+
+        rot = new_vectors.astype(jnp.float32) @ index.rotation
+        bal = KMeansBalancedParams()
+        labels = kmeans_balanced.predict(res, bal, rot, index.centers)
+        resid = _subspace_split(rot - index.centers[labels], index.pq_dim)
+        codes = _encode(index.codebooks, resid, index.codebook_kind, labels)
+
+        # flatten existing + concat + repack (same dance as ivf_flat.extend)
+        old_valid = (index.list_indices >= 0).ravel()
+        old_labels = jnp.repeat(jnp.arange(index.n_lists, dtype=jnp.int32),
+                                index.capacity)[old_valid]
+        old_codes = index.list_codes.reshape(-1, index.pq_dim)[old_valid]
+        old_ids = index.list_indices.ravel()[old_valid]
+
+        all_codes = jnp.concatenate([old_codes, codes])
+        all_ids = jnp.concatenate([old_ids, new_indices.astype(jnp.int32)])
+        all_labels = jnp.concatenate([old_labels, labels])
+
+        sizes = jax.ops.segment_sum(
+            jnp.ones(all_labels.shape[0], jnp.int32), all_labels,
+            num_segments=index.n_lists)
+        capacity = _round_up(max(int(jnp.max(sizes)), _LIST_ALIGN),
+                             _LIST_ALIGN)
+        list_codes, list_idx, sizes = _pack_lists(
+            all_codes, all_labels, all_ids, index.n_lists, capacity)
+
+        return Index(centers=index.centers, codebooks=index.codebooks,
+                     list_codes=list_codes, list_indices=list_idx,
+                     list_sizes=sizes, rotation=index.rotation,
+                     metric=index.metric,
+                     codebook_kind=index.codebook_kind,
+                     pq_bits=index.pq_bits)
+
+
+# ---------------------------------------------------------------------------
+# search
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=(
+    "k", "n_probes", "metric", "codebook_kind", "lut_dtype"))
+def _search_impl(centers, codebooks, list_codes, list_indices, rotation,
+                 queries, k, n_probes, metric, codebook_kind, lut_dtype):
+    nq = queries.shape[0]
+    qrot = queries.astype(jnp.float32) @ rotation       # (q, rot_dim)
+    cf = centers.astype(jnp.float32)
+    pq_dim = list_codes.shape[2]
+    ip_metric = metric == DistanceType.InnerProduct
+
+    # ---- select_clusters (ivf_pq_search.cuh:133): coarse top-n_probes ----
+    q_dot_c = jax.lax.dot_general(qrot, cf, (((1,), (1,)), ((), ())),
+                                  precision=get_matmul_precision(),
+                                  preferred_element_type=jnp.float32)
+    if ip_metric:
+        _, probes = jax.lax.top_k(q_dot_c, n_probes)
+    else:
+        c_sq = jnp.sum(cf * cf, axis=1)
+        _, probes = jax.lax.top_k(2.0 * q_dot_c - c_sq[None, :], n_probes)
+
+    worst = -jnp.inf if ip_metric else jnp.inf
+    init = (jnp.full((nq, k), worst, jnp.float32),
+            jnp.full((nq, k), -1, jnp.int32))
+    cb_sq = jnp.sum(codebooks.astype(jnp.float32) ** 2, axis=-1)
+
+    q_sub = _subspace_split(qrot, pq_dim)               # (q, j, l)
+
+    def probe_step(carry, p):
+        best_d, best_i = carry
+        lists = probes[:, p]                            # (q,)
+        if ip_metric:
+            # score = q·x ≈ q·center + Σ_j <q_j, cb[code_j]>: the LUT is the
+            # *query* subvectors against the books; q·center folds in below.
+            sub = q_sub
+        else:
+            # d = ||resid_q - codevec||² = ||resid_q||² + Σ_j (||cb||² - 2<r_j,cb>)
+            sub = _subspace_split(qrot - cf[lists], pq_dim)
+        if codebook_kind == CodebookKind.PER_SUBSPACE:
+            ip = jnp.einsum("qjl,jkl->qjk", sub,
+                            codebooks.astype(jnp.float32),
+                            precision=get_matmul_precision())
+            bsq = cb_sq[None, :, :]
+        else:
+            books = codebooks[lists]                     # (q, book, l)
+            ip = jnp.einsum("qjl,qkl->qjk", sub, books.astype(jnp.float32),
+                            precision=get_matmul_precision())
+            bsq = cb_sq[lists][:, None, :]
+        lut = (ip if ip_metric else bsq - 2.0 * ip).astype(lut_dtype)
+
+        codes = list_codes[lists]                       # (q, cap, j) uint8
+        ids = list_indices[lists]                       # (q, cap)
+        # gather LUT entries by code: (q, cap, j) — the compute_similarity
+        # kernel's smem-LUT lookup (ivf_pq_search.cuh:611)
+        gathered = jnp.take_along_axis(
+            lut[:, None, :, :],                         # (q, 1, j, book)
+            codes[..., None].astype(jnp.int32),         # (q, cap, j, 1)
+            axis=-1)[..., 0]
+        d = jnp.sum(gathered.astype(jnp.float32), axis=-1)  # (q, cap)
+        if ip_metric:
+            d = d + jnp.take_along_axis(q_dot_c, lists[:, None], axis=1)
+        else:
+            # ||resid_q||² varies across probes — required for cross-probe
+            # comparability in the merged top-k
+            d = d + jnp.sum(sub * sub, axis=(1, 2))[:, None]
+        d = jnp.where(ids >= 0, d, worst)
+        kt = min(k, d.shape[1])
+        td, ti = select_k(d, kt, in_idx=ids, select_min=not ip_metric)
+        return merge_topk(best_d, best_i, td, ti,
+                          select_min=not ip_metric), None
+
+    (best_d, best_i), _ = jax.lax.scan(probe_step, init,
+                                       jnp.arange(n_probes))
+    if metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded):
+        best_d = jnp.sqrt(jnp.maximum(best_d, 0.0))
+    return best_d, best_i
+
+
+def search(res, params: SearchParams, index: Index, queries, k: int
+           ) -> Tuple[jax.Array, jax.Array]:
+    """Search (reference: ivf_pq.cuh:342).  Returns (distances, indices)."""
+    with named_range("ivf_pq::search"):
+        queries = ensure_array(queries, "queries")
+        expects(queries.ndim == 2 and queries.shape[1] == index.dim,
+                "ivf_pq.search: query dim mismatch")
+        n_probes = min(params.n_probes, index.n_lists)
+        return _search_impl(index.centers, index.codebooks, index.list_codes,
+                            index.list_indices, index.rotation, queries, k,
+                            n_probes, index.metric, index.codebook_kind,
+                            jnp.dtype(params.lut_dtype).name)
+
+
+# ---------------------------------------------------------------------------
+# serialization (reference: ivf_pq_serialize.cuh:38 kSerializationVersion)
+# ---------------------------------------------------------------------------
+
+_SERIALIZATION_VERSION = 1
+
+
+def serialize(res, stream: BinaryIO, index: Index) -> None:
+    ser.serialize_scalar(res, stream, np.int32(_SERIALIZATION_VERSION))
+    ser.serialize_scalar(res, stream, np.int32(index.metric))
+    ser.serialize_scalar(res, stream, np.int32(index.codebook_kind))
+    ser.serialize_scalar(res, stream, np.int32(index.pq_bits))
+    for arr in (index.centers, index.codebooks, index.list_codes,
+                index.list_indices, index.list_sizes, index.rotation):
+        ser.serialize_mdspan(res, stream, arr)
+
+
+def deserialize(res, stream: BinaryIO) -> Index:
+    version = int(ser.deserialize_scalar(res, stream))
+    if version != _SERIALIZATION_VERSION:
+        raise ValueError(
+            f"ivf_pq serialization version mismatch: got {version}, "
+            f"expected {_SERIALIZATION_VERSION}")
+    metric = int(ser.deserialize_scalar(res, stream))
+    kind = int(ser.deserialize_scalar(res, stream))
+    pq_bits = int(ser.deserialize_scalar(res, stream))
+    arrays = [jnp.asarray(ser.deserialize_mdspan(res, stream))
+              for _ in range(6)]
+    return Index(*arrays, metric=metric, codebook_kind=kind, pq_bits=pq_bits)

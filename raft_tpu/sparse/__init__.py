@@ -1,0 +1,42 @@
+"""Sparse stack: formats, ops, linalg, distances, neighbors, solvers.
+
+Reference: cpp/include/raft/sparse/ (72 files, SURVEY.md §2.5).  XLA has no
+sparse runtime, so the containers are static-nnz padded index/value arrays
+(see formats.py) and the kernels are sort/segment/gather compositions with
+MXU-friendly densified tiles where FLOPs dominate.
+"""
+
+from raft_tpu.sparse.formats import (  # noqa: F401
+    CooMatrix,
+    CsrMatrix,
+    coo_sort,
+    coo_to_csr,
+    csr_to_coo,
+    coo_to_dense,
+    csr_to_dense,
+    dense_to_coo,
+    dense_to_csr,
+)
+from raft_tpu.sparse.linalg import (  # noqa: F401
+    spmv,
+    spmm,
+    transpose,
+    add,
+    symmetrize,
+    degree,
+    row_norm_csr,
+    laplacian,
+    laplacian_spmv,
+)
+from raft_tpu.sparse.distance import pairwise_distance_sparse  # noqa: F401
+from raft_tpu.sparse.neighbors import (  # noqa: F401
+    brute_force_knn_sparse,
+    knn_graph,
+    connect_components,
+)
+from raft_tpu.sparse.solver import (  # noqa: F401
+    eigsh_smallest,
+    eigsh_largest,
+    lanczos_tridiag,
+    mst,
+)

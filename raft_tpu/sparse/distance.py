@@ -1,0 +1,58 @@
+"""Sparse pairwise distances (CSR × CSR).
+
+Reference: cpp/include/raft/sparse/distance/distance.cuh:68
+``pairwiseDistance`` with per-metric detail kernels (SURVEY.md §2.5).
+
+TPU design: the MXU wants dense tiles — sparse×sparse products on TPU are
+fastest as *densified row blocks* feeding the same expanded-form math as the
+dense metrics (one gather + matmul per tile), which also reuses the dense
+epilogues exactly.  This is the honest TPU answer to cuSPARSE's SpGEMM: for
+the dims RAFT targets (feature dims ≤ ~100k with row nnz ≪ dim), block
+densification + MXU beats scalar gather-multiply loops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.distance.pairwise import pairwise_distance
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.sparse.formats import CsrMatrix, csr_to_dense
+
+_TILE_ROWS = 2048
+
+
+def pairwise_distance_sparse(
+    x: CsrMatrix,
+    y: CsrMatrix,
+    metric: int = DistanceType.L2Expanded,
+    *,
+    metric_arg: float = 2.0,
+) -> jax.Array:
+    """All-pairs distances between CSR row sets (reference:
+    sparse/distance/distance.cuh:68).  Returns dense (m, n)."""
+    expects(x.shape[1] == y.shape[1],
+            "sparse pairwise: feature dims differ")
+    yd = csr_to_dense(y)
+    m = x.shape[0]
+    outs = []
+    for start in range(0, m, _TILE_ROWS):
+        stop = min(start + _TILE_ROWS, m)
+        xd = _dense_rows(x, start, stop)
+        outs.append(pairwise_distance(xd, yd, metric,
+                                      metric_arg=metric_arg))
+    return jnp.concatenate(outs, axis=0)
+
+
+def _dense_rows(csr: CsrMatrix, start: int, stop: int) -> jax.Array:
+    """Densify a row block of a CSR matrix."""
+    n_rows, n_cols = csr.shape
+    rows = csr.row_ids()
+    in_block = (rows >= start) & (rows < stop)
+    local = jnp.where(in_block, rows - start, stop - start)
+    out = jnp.zeros((stop - start + 1, n_cols), csr.data.dtype)
+    out = out.at[local, csr.indices].add(
+        jnp.where(in_block, csr.data, 0))
+    return out[:stop - start]

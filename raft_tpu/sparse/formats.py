@@ -1,0 +1,155 @@
+"""Sparse formats: COO and CSR containers + conversions.
+
+Reference: cpp/include/raft/sparse/coo.hpp, csr.hpp and the owning/view
+types in core/ (coo_matrix.hpp, csr_matrix.hpp, device_coo_matrix.hpp,
+device_csr_matrix.hpp, sparse_types.hpp); conversions under sparse/convert/
+(SURVEY.md §2.5).
+
+TPU design: XLA has no sparse runtime (the central impedance mismatch,
+SURVEY.md §7) — both containers are pytrees of dense index/value arrays with
+a **static nnz**; "unused" slots are padded with row=n_rows (COO) so they
+sort to the end and segment reductions drop them.  This mirrors
+jax.experimental.sparse's BCOO padding convention while keeping the
+reference's API names.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import ensure_array
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class CooMatrix:
+    """COO (reference: sparse/coo.hpp ``COO``).  Padding rows carry
+    ``row == n_rows`` and val 0 so they never contribute."""
+
+    rows: jax.Array      # (nnz,) int32
+    cols: jax.Array      # (nnz,) int32
+    vals: jax.Array      # (nnz,)
+    shape: Tuple[int, int]
+
+    @property
+    def nnz(self) -> int:
+        return self.rows.shape[0]
+
+    def tree_flatten(self):
+        return (self.rows, self.cols, self.vals), (self.shape,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves, shape=aux[0])
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class CsrMatrix:
+    """CSR (reference: sparse/csr.hpp; core/csr_matrix.hpp).  ``indptr`` has
+    n_rows+1 entries; padding sits past ``indptr[-1]`` with col 0, val 0."""
+
+    indptr: jax.Array    # (n_rows+1,) int32
+    indices: jax.Array   # (nnz,) int32
+    data: jax.Array      # (nnz,)
+    shape: Tuple[int, int]
+
+    @property
+    def nnz(self) -> int:
+        return self.indices.shape[0]
+
+    def row_ids(self) -> jax.Array:
+        """Expand indptr to one row id per nnz slot (padding -> n_rows)."""
+        n_rows = self.shape[0]
+        counts = jnp.diff(self.indptr)
+        ids = jnp.repeat(jnp.arange(n_rows, dtype=jnp.int32), counts,
+                         total_repeat_length=self.nnz)
+        # jnp.repeat pads the tail with the LAST row id; mark real padding
+        slot = jnp.arange(self.nnz)
+        return jnp.where(slot < self.indptr[-1], ids, n_rows)
+
+    def tree_flatten(self):
+        return (self.indptr, self.indices, self.data), (self.shape,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves, shape=aux[0])
+
+
+# ---------------------------------------------------------------------------
+# conversions (reference: sparse/convert/{coo.hpp,csr.hpp,dense.hpp})
+# ---------------------------------------------------------------------------
+
+def coo_sort(coo: CooMatrix) -> CooMatrix:
+    """Sort entries by (row, col) (reference: sparse/op/sort.hpp
+    ``coo_sort``).  Padding (row == n_rows) sorts to the end.
+    lexsort keeps keys in int32 (no row*n_cols encoding overflow)."""
+    order = jnp.lexsort((coo.cols, coo.rows))
+    return CooMatrix(coo.rows[order], coo.cols[order], coo.vals[order],
+                     coo.shape)
+
+
+def coo_to_csr(coo: CooMatrix) -> CsrMatrix:
+    """Reference: sparse/convert/csr.hpp ``sorted_coo_to_csr``."""
+    coo = coo_sort(coo)
+    n_rows = coo.shape[0]
+    counts = jax.ops.segment_sum(
+        jnp.where(coo.rows < n_rows, 1, 0).astype(jnp.int32),
+        jnp.minimum(coo.rows, n_rows - 1).astype(jnp.int32),
+        num_segments=n_rows)
+    indptr = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                              jnp.cumsum(counts).astype(jnp.int32)])
+    return CsrMatrix(indptr, coo.cols, coo.vals, coo.shape)
+
+
+def csr_to_coo(csr: CsrMatrix) -> CooMatrix:
+    """Reference: sparse/convert/coo.hpp ``csr_to_coo``."""
+    return CooMatrix(csr.row_ids(), csr.indices, csr.data, csr.shape)
+
+
+def coo_to_dense(coo: CooMatrix) -> jax.Array:
+    """Reference: sparse/convert/dense.hpp."""
+    n_rows, n_cols = coo.shape
+    out = jnp.zeros((n_rows + 1, n_cols), coo.vals.dtype)
+    out = out.at[jnp.minimum(coo.rows, n_rows),
+                 coo.cols].add(coo.vals)
+    return out[:n_rows]
+
+
+def csr_to_dense(csr: CsrMatrix) -> jax.Array:
+    return coo_to_dense(csr_to_coo(csr))
+
+
+def dense_to_coo(dense, nnz: Optional[int] = None) -> CooMatrix:
+    """Reference: sparse/convert/coo.hpp.  ``nnz`` caps the static slot
+    count (defaults to all entries — callers with known sparsity pass less);
+    entries are selected largest-|value|-first when capped."""
+    dense = ensure_array(dense, "dense")
+    n_rows, n_cols = dense.shape
+    total = n_rows * n_cols
+    nnz = nnz or total
+    flat = dense.ravel()
+    nonzero = flat != 0
+    if nnz >= total:
+        rows = (jnp.arange(total) // n_cols).astype(jnp.int32)
+        cols = (jnp.arange(total) % n_cols).astype(jnp.int32)
+        rows = jnp.where(nonzero, rows, n_rows)
+        return coo_sort(CooMatrix(rows, jnp.where(nonzero, cols, 0),
+                                  jnp.where(nonzero, flat, 0),
+                                  (n_rows, n_cols)))
+    score = jnp.where(nonzero, jnp.abs(flat), -jnp.inf)
+    _, sel = jax.lax.top_k(score, nnz)
+    keep = nonzero[sel]
+    rows = jnp.where(keep, (sel // n_cols).astype(jnp.int32), n_rows)
+    cols = jnp.where(keep, (sel % n_cols).astype(jnp.int32), 0)
+    vals = jnp.where(keep, flat[sel], 0)
+    return coo_sort(CooMatrix(rows, cols, vals, (n_rows, n_cols)))
+
+
+def dense_to_csr(dense, nnz: Optional[int] = None) -> CsrMatrix:
+    return coo_to_csr(dense_to_coo(dense, nnz))

@@ -1,0 +1,168 @@
+"""Sparse linear algebra.
+
+Reference: cpp/include/raft/sparse/linalg/ — spmm/spgemm via cuSPARSE
+wrappers (sparse/detail/cusparse_wrappers.h), add, norm, degree, transpose,
+symmetrize, Laplacian/spectral embedding helpers (SURVEY.md §2.5).
+
+TPU design: CSR×dense products are ``segment_sum`` over gathered dense rows
+(HBM-bandwidth bound, like any SpMV); everything structural (transpose,
+symmetrize, add) is sort + segment reduction.  No cuSPARSE analogue exists —
+these ARE the kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.sparse.formats import (
+    CooMatrix,
+    CsrMatrix,
+    coo_sort,
+    coo_to_csr,
+    csr_to_coo,
+)
+
+
+def spmv(csr: CsrMatrix, x: jax.Array) -> jax.Array:
+    """y = A @ x for CSR A (reference: cusparsespmv wrapper path).
+
+    One gather of x[indices] + one segment_sum over row ids — the TPU SpMV.
+    """
+    rows = csr.row_ids()
+    n_rows = csr.shape[0]
+    contrib = csr.data * x[csr.indices]
+    return jax.ops.segment_sum(
+        jnp.where(rows < n_rows, contrib, 0),
+        jnp.minimum(rows, n_rows - 1), num_segments=n_rows)
+
+
+def spmm(csr: CsrMatrix, B: jax.Array) -> jax.Array:
+    """C = A @ B for CSR A, dense B (reference: cusparsespmm wrapper)."""
+    rows = csr.row_ids()
+    n_rows = csr.shape[0]
+    contrib = csr.data[:, None] * B[csr.indices]     # (nnz, k)
+    return jax.ops.segment_sum(
+        jnp.where((rows < n_rows)[:, None], contrib, 0),
+        jnp.minimum(rows, n_rows - 1), num_segments=n_rows)
+
+
+def transpose(coo: CooMatrix) -> CooMatrix:
+    """Reference: sparse/linalg/transpose.hpp."""
+    n_rows, n_cols = coo.shape
+    pad = coo.rows >= n_rows
+    return coo_sort(CooMatrix(
+        jnp.where(pad, n_cols, coo.cols).astype(jnp.int32),
+        jnp.where(pad, 0, coo.rows).astype(jnp.int32),
+        coo.vals, (n_cols, n_rows)))
+
+
+def add(a: CooMatrix, b: CooMatrix) -> CooMatrix:
+    """C = A + B with duplicate coalescing
+    (reference: sparse/linalg/add.hpp ``csr_add_calc/csr_add_finalize``).
+    Output nnz is (a.nnz + b.nnz) static slots; duplicates are summed into
+    one slot and the shadow entries padded out."""
+    expects(a.shape == b.shape, "sparse.add: shape mismatch")
+    n_rows, n_cols = a.shape
+    rows = jnp.concatenate([a.rows, b.rows])
+    cols = jnp.concatenate([a.cols, b.cols])
+    vals = jnp.concatenate([a.vals, b.vals])
+    merged = coo_sort(CooMatrix(rows, cols, vals, a.shape))
+    # coalesce duplicates: after the sort, equal (row, col) are adjacent
+    first = jnp.concatenate([
+        jnp.ones(1, jnp.bool_),
+        (merged.rows[1:] != merged.rows[:-1])
+        | (merged.cols[1:] != merged.cols[:-1])])
+    seg = jnp.cumsum(first) - 1                       # group index per slot
+    summed = jax.ops.segment_sum(merged.vals, seg,
+                                 num_segments=merged.nnz)
+    # one representative slot per group; shadows -> padding
+    out_rows = jnp.where(first, merged.rows, n_rows)
+    out_vals = jnp.where(first, summed[seg], 0)
+    return coo_sort(CooMatrix(out_rows, jnp.where(first, merged.cols, 0),
+                              out_vals, a.shape))
+
+
+def symmetrize(coo: CooMatrix, op: str = "add") -> CooMatrix:
+    """A ∪ Aᵀ (reference: sparse/linalg/symmetrize.hpp — used to build
+    undirected kNN graphs).  op='add' sums mirrored entries; op='max' keeps
+    the max (the reference's coo_symmetrize lambda hook)."""
+    at = transpose(coo)
+    if op == "add":
+        return add(coo, at)
+    expects(op == "max", "symmetrize: op must be 'add' or 'max'")
+    n_rows, n_cols = coo.shape
+    rows = jnp.concatenate([coo.rows, at.rows])
+    cols = jnp.concatenate([coo.cols, at.cols])
+    vals = jnp.concatenate([coo.vals, at.vals])
+    merged = coo_sort(CooMatrix(rows, cols, vals, coo.shape))
+    first = jnp.concatenate([
+        jnp.ones(1, jnp.bool_),
+        (merged.rows[1:] != merged.rows[:-1])
+        | (merged.cols[1:] != merged.cols[:-1])])
+    seg = jnp.cumsum(first) - 1
+    maxed = jax.ops.segment_max(merged.vals, seg, num_segments=merged.nnz)
+    out_rows = jnp.where(first, merged.rows, n_rows)
+    return coo_sort(CooMatrix(out_rows, jnp.where(first, merged.cols, 0),
+                              jnp.where(first, maxed[seg], 0), coo.shape))
+
+
+def degree(coo: CooMatrix) -> jax.Array:
+    """Per-row entry count (reference: sparse/linalg/degree.hpp)."""
+    n_rows = coo.shape[0]
+    return jax.ops.segment_sum(
+        jnp.where(coo.rows < n_rows, 1, 0),
+        jnp.minimum(coo.rows, n_rows - 1).astype(jnp.int32),
+        num_segments=n_rows)
+
+
+def row_norm_csr(csr: CsrMatrix, norm_type: str = "l2") -> jax.Array:
+    """Per-row norms (reference: sparse/linalg/norm.hpp)."""
+    rows = csr.row_ids()
+    n_rows = csr.shape[0]
+    if norm_type == "l1":
+        v = jnp.abs(csr.data)
+    elif norm_type == "l2":
+        v = csr.data * csr.data
+    elif norm_type == "linf":
+        return jax.ops.segment_max(
+            jnp.where(rows < n_rows, jnp.abs(csr.data), 0),
+            jnp.minimum(rows, n_rows - 1), num_segments=n_rows)
+    else:
+        raise ValueError(f"unknown norm {norm_type!r}")
+    out = jax.ops.segment_sum(jnp.where(rows < n_rows, v, 0),
+                              jnp.minimum(rows, n_rows - 1),
+                              num_segments=n_rows)
+    return jnp.sqrt(out) if norm_type == "l2" else out
+
+
+def laplacian(adj: CooMatrix, normalized: bool = True
+              ) -> Tuple[CsrMatrix, jax.Array]:
+    """Graph Laplacian L = D - A (or normalized I - D^-1/2 A D^-1/2) as the
+    (CSR, diagonal) pair used by the spectral solver (reference:
+    spectral/matrix_wrappers.hpp ``laplacian_matrix_t`` — spmv computes
+    D·x - A·x there; we return the same operator pieces)."""
+    d = jax.ops.segment_sum(
+        jnp.where(adj.rows < adj.shape[0], adj.vals, 0),
+        jnp.minimum(adj.rows, adj.shape[0] - 1).astype(jnp.int32),
+        num_segments=adj.shape[0])
+    if normalized:
+        inv_sqrt = jnp.where(d > 0, 1.0 / jnp.sqrt(jnp.maximum(d, 1e-30)),
+                             0.0)
+        vals = -adj.vals * inv_sqrt[jnp.minimum(adj.rows, adj.shape[0] - 1)] \
+            * inv_sqrt[adj.cols]
+        diag = jnp.where(d > 0, 1.0, 0.0)
+    else:
+        vals = -adj.vals
+        diag = d
+    neg_a = CooMatrix(adj.rows, adj.cols, vals, adj.shape)
+    return coo_to_csr(neg_a), diag
+
+
+def laplacian_spmv(lap_csr: CsrMatrix, diag: jax.Array, x: jax.Array
+                   ) -> jax.Array:
+    """L @ x given the (off-diagonal CSR, diagonal) pair."""
+    return diag * x + spmv(lap_csr, x)

@@ -1,0 +1,100 @@
+"""Sparse neighbors: brute-force kNN over CSR, kNN-graph construction, and
+cross-component 1-NN (``connect_components``).
+
+Reference: cpp/include/raft/sparse/neighbors/{brute_force,knn,knn_graph,
+connect_components}.cuh (SURVEY.md §2.5).  ``connect_components`` is the
+single-linkage fix-up: after an MST pass leaves a forest, find for every
+component its nearest point in any other component and add those edges
+(detail in sparse/neighbors/cross_component_nn.cuh).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import ensure_array
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.matrix.select_k import select_k
+from raft_tpu.sparse.distance import pairwise_distance_sparse
+from raft_tpu.sparse.formats import CooMatrix, CsrMatrix, coo_sort
+
+
+def brute_force_knn_sparse(
+    x: CsrMatrix,
+    y: CsrMatrix,
+    k: int,
+    *,
+    metric: int = DistanceType.L2Expanded,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact kNN of CSR queries x against CSR database y
+    (reference: sparse/neighbors/brute_force.cuh)."""
+    d = pairwise_distance_sparse(x, y, metric)
+    select_min = metric != DistanceType.InnerProduct
+    return select_k(d, k, select_min=select_min)
+
+
+def knn_graph(
+    res,
+    X,
+    k: int,
+    *,
+    metric: int = DistanceType.L2SqrtExpanded,
+) -> CooMatrix:
+    """Symmetrized kNN graph of dense points as COO
+    (reference: sparse/neighbors/knn_graph.cuh — feeds single-linkage).
+    Each of the n*k edges appears with its mirror (max-symmetrized)."""
+    from raft_tpu.neighbors.brute_force import knn as dense_knn
+    from raft_tpu.sparse.linalg import symmetrize
+
+    X = ensure_array(X, "X")
+    n = X.shape[0]
+    d, i = dense_knn(res, X, X, k + 1, metric=metric)
+    # drop self column (first hit is the point itself)
+    d, i = d[:, 1:], i[:, 1:]
+    rows = jnp.repeat(jnp.arange(n, dtype=jnp.int32), k)
+    coo = CooMatrix(rows, i.ravel().astype(jnp.int32),
+                    d.ravel(), (n, n))
+    return symmetrize(coo_sort(coo), op="max")
+
+
+def connect_components(
+    res,
+    X,
+    labels: jax.Array,
+    *,
+    metric: int = DistanceType.L2Expanded,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Cross-component nearest neighbors (reference:
+    sparse/neighbors/connect_components.cuh `cross_component_nn`):
+    for each component, the closest point pair reaching any OTHER component.
+    Returns (src, dst, dist) — one candidate edge per component (padded -1
+    for absent).  Adding these to an MST forest makes it spanning.
+    """
+    from raft_tpu.distance.pairwise import pairwise_distance
+
+    X = ensure_array(X, "X")
+    labels = ensure_array(labels, "labels").astype(jnp.int32)
+    n = X.shape[0]
+    # full pairwise with same-component masking; for the sizes single-linkage
+    # handles (fix-up stage) the dense (n, n) block is acceptable, as the
+    # reference's fix-up also does an all-pairs NN over components
+    d = pairwise_distance(X, X, metric)
+    same = labels[:, None] == labels[None, :]
+    d = jnp.where(same, jnp.inf, d)
+    best_j = jnp.argmin(d, axis=1).astype(jnp.int32)      # (n,)
+    best_d = jnp.min(d, axis=1)
+    # per-component best row
+    order_key = best_d
+    comp_min = jax.ops.segment_min(order_key, labels, num_segments=n)
+    is_best = order_key <= comp_min[labels]
+    rid = jnp.where(is_best, jnp.arange(n), n)
+    comp_rep = jax.ops.segment_min(rid, labels, num_segments=n)
+    valid = comp_rep < n
+    src = jnp.where(valid, jnp.minimum(comp_rep, n - 1), -1)
+    dst = jnp.where(valid, best_j[jnp.minimum(comp_rep, n - 1)], -1)
+    dist = jnp.where(valid, best_d[jnp.minimum(comp_rep, n - 1)], jnp.inf)
+    return src, dst, dist

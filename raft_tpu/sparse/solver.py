@@ -1,0 +1,214 @@
+"""Sparse solvers: Lanczos eigenpairs + Boruvka MST.
+
+Reference: cpp/include/raft/sparse/solver/lanczos.cuh
+(``computeSmallestEigenvectors`` / ``computeLargestEigenvectors``) and
+sparse/solver/mst.cuh + mst_solver.cuh (Boruvka MST, used by
+single-linkage) — SURVEY.md §2.5.
+
+TPU design: both are fixed-iteration jittable loops —
+
+- **Lanczos**: classic tridiagonalization with full reorthogonalization
+  (the reference restarts; full reorth at these m is cheaper than restart
+  logic and is XLA-friendly: one (m, n) panel matmul per step).  The small
+  (m, m) tridiagonal eigenproblem solves with ``jnp.linalg.eigh``.
+- **Boruvka**: edge-list halving — each round every component picks its
+  minimum outgoing edge (``segment_min`` over encoded weight+id keys),
+  merges via iterated pointer jumping (log-depth label propagation).
+  Rounds are bounded by ceil(log2(n)) statically.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.error import expects
+from raft_tpu.sparse.formats import CooMatrix, CsrMatrix
+from raft_tpu.sparse.linalg import spmv
+
+
+# ---------------------------------------------------------------------------
+# Lanczos
+# ---------------------------------------------------------------------------
+
+def lanczos_tridiag(
+    matvec: Callable[[jax.Array], jax.Array],
+    n: int,
+    m: int,
+    v0: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """m-step Lanczos: returns (V (m, n), alpha (m,), beta (m-1,))."""
+
+    def step(carry, i):
+        V, alpha, beta, v_prev, v = carry
+        w = matvec(v)
+        a = jnp.dot(w, v)
+        w = w - a * v - jnp.where(i > 0, beta[jnp.maximum(i - 1, 0)],
+                                  0.0) * v_prev
+        # full reorthogonalization against the panel built so far
+        mask = (jnp.arange(m) <= i)[:, None]
+        proj = (V * mask) @ w
+        w = w - (V * mask).T @ proj
+        b = jnp.linalg.norm(w)
+        v_next = jnp.where(b > 1e-10, w / jnp.maximum(b, 1e-30),
+                           jnp.zeros_like(w))
+        V = V.at[i].set(v)
+        alpha = alpha.at[i].set(a)
+        beta = jnp.where(i < m - 1, beta.at[jnp.minimum(i, m - 2)].set(b),
+                         beta)
+        return (V, alpha, beta, v, v_next), None
+
+    V0 = jnp.zeros((m, n), jnp.float32)
+    alpha0 = jnp.zeros((m,), jnp.float32)
+    beta0 = jnp.zeros((max(m - 1, 1),), jnp.float32)
+    v = v0 / jnp.maximum(jnp.linalg.norm(v0), 1e-30)
+    (V, alpha, beta, _, _), _ = jax.lax.scan(
+        step, (V0, alpha0, beta0, jnp.zeros_like(v), v), jnp.arange(m))
+    return V, alpha, beta
+
+
+def _eig_from_tridiag(V, alpha, beta, n_components, largest):
+    m = alpha.shape[0]
+    T = (jnp.diag(alpha) + jnp.diag(beta[:m - 1], 1)
+         + jnp.diag(beta[:m - 1], -1))
+    evals, evecs = jnp.linalg.eigh(T)        # ascending
+    if largest:
+        evals = evals[::-1]
+        evecs = evecs[:, ::-1]
+    ritz = V.T @ evecs[:, :n_components]     # (n, k)
+    norms = jnp.linalg.norm(ritz, axis=0)
+    ritz = ritz / jnp.maximum(norms, 1e-30)
+    return evals[:n_components], ritz
+
+
+def eigsh_smallest(
+    res,
+    A: CsrMatrix,
+    n_components: int,
+    *,
+    ncv: int = 0,
+    matvec: Optional[Callable[[jax.Array], jax.Array]] = None,
+    seed: int = 0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Smallest eigenpairs of a symmetric operator
+    (reference: lanczos.cuh ``computeSmallestEigenvectors``).
+    Returns (eigenvalues (k,), eigenvectors (n, k))."""
+    n = A.shape[0] if A is not None else None
+    mv = matvec or (lambda x: spmv(A, x))
+    expects(n is not None, "eigsh_smallest: need a CSR matrix or n via A")
+    m = ncv or min(max(2 * n_components + 1, 20), n)
+    v0 = jax.random.normal(jax.random.key(seed), (n,), jnp.float32)
+    V, alpha, beta = lanczos_tridiag(mv, n, m, v0)
+    return _eig_from_tridiag(V, alpha, beta, n_components, largest=False)
+
+
+def eigsh_largest(res, A: CsrMatrix, n_components: int, *, ncv: int = 0,
+                  matvec=None, seed: int = 0):
+    """Reference: lanczos.cuh ``computeLargestEigenvectors``."""
+    n = A.shape[0]
+    mv = matvec or (lambda x: spmv(A, x))
+    m = ncv or min(max(2 * n_components + 1, 20), n)
+    v0 = jax.random.normal(jax.random.key(seed), (n,), jnp.float32)
+    V, alpha, beta = lanczos_tridiag(mv, n, m, v0)
+    return _eig_from_tridiag(V, alpha, beta, n_components, largest=True)
+
+
+# ---------------------------------------------------------------------------
+# Boruvka MST
+# ---------------------------------------------------------------------------
+
+def _pointer_jump(parent: jax.Array, rounds: int) -> jax.Array:
+    """Iterated parent[parent[...]] — log-depth component flattening."""
+    def body(_, p):
+        return p[p]
+    return jax.lax.fori_loop(0, rounds, body, parent)
+
+
+@functools.partial(jax.jit, static_argnames=("n_vertices",))
+def _boruvka(rows, cols, weights, n_vertices):
+    """Boruvka rounds on a symmetric edge list.  Returns
+    (mst_src, mst_dst, mst_weight, in_mst mask) with n_vertices-1 real
+    entries for a connected graph (others padded -1)."""
+    n_edges = rows.shape[0]
+    big = jnp.float32(jnp.inf)
+    n_rounds = max(int(np.ceil(np.log2(max(n_vertices, 2)))) + 1, 1)
+    jump_rounds = n_rounds + 2
+
+    def round_body(state):
+        color, in_mst, n_merged, rnd = state
+        # min outgoing edge per component: key = (weight, edge_id) encoded
+        src_c = color[rows]
+        dst_c = color[cols]
+        cross = src_c != dst_c
+        w = jnp.where(cross, weights, big)
+        # segment argmin via min over encoded (weight, id) — ids break ties
+        # deterministically (the reference's alteration step)
+        order = jnp.argsort(w, stable=True)
+        # cheaper: for each component take min weight then first edge achieving it
+        wmin = jax.ops.segment_min(w, src_c, num_segments=n_vertices)
+        is_min = cross & (w <= wmin[src_c] + 0.0)
+        # first edge index per component among is_min
+        eid = jnp.where(is_min, jnp.arange(n_edges), n_edges)
+        emin = jax.ops.segment_min(eid, src_c, num_segments=n_vertices)
+        has_edge = emin < n_edges
+        sel = jnp.minimum(emin, n_edges - 1)
+        # proposed merges: component c -> color of the other endpoint
+        partner = jnp.where(has_edge, color[cols[sel]],
+                            jnp.arange(n_vertices))
+        # symmetry breaking: merge into the smaller color when both chose
+        # each other (standard Boruvka star contraction)
+        partner_of_partner = partner[partner]
+        root = jnp.where(
+            (partner_of_partner == jnp.arange(n_vertices))
+            & (jnp.arange(n_vertices) < partner),
+            jnp.arange(n_vertices), partner)
+        new_color_map = _pointer_jump(root, jump_rounds)
+        # mark selected edges as MST members (only components that merged
+        # into another root add their edge; dedupe mutual pairs)
+        adds = has_edge & (new_color_map != jnp.arange(n_vertices)) | (
+            has_edge & (partner_of_partner == jnp.arange(n_vertices))
+            & (jnp.arange(n_vertices) > partner))
+        in_mst = in_mst.at[sel].set(in_mst[sel] | adds)
+        new_color = new_color_map[color]
+        merged = jnp.sum(adds.astype(jnp.int32))
+        return new_color, in_mst, n_merged + merged, rnd + 1
+
+    def cond(state):
+        color, _, _, rnd = state
+        # stop when one component (or max rounds)
+        n_comp = jnp.sum((color == jnp.arange(n_vertices)).astype(jnp.int32))
+        return jnp.logical_and(rnd < n_rounds + 4, n_comp > 1)
+
+    color0 = jnp.arange(n_vertices)
+    in_mst0 = jnp.zeros(n_edges, jnp.bool_)
+    color, in_mst, _, _ = jax.lax.while_loop(
+        cond, round_body, (color0, in_mst0, jnp.int32(0), jnp.int32(0)))
+    return color, in_mst
+
+
+def mst(
+    res,
+    coo: CooMatrix,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Minimum spanning forest of a symmetric weighted graph.
+
+    Reference: sparse/solver/mst.cuh ``mst`` (Boruvka; returns src/dst/weight
+    edge list).  Returns ``(src, dst, weight, color)`` where the first
+    entries flagged by weight < inf are forest edges and ``color`` is the
+    final component labeling (useful for ``connect_components``).
+    """
+    n = coo.shape[0]
+    pad = coo.rows >= n
+    rows = jnp.where(pad, 0, coo.rows)
+    cols = jnp.where(pad, 0, coo.cols)
+    w = jnp.where(pad | (coo.rows == coo.cols), jnp.inf,
+                  coo.vals.astype(jnp.float32))
+    color, in_mst = _boruvka(rows, cols, w, n)
+    src = jnp.where(in_mst, rows, -1)
+    dst = jnp.where(in_mst, cols, -1)
+    weight = jnp.where(in_mst, w, jnp.inf)
+    return src, dst, weight, color

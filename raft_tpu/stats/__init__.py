@@ -1,0 +1,44 @@
+"""Statistics primitives and model-evaluation metrics.
+
+Reference: cpp/include/raft/stats/ (50 files, SURVEY.md §2.8) — moments
+(mean/var/stddev/minmax/cov/histogram/weighted means), clustering metrics
+(ARI, (adjusted) rand index, homogeneity/completeness/v-measure, mutual info,
+entropy, silhouette, dispersion), regression/classification metrics, and
+information criteria.
+"""
+
+from raft_tpu.stats.moments import (  # noqa: F401
+    mean,
+    mean_center,
+    mean_add,
+    meanvar,
+    stddev,
+    vars_,
+    minmax,
+    cov,
+    histogram,
+    weighted_mean,
+    row_weighted_mean,
+    col_weighted_mean,
+)
+from raft_tpu.stats.cluster_metrics import (  # noqa: F401
+    contingency_matrix,
+    entropy,
+    mutual_info_score,
+    homogeneity_score,
+    completeness_score,
+    v_measure,
+    rand_index,
+    adjusted_rand_index,
+    silhouette_score,
+    dispersion,
+)
+from raft_tpu.stats.regression_metrics import (  # noqa: F401
+    IC_Type,
+    accuracy,
+    r2_score,
+    regression_metrics,
+    information_criterion_batched,
+    kl_divergence,
+    trustworthiness_score,
+)

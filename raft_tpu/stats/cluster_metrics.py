@@ -1,0 +1,188 @@
+"""Clustering evaluation metrics.
+
+Reference: cpp/include/raft/stats/ — adjusted_rand_index.cuh, rand_index.cuh,
+completeness_score.cuh, homogeneity_score.cuh, v_measure.cuh,
+mutual_info_score.cuh, entropy.cuh, contingency_matrix.cuh,
+silhouette_score.cuh (incl. batched), dispersion.cuh (SURVEY.md §2.8).
+
+All metrics reduce through the contingency matrix — one ``segment_sum``-style
+scatter on device (the reference builds it with a custom kernel,
+contingency_matrix.cuh) — after which the formulas are tiny reductions.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import ensure_array
+from raft_tpu.distance.pairwise import pairwise_distance
+from raft_tpu.distance.types import DistanceType
+
+
+def contingency_matrix(y_true, y_pred, *, n_classes_true: int,
+                       n_classes_pred: int) -> jax.Array:
+    """Joint label-count matrix (reference: stats/contingency_matrix.cuh).
+
+    Class counts must be static for XLA; the reference's
+    ``getInputClassCardinality`` pre-pass maps to the caller supplying them
+    (or via int(max)+1 outside jit).
+    """
+    y_true = ensure_array(y_true, "y_true").astype(jnp.int32)
+    y_pred = ensure_array(y_pred, "y_pred").astype(jnp.int32)
+    flat = y_true * n_classes_pred + y_pred
+    counts = jnp.zeros(n_classes_true * n_classes_pred, jnp.int32).at[
+        flat].add(1)
+    return counts.reshape(n_classes_true, n_classes_pred)
+
+
+def _entropy_from_counts(counts: jax.Array) -> jax.Array:
+    n = jnp.sum(counts)
+    p = counts / jnp.maximum(n, 1)
+    return -jnp.sum(jnp.where(p > 0, p * jnp.log(jnp.maximum(p, 1e-30)), 0.0))
+
+
+def entropy(labels, *, n_classes: int) -> jax.Array:
+    """Shannon entropy of a labeling, in nats (reference: stats/entropy.cuh)."""
+    labels = ensure_array(labels, "labels").astype(jnp.int32)
+    counts = jnp.zeros(n_classes, jnp.int32).at[labels].add(1)
+    return _entropy_from_counts(counts)
+
+
+def mutual_info_score(y_true, y_pred, *, n_classes_true: int,
+                      n_classes_pred: int) -> jax.Array:
+    """Mutual information between two labelings
+    (reference: stats/mutual_info_score.cuh)."""
+    cm = contingency_matrix(y_true, y_pred,
+                            n_classes_true=n_classes_true,
+                            n_classes_pred=n_classes_pred).astype(jnp.float64
+                            if jax.config.jax_enable_x64 else jnp.float32)
+    n = jnp.sum(cm)
+    pij = cm / n
+    pi = jnp.sum(pij, axis=1, keepdims=True)
+    pj = jnp.sum(pij, axis=0, keepdims=True)
+    ratio = pij / jnp.maximum(pi * pj, 1e-30)
+    return jnp.sum(jnp.where(pij > 0,
+                             pij * jnp.log(jnp.maximum(ratio, 1e-30)), 0.0))
+
+
+def homogeneity_score(y_true, y_pred, *, n_classes_true: int,
+                      n_classes_pred: int) -> jax.Array:
+    """h = 1 - H(C|K)/H(C) (reference: stats/homogeneity_score.cuh)."""
+    mi = mutual_info_score(y_true, y_pred, n_classes_true=n_classes_true,
+                           n_classes_pred=n_classes_pred)
+    h_c = entropy(y_true, n_classes=n_classes_true)
+    return jnp.where(h_c > 0, mi / h_c, 1.0)
+
+
+def completeness_score(y_true, y_pred, *, n_classes_true: int,
+                       n_classes_pred: int) -> jax.Array:
+    """c = 1 - H(K|C)/H(K) (reference: stats/completeness_score.cuh)."""
+    return homogeneity_score(y_pred, y_true,
+                             n_classes_true=n_classes_pred,
+                             n_classes_pred=n_classes_true)
+
+
+def v_measure(y_true, y_pred, *, n_classes_true: int, n_classes_pred: int,
+              beta: float = 1.0) -> jax.Array:
+    """Harmonic mean of homogeneity and completeness
+    (reference: stats/v_measure.cuh)."""
+    h = homogeneity_score(y_true, y_pred, n_classes_true=n_classes_true,
+                          n_classes_pred=n_classes_pred)
+    c = completeness_score(y_true, y_pred, n_classes_true=n_classes_true,
+                           n_classes_pred=n_classes_pred)
+    denom = beta * h + c
+    return jnp.where(denom > 0, (1 + beta) * h * c / denom, 0.0)
+
+
+def rand_index(y_true, y_pred) -> jax.Array:
+    """Rand index via pair agreement (reference: stats/rand_index.cuh)."""
+    y_true = ensure_array(y_true, "y_true")
+    y_pred = ensure_array(y_pred, "y_pred")
+    same_t = y_true[:, None] == y_true[None, :]
+    same_p = y_pred[:, None] == y_pred[None, :]
+    agree = (same_t == same_p).astype(jnp.float32)
+    n = y_true.shape[0]
+    total = n * (n - 1) / 2
+    agree_pairs = (jnp.sum(agree) - n) / 2  # remove diagonal
+    return agree_pairs / total
+
+
+def adjusted_rand_index(y_true, y_pred, *, n_classes_true: int,
+                        n_classes_pred: int) -> jax.Array:
+    """ARI from the contingency matrix
+    (reference: stats/adjusted_rand_index.cuh)."""
+    cm = contingency_matrix(y_true, y_pred,
+                            n_classes_true=n_classes_true,
+                            n_classes_pred=n_classes_pred).astype(jnp.float32)
+    n = jnp.sum(cm)
+
+    def comb2(x):
+        return x * (x - 1) / 2
+
+    sum_ij = jnp.sum(comb2(cm))
+    a = jnp.sum(comb2(jnp.sum(cm, axis=1)))
+    b = jnp.sum(comb2(jnp.sum(cm, axis=0)))
+    expected = a * b / jnp.maximum(comb2(n), 1.0)
+    max_index = (a + b) / 2
+    denom = max_index - expected
+    return jnp.where(jnp.abs(denom) > 1e-12, (sum_ij - expected) / denom, 1.0)
+
+
+def silhouette_score(
+    X,
+    labels,
+    *,
+    n_clusters: int,
+    metric: int = DistanceType.L2Expanded,
+    chunk: int = 0,
+) -> jax.Array:
+    """Mean silhouette coefficient (reference: stats/silhouette_score.cuh;
+    the ``chunk`` parameter mirrors ``silhouette_score_batched`` — row tiles
+    of the pairwise matrix are processed at a time).
+    """
+    X = ensure_array(X, "X")
+    labels = ensure_array(labels, "labels").astype(jnp.int32)
+    n = X.shape[0]
+    chunk = chunk or n
+    one_hot = jax.nn.one_hot(labels, n_clusters, dtype=jnp.float32)  # (n, k)
+    counts = jnp.sum(one_hot, axis=0)                                # (k,)
+
+    def tile_scores(xt, lt):
+        # distances of the row tile against the FULL dataset (columns are
+        # never padded, so sums are exact)
+        d = pairwise_distance(xt, X, metric)                # (c, n)
+        sums = d @ one_hot                                  # (c, k)
+        own = jnp.take_along_axis(sums, lt[:, None], axis=1)[:, 0]
+        own_count = counts[lt]
+        a = own / jnp.maximum(own_count - 1, 1)
+        other_mean = sums / jnp.maximum(counts[None, :], 1)
+        other_mean = other_mean.at[jnp.arange(xt.shape[0]), lt].set(jnp.inf)
+        b = jnp.min(other_mean, axis=1)
+        s = (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-30)
+        # singleton clusters have s = 0 by convention
+        return jnp.where(own_count <= 1, 0.0, s)
+
+    n_chunks = -(-n // chunk)
+    scores = jnp.concatenate(
+        [tile_scores(X[i * chunk:(i + 1) * chunk],
+                     labels[i * chunk:(i + 1) * chunk])
+         for i in range(n_chunks)])
+    return jnp.mean(scores)
+
+
+def dispersion(centroids, cluster_sizes, global_centroid=None
+               ) -> jax.Array:
+    """Between-cluster dispersion (reference: stats/dispersion.cuh):
+    sqrt(sum_k n_k ||mu_k - mu||^2)."""
+    centroids = ensure_array(centroids, "centroids")
+    cluster_sizes = ensure_array(cluster_sizes, "cluster_sizes")
+    if global_centroid is None:
+        w = cluster_sizes.astype(jnp.float32)
+        global_centroid = (jnp.sum(centroids * w[:, None], axis=0)
+                           / jnp.sum(w))
+    diff = centroids - global_centroid[None, :]
+    return jnp.sqrt(jnp.sum(cluster_sizes * jnp.sum(diff * diff, axis=1)))

@@ -1,0 +1,133 @@
+"""Summary statistics (moments).
+
+Reference: cpp/include/raft/stats/ — mean.cuh, meanvar.cuh, stddev.cuh,
+minmax.cuh, cov.cuh, histogram.cuh, weighted_mean.cuh, mean_center.cuh
+(SURVEY.md §2.8).  Axis convention follows the reference: statistics are
+per-column over samples-in-rows unless ``rowwise``.
+
+All of these are single XLA reductions/matmuls; the value kept is the API
+names + semantics (sample vs population normalization, centered covariance).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import ensure_array
+from raft_tpu.utils.precision import get_matmul_precision
+
+
+def mean(data, *, rowwise: bool = False) -> jax.Array:
+    """Column (or row) means (reference: stats/mean.cuh)."""
+    data = ensure_array(data, "data")
+    return jnp.mean(data, axis=1 if rowwise else 0)
+
+
+def mean_center(data, mu=None, *, rowwise: bool = False) -> jax.Array:
+    """Subtract the mean (reference: stats/mean_center.cuh)."""
+    data = ensure_array(data, "data")
+    if mu is None:
+        mu = mean(data, rowwise=rowwise)
+    return data - (mu[:, None] if rowwise else mu[None, :])
+
+
+def mean_add(data, mu, *, rowwise: bool = False) -> jax.Array:
+    """Add the mean back (reference: stats/mean_center.cuh meanAdd)."""
+    data = ensure_array(data, "data")
+    return data + (mu[:, None] if rowwise else mu[None, :])
+
+
+def meanvar(data, *, sample: bool = True, rowwise: bool = False
+            ) -> Tuple[jax.Array, jax.Array]:
+    """Mean and variance in one pass (reference: stats/meanvar.cuh).
+
+    ``sample=True`` uses the n-1 normalization, as the reference's flag.
+    """
+    data = ensure_array(data, "data")
+    axis = 1 if rowwise else 0
+    mu = jnp.mean(data, axis=axis)
+    var = jnp.var(data, axis=axis, ddof=1 if sample else 0)
+    return mu, var
+
+
+def stddev(data, mu=None, *, sample: bool = True, rowwise: bool = False
+           ) -> jax.Array:
+    """Column standard deviation (reference: stats/stddev.cuh)."""
+    data = ensure_array(data, "data")
+    axis = 1 if rowwise else 0
+    if mu is not None:
+        centered = data - jnp.expand_dims(mu, axis)
+        n = data.shape[axis]
+        denom = n - 1 if sample else n
+        return jnp.sqrt(jnp.sum(centered * centered, axis=axis) / denom)
+    return jnp.std(data, axis=axis, ddof=1 if sample else 0)
+
+
+def vars_(data, mu=None, *, sample: bool = True, rowwise: bool = False
+          ) -> jax.Array:
+    """Column variance (reference: stats/stddev.cuh ``vars``)."""
+    s = stddev(data, mu, sample=sample, rowwise=rowwise)
+    return s * s
+
+
+def minmax(data, *, rowwise: bool = False) -> Tuple[jax.Array, jax.Array]:
+    """Per-column min and max (reference: stats/minmax.cuh)."""
+    data = ensure_array(data, "data")
+    axis = 1 if rowwise else 0
+    return jnp.min(data, axis=axis), jnp.max(data, axis=axis)
+
+
+def cov(data, mu=None, *, sample: bool = True, stable: bool = True
+        ) -> jax.Array:
+    """Covariance matrix (d, d) of row-sample data (n, d)
+    (reference: stats/cov.cuh; ``stable`` centers explicitly first)."""
+    data = ensure_array(data, "data")
+    expects(data.ndim == 2, "cov: 2-D data required")
+    n = data.shape[0]
+    if mu is None:
+        mu = jnp.mean(data, axis=0)
+    centered = (data - mu[None, :]).astype(jnp.float32)
+    denom = (n - 1) if sample else n
+    return jax.lax.dot_general(
+        centered.T, centered.T, (((1,), (1,)), ((), ())),
+        precision=get_matmul_precision(),
+        preferred_element_type=jnp.float32) / denom
+
+
+def histogram(data, n_bins: int, *, lower: float, upper: float) -> jax.Array:
+    """Per-column histogram (reference: stats/histogram.cuh).
+
+    data (n, d) -> counts (n_bins, d); values outside [lower, upper) are
+    dropped (the reference's binner clamps via bin index validity).
+    """
+    data = ensure_array(data, "data")
+    if data.ndim == 1:
+        data = data[:, None]
+    width = (upper - lower) / n_bins
+    bins = jnp.floor((data - lower) / width).astype(jnp.int32)
+    valid = (bins >= 0) & (bins < n_bins)
+    bins = jnp.clip(bins, 0, n_bins - 1)
+    one_hot = jax.nn.one_hot(bins, n_bins, dtype=jnp.int32, axis=0)
+    return jnp.sum(one_hot * valid[None, :, :].astype(jnp.int32), axis=1)
+
+
+def weighted_mean(data, weights, *, rowwise: bool = True) -> jax.Array:
+    """Weight-averaged rows or columns (reference: stats/weighted_mean.cuh:
+    row_weighted_mean averages along rows)."""
+    data = ensure_array(data, "data")
+    weights = ensure_array(weights, "weights")
+    axis = 1 if rowwise else 0
+    w = jnp.expand_dims(weights, 1 - axis)
+    return jnp.sum(data * w, axis=axis) / jnp.sum(weights)
+
+
+def row_weighted_mean(data, weights) -> jax.Array:
+    return weighted_mean(data, weights, rowwise=True)
+
+
+def col_weighted_mean(data, weights) -> jax.Array:
+    return weighted_mean(data, weights, rowwise=False)

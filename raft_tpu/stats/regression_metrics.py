@@ -1,0 +1,116 @@
+"""Regression / classification / information metrics.
+
+Reference: cpp/include/raft/stats/ — accuracy.cuh, r2_score.cuh,
+regression_metrics.cuh, information_criterion.cuh, kl_divergence.cuh,
+trustworthiness_score.cuh (SURVEY.md §2.8).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import ensure_array
+from raft_tpu.distance.pairwise import pairwise_distance
+from raft_tpu.distance.types import DistanceType
+
+
+def accuracy(predictions, ref_predictions) -> jax.Array:
+    """Fraction of exact matches (reference: stats/accuracy.cuh)."""
+    predictions = ensure_array(predictions, "predictions")
+    ref_predictions = ensure_array(ref_predictions, "ref_predictions")
+    return jnp.mean((predictions == ref_predictions).astype(jnp.float32))
+
+
+def r2_score(y, y_hat) -> jax.Array:
+    """Coefficient of determination (reference: stats/r2_score.cuh)."""
+    y = ensure_array(y, "y").astype(jnp.float32)
+    y_hat = ensure_array(y_hat, "y_hat").astype(jnp.float32)
+    ss_tot = jnp.sum((y - jnp.mean(y)) ** 2)
+    ss_res = jnp.sum((y - y_hat) ** 2)
+    return 1.0 - ss_res / ss_tot
+
+
+def regression_metrics(predictions, ref_predictions
+                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(mean_abs_error, mean_squared_error, median_abs_error)
+    (reference: stats/regression_metrics.cuh)."""
+    predictions = ensure_array(predictions, "predictions").astype(jnp.float32)
+    ref_predictions = ensure_array(ref_predictions,
+                                   "ref_predictions").astype(jnp.float32)
+    diff = predictions - ref_predictions
+    return (jnp.mean(jnp.abs(diff)),
+            jnp.mean(diff * diff),
+            jnp.median(jnp.abs(diff)))
+
+
+class IC_Type:
+    """Reference: stats/information_criterion.cuh ``IC_Type`` enum."""
+
+    AIC = 0
+    AICc = 1
+    BIC = 2
+
+
+def information_criterion_batched(loglike, ic_type: int, n_params: int,
+                                  n_samples: int) -> jax.Array:
+    """Batched AIC/AICc/BIC from log-likelihoods
+    (reference: stats/information_criterion.cuh)."""
+    loglike = ensure_array(loglike, "loglike").astype(jnp.float32)
+    base = -2.0 * loglike
+    if ic_type == IC_Type.AIC:
+        penalty = 2.0 * n_params
+    elif ic_type == IC_Type.AICc:
+        penalty = (2.0 * n_params
+                   + 2.0 * n_params * (n_params + 1)
+                   / max(n_samples - n_params - 1, 1))
+    elif ic_type == IC_Type.BIC:
+        penalty = jnp.log(jnp.float32(n_samples)) * n_params
+    else:
+        raise ValueError(f"unknown IC type {ic_type}")
+    return base + penalty
+
+
+def kl_divergence(modeled_pdf, observed_pdf) -> jax.Array:
+    """Scalar KL divergence between two densities
+    (reference: stats/kl_divergence.cuh)."""
+    p = ensure_array(modeled_pdf, "modeled_pdf").astype(jnp.float32)
+    q = ensure_array(observed_pdf, "observed_pdf").astype(jnp.float32)
+    term = jnp.where((p > 0) & (q > 0),
+                     p * jnp.log(jnp.maximum(p, 1e-30)
+                                 / jnp.maximum(q, 1e-30)), 0.0)
+    return jnp.sum(term)
+
+
+def trustworthiness_score(X, X_embedded, n_neighbors: int,
+                          *, metric: int = DistanceType.L2SqrtExpanded
+                          ) -> jax.Array:
+    """Trustworthiness of a low-dimensional embedding
+    (reference: stats/trustworthiness_score.cuh): penalizes points that are
+    close in the embedding but far in the original space.
+    """
+    X = ensure_array(X, "X")
+    X_embedded = ensure_array(X_embedded, "X_embedded")
+    n = X.shape[0]
+    expects(n_neighbors < n // 2,
+            "trustworthiness: n_neighbors must be < n/2")
+
+    d_orig = pairwise_distance(X, X, metric)
+    d_emb = pairwise_distance(X_embedded, X_embedded, metric)
+    big = jnp.max(d_orig) + 1.0
+    d_orig = d_orig.at[jnp.arange(n), jnp.arange(n)].set(big)
+    d_emb = d_emb.at[jnp.arange(n), jnp.arange(n)].set(big)
+
+    # rank of each point j in i's original-space neighbor ordering
+    orig_order = jnp.argsort(d_orig, axis=1)
+    ranks = jnp.zeros((n, n), jnp.int32).at[
+        jnp.arange(n)[:, None], orig_order].set(
+        jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32), (n, n)))
+    _, emb_nn = jax.lax.top_k(-d_emb, n_neighbors)
+    emb_ranks = jnp.take_along_axis(ranks, emb_nn, axis=1)
+    penalty = jnp.sum(jnp.maximum(emb_ranks - n_neighbors + 1, 0))
+    norm = 2.0 / (n * n_neighbors * (2.0 * n - 3.0 * n_neighbors - 1.0))
+    return 1.0 - norm * penalty

@@ -1,0 +1,94 @@
+"""CAGRA tests — recall-based (reference: cpp/test/neighbors/ann_cagra.cuh),
+covering graph build, prune degree/validity, search recall and serialization.
+"""
+
+import io
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.neighbors import cagra
+from raft_tpu.random import make_blobs
+
+
+def naive_knn(db, q, k):
+    d = ((q[:, None, :] - db[None, :, :]) ** 2).sum(-1)
+    idx = np.argsort(d, axis=1, kind="stable")[:, :k]
+    return np.take_along_axis(d, idx, axis=1), idx
+
+
+def recall(found, truth):
+    hits = sum(len(set(f) & set(t)) for f, t in zip(found, truth))
+    return hits / truth.size
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    X, _ = make_blobs(2100, 16, n_clusters=30, cluster_std=1.0, seed=11)
+    return np.asarray(X[:2000]), np.asarray(X[2000:2040])
+
+
+@pytest.fixture(scope="module")
+def index(dataset):
+    from raft_tpu import DeviceResources
+    db, _ = dataset
+    res = DeviceResources(seed=42)
+    params = cagra.IndexParams(intermediate_graph_degree=32, graph_degree=16)
+    return cagra.build(res, params, db)
+
+
+class TestCagra:
+    def test_graph_shape_and_validity(self, dataset, index):
+        db, _ = dataset
+        assert index.graph.shape == (db.shape[0], 16)
+        g = np.asarray(index.graph)
+        assert (g >= 0).all() and (g < db.shape[0]).all()
+        # no self-edges in the forward half
+        self_frac = (g == np.arange(db.shape[0])[:, None]).mean()
+        assert self_frac < 0.01
+
+    def test_knn_graph_quality(self, res, dataset):
+        db, _ = dataset
+        knn = cagra.build_knn_graph(res, db, 16)
+        _, ti = naive_knn(db, db, 17)
+        # graph neighbors should substantially overlap true neighbors
+        # (exclude self column from truth)
+        r = recall(np.asarray(knn)[:200], ti[:200, 1:])
+        assert r > 0.8
+
+    def test_search_recall(self, res, dataset, index):
+        db, q = dataset
+        params = cagra.SearchParams(itopk_size=32, search_width=2)
+        d, i = cagra.search(res, params, index, q, 10)
+        _, ti = naive_knn(db, q, 10)
+        assert recall(np.asarray(i), ti) > 0.85
+
+    def test_search_sorted_and_valid(self, res, dataset, index):
+        db, q = dataset
+        params = cagra.SearchParams(itopk_size=32)
+        d, i = cagra.search(res, params, index, q, 5)
+        dd = np.asarray(d)
+        assert (np.diff(dd, axis=1) >= -1e-5).all()
+        assert (np.asarray(i) >= 0).all()
+
+    def test_serialize_roundtrip(self, res, dataset, index):
+        db, q = dataset
+        buf = io.BytesIO()
+        cagra.serialize(res, buf, index)
+        buf.seek(0)
+        index2 = cagra.deserialize(res, buf)
+        np.testing.assert_array_equal(np.asarray(index.graph),
+                                      np.asarray(index2.graph))
+        d1, i1 = cagra.search(res, cagra.SearchParams(), index, q, 5)
+        d2, i2 = cagra.search(res, cagra.SearchParams(), index2, q, 5)
+        # same index contents -> same search behavior modulo random seeds
+        assert d1.shape == d2.shape
+
+    def test_prune_reverse_edges(self, res, dataset):
+        db, _ = dataset
+        knn = cagra.build_knn_graph(res, db, 16)
+        pruned = cagra.prune(res, knn, 8)
+        assert pruned.shape == (db.shape[0], 8)
+        g = np.asarray(pruned)
+        assert (g >= 0).all()

@@ -1,0 +1,100 @@
+"""MNMG algorithm tests on the virtual 8-device mesh (the reference's
+LocalCUDACluster-without-a-cluster strategy, SURVEY.md §4) — distributed
+results must match the single-device algorithms.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.cluster import KMeansParams, kmeans
+from raft_tpu.comms import CommsSession
+from raft_tpu.distributed import kmeans as dist_kmeans
+from raft_tpu.distributed import knn as dist_knn
+from raft_tpu.random import make_blobs
+
+
+@pytest.fixture
+def session(mesh8):
+    s = CommsSession(mesh=mesh8, axis_name="data").init()
+    yield s
+    s.destroy()
+
+
+@pytest.fixture
+def handle(session):
+    return session.worker_handle(seed=0)
+
+
+class TestDistributedKMeans:
+    def test_matches_single_device(self, res, handle):
+        X, _ = make_blobs(1600, 8, n_clusters=5, cluster_std=0.5, seed=2)
+        X = np.asarray(X)
+        c0 = X[:5].copy()
+        params = KMeansParams(n_clusters=5, max_iter=50, tol=1e-6,
+                              init=1)  # will be overridden by Array path
+        from raft_tpu.cluster.kmeans_types import InitMethod
+        params.init = InitMethod.Array
+        dc, dinertia, dn = dist_kmeans.fit(handle, params, X,
+                                           centroids=jnp.asarray(c0))
+        sc, sinertia, sn = kmeans.fit(res, params, X, centroids=c0)
+        # same init, same Lloyd updates -> same fixed point
+        np.testing.assert_allclose(float(dinertia), float(sinertia),
+                                   rtol=1e-3)
+        # centroids equal up to ordering (same init -> same order)
+        np.testing.assert_allclose(np.asarray(dc), np.asarray(sc),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_predict(self, handle):
+        X, _ = make_blobs(800, 4, n_clusters=4, cluster_std=0.3, seed=3)
+        X = np.asarray(X)
+        from raft_tpu.cluster.kmeans_types import InitMethod
+        params = KMeansParams(n_clusters=4, max_iter=30,
+                              init=InitMethod.Array)
+        c, _, _ = dist_kmeans.fit(handle, params, X,
+                                  centroids=jnp.asarray(X[:4]))
+        labels = dist_kmeans.predict(handle, params, X, c)
+        labels = np.asarray(labels)
+        d = ((X[:, None, :] - np.asarray(c)[None, :, :]) ** 2).sum(-1)
+        np.testing.assert_array_equal(labels, d.argmin(1))
+
+    def test_requires_comms(self, res):
+        X = np.zeros((64, 4), np.float32)
+        from raft_tpu.core.error import RaftError
+        with pytest.raises(RaftError, match="comms"):
+            dist_kmeans.fit(res, KMeansParams(n_clusters=2), X)
+
+
+class TestDistributedKnn:
+    def test_matches_single_device(self, res, handle):
+        rng = np.random.default_rng(0)
+        db = rng.normal(size=(1024, 16)).astype(np.float32)
+        q = rng.normal(size=(32, 16)).astype(np.float32)
+        dd, di = dist_knn.knn(handle, db, q, 8)
+        from raft_tpu.neighbors import brute_force
+        sd, si = brute_force.knn(res, db, q, 8,
+                                 metric=0)  # L2Expanded
+        np.testing.assert_allclose(np.asarray(dd), np.asarray(sd),
+                                   rtol=1e-3, atol=1e-3)
+        # ids may differ on exact ties only
+        agree = (np.asarray(di) == np.asarray(si)).mean()
+        assert agree > 0.95
+
+    def test_inner_product(self, handle):
+        rng = np.random.default_rng(1)
+        db = rng.normal(size=(512, 8)).astype(np.float32)
+        q = rng.normal(size=(16, 8)).astype(np.float32)
+        from raft_tpu.distance.types import DistanceType
+        dd, di = dist_knn.knn(handle, db, q, 4,
+                              metric=DistanceType.InnerProduct)
+        ip = q @ db.T
+        ti = np.argsort(-ip, axis=1)[:, :4]
+        np.testing.assert_array_equal(np.asarray(di), ti)
+
+    def test_uneven_shards_rejected(self, handle):
+        from raft_tpu.core.error import RaftError
+        db = np.zeros((100, 4), np.float32)  # 100 % 8 != 0
+        q = np.zeros((4, 4), np.float32)
+        with pytest.raises(RaftError, match="divide"):
+            dist_knn.knn(handle, db, q, 3)

@@ -1,0 +1,164 @@
+"""IVF-PQ tests — recall-based per the reference's ANN pattern
+(cpp/test/neighbors/ann_ivf_pq.cuh; ground truth from naive brute force,
+``eval_neighbours(min_recall)`` assertions), plus refine composition and
+serialization round-trip.
+"""
+
+import io
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.neighbors import ivf_pq, refine
+from raft_tpu.random import make_blobs
+
+
+def naive_knn(db, q, k):
+    d = ((q[:, None, :] - db[None, :, :]) ** 2).sum(-1)
+    idx = np.argsort(d, axis=1, kind="stable")[:, :k]
+    return np.take_along_axis(d, idx, axis=1), idx
+
+
+def recall(found, truth):
+    hits = sum(len(set(f) & set(t)) for f, t in zip(found, truth))
+    return hits / truth.size
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    X, _ = make_blobs(4000, 32, n_clusters=64, cluster_std=1.0, seed=5)
+    return np.asarray(X[:3800]), np.asarray(X[3800:3850])
+
+
+class TestIvfPq:
+    def test_build_shapes(self, res, dataset):
+        db, _ = dataset
+        params = ivf_pq.IndexParams(n_lists=32, pq_dim=8, pq_bits=8,
+                                    kmeans_n_iters=10)
+        index = ivf_pq.build(res, params, db)
+        assert index.n_lists == 32
+        assert index.pq_dim == 8
+        assert index.pq_book_size == 256
+        assert index.codebooks.shape == (8, 256, index.rot_dim // 8)
+        assert index.size == db.shape[0]
+        ids = np.asarray(index.list_indices)
+        valid = ids[ids >= 0]
+        assert sorted(valid.tolist()) == list(range(db.shape[0]))
+
+    def test_search_recall(self, res, dataset):
+        db, q = dataset
+        params = ivf_pq.IndexParams(n_lists=32, pq_dim=16, pq_bits=8,
+                                    kmeans_n_iters=10)
+        index = ivf_pq.build(res, params, db)
+        d, i = ivf_pq.search(res, ivf_pq.SearchParams(n_probes=16),
+                             index, q, 10)
+        _, ti = naive_knn(db, q, 10)
+        # PQ-compressed distances: recall margin as the reference's
+        # low-precision configs (ann_ivf_pq tests allow low_precision_tol)
+        assert recall(np.asarray(i), ti) > 0.7
+
+    def test_search_with_refine(self, res, dataset):
+        db, q = dataset
+        params = ivf_pq.IndexParams(n_lists=32, pq_dim=8, pq_bits=8,
+                                    kmeans_n_iters=10)
+        index = ivf_pq.build(res, params, db)
+        # 4x oversample then exact re-rank — the CAGRA-build composition
+        d_raw, i_raw = ivf_pq.search(res, ivf_pq.SearchParams(n_probes=16),
+                                     index, q, 10)
+        _, i0 = ivf_pq.search(res, ivf_pq.SearchParams(n_probes=16),
+                              index, q, 40)
+        d, i = refine(res, db, q, i0, 10, metric=DistanceType.L2Expanded)
+        _, ti = naive_knn(db, q, 10)
+        r_refined = recall(np.asarray(i), ti)
+        r_raw = recall(np.asarray(i_raw), ti)
+        # refinement must not hurt, and lands decent absolute recall
+        assert r_refined >= r_raw
+        assert r_refined > 0.75
+
+    def test_bf16_lut(self, res, dataset):
+        db, q = dataset
+        params = ivf_pq.IndexParams(n_lists=16, pq_dim=8, kmeans_n_iters=5)
+        index = ivf_pq.build(res, params, db)
+        d32, i32 = ivf_pq.search(res, ivf_pq.SearchParams(n_probes=8),
+                                 index, q, 10)
+        dbf, ibf = ivf_pq.search(
+            res, ivf_pq.SearchParams(n_probes=8, lut_dtype=jnp.bfloat16),
+            index, q, 10)
+        # bf16 LUT stays close to fp32 results
+        assert recall(np.asarray(ibf), np.asarray(i32)) > 0.85
+
+    def test_per_cluster_codebooks(self, res, dataset):
+        db, q = dataset
+        params = ivf_pq.IndexParams(
+            n_lists=16, pq_dim=16, kmeans_n_iters=10,
+            codebook_kind=ivf_pq.CodebookKind.PER_CLUSTER)
+        index = ivf_pq.build(res, params, db)
+        assert index.codebooks.shape[0] == 16
+        d, i = ivf_pq.search(res, ivf_pq.SearchParams(n_probes=8),
+                             index, q, 10)
+        _, ti = naive_knn(db, q, 10)
+        assert recall(np.asarray(i), ti) > 0.4
+
+    def test_extend(self, res, dataset):
+        db, q = dataset
+        params = ivf_pq.IndexParams(n_lists=16, pq_dim=16, kmeans_n_iters=10,
+                                    add_data_on_build=False)
+        index = ivf_pq.build(res, params, db)
+        assert index.size == 0
+        index = ivf_pq.extend(res, index, db[:2000],
+                              jnp.arange(2000, dtype=jnp.int32))
+        index = ivf_pq.extend(res, index, db[2000:],
+                              jnp.arange(2000, db.shape[0], dtype=jnp.int32))
+        assert index.size == db.shape[0]
+        _, i = ivf_pq.search(res, ivf_pq.SearchParams(n_probes=16),
+                             index, q, 10)
+        _, ti = naive_knn(db, q, 10)
+        assert recall(np.asarray(i), ti) > 0.6
+        # matches a fresh add_data_on_build build on the same data
+        params2 = ivf_pq.IndexParams(n_lists=16, pq_dim=16,
+                                     kmeans_n_iters=10)
+        idx2 = ivf_pq.build(res, params2, db)
+        _, i2 = ivf_pq.search(res, ivf_pq.SearchParams(n_probes=16),
+                              idx2, q, 10)
+        assert abs(recall(np.asarray(i), ti)
+                   - recall(np.asarray(i2), ti)) < 0.15
+
+    def test_rotation_orthonormal(self, res, dataset):
+        db, _ = dataset
+        params = ivf_pq.IndexParams(n_lists=16, pq_dim=5, kmeans_n_iters=3,
+                                    force_random_rotation=True)
+        index = ivf_pq.build(res, params, db)
+        # dim=32 not divisible by 5 -> rot_dim=35, rotation (32, 35) with
+        # orthonormal rows ... R R^T = I_32
+        r = np.asarray(index.rotation)
+        assert r.shape == (32, 35)
+        np.testing.assert_allclose(r @ r.T, np.eye(32), atol=1e-4)
+
+    def test_serialize_roundtrip(self, res, dataset):
+        db, q = dataset
+        params = ivf_pq.IndexParams(n_lists=16, pq_dim=8, kmeans_n_iters=3)
+        index = ivf_pq.build(res, params, db)
+        buf = io.BytesIO()
+        ivf_pq.serialize(res, buf, index)
+        buf.seek(0)
+        index2 = ivf_pq.deserialize(res, buf)
+        d1, i1 = ivf_pq.search(res, ivf_pq.SearchParams(n_probes=4),
+                               index, q, 5)
+        d2, i2 = ivf_pq.search(res, ivf_pq.SearchParams(n_probes=4),
+                               index2, q, 5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2),
+                                   rtol=1e-5)
+
+    def test_pq_bits_4(self, res, dataset):
+        db, q = dataset
+        params = ivf_pq.IndexParams(n_lists=16, pq_dim=32, pq_bits=4,
+                                    kmeans_n_iters=10)
+        index = ivf_pq.build(res, params, db)
+        assert index.pq_book_size == 16
+        d, i = ivf_pq.search(res, ivf_pq.SearchParams(n_probes=16),
+                             index, q, 10)
+        _, ti = naive_knn(db, q, 10)
+        assert recall(np.asarray(i), ti) > 0.5

@@ -1,0 +1,236 @@
+"""Sparse stack tests — compare against scipy.sparse / dense numpy
+references (the reference's compute-vs-reference pattern; reference tests:
+cpp/test/sparse/*.cu).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import sparse
+
+RNG = np.random.default_rng(0)
+
+
+def random_sparse(m, n, density=0.2, seed=0):
+    rng = np.random.default_rng(seed)
+    dense = rng.normal(size=(m, n)).astype(np.float32)
+    dense[rng.random((m, n)) > density] = 0.0
+    return dense
+
+
+class TestFormats:
+    def test_dense_coo_roundtrip(self):
+        d = random_sparse(10, 8)
+        coo = sparse.dense_to_coo(jnp.asarray(d))
+        back = np.asarray(sparse.coo_to_dense(coo))
+        np.testing.assert_allclose(back, d, rtol=1e-6)
+
+    def test_dense_csr_roundtrip(self):
+        d = random_sparse(12, 6, seed=1)
+        csr = sparse.dense_to_csr(jnp.asarray(d))
+        back = np.asarray(sparse.csr_to_dense(csr))
+        np.testing.assert_allclose(back, d, rtol=1e-6)
+
+    def test_csr_indptr_matches_scipy(self):
+        d = random_sparse(9, 7, seed=2)
+        csr = sparse.dense_to_csr(jnp.asarray(d))
+        try:
+            import scipy.sparse as sp
+            ref = sp.csr_matrix(d)
+            np.testing.assert_array_equal(np.asarray(csr.indptr),
+                                          ref.indptr)
+        except ImportError:
+            counts = (d != 0).sum(1)
+            np.testing.assert_array_equal(
+                np.asarray(jnp.diff(csr.indptr)), counts)
+
+    def test_coo_csr_coo(self):
+        d = random_sparse(6, 5, seed=3)
+        coo = sparse.dense_to_coo(jnp.asarray(d))
+        csr = sparse.coo_to_csr(coo)
+        coo2 = sparse.csr_to_coo(csr)
+        np.testing.assert_allclose(np.asarray(sparse.coo_to_dense(coo2)), d,
+                                   rtol=1e-6)
+
+    def test_capped_nnz_keeps_largest(self):
+        d = np.zeros((4, 4), np.float32)
+        d[0, 0], d[1, 1], d[2, 2] = 5.0, -3.0, 1.0
+        coo = sparse.dense_to_coo(jnp.asarray(d), nnz=2)
+        back = np.asarray(sparse.coo_to_dense(coo))
+        assert back[0, 0] == 5.0 and back[1, 1] == -3.0 and back[2, 2] == 0
+
+
+class TestLinalg:
+    def test_spmv(self):
+        d = random_sparse(20, 15, seed=4)
+        csr = sparse.dense_to_csr(jnp.asarray(d))
+        x = RNG.normal(size=15).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(sparse.spmv(csr, x)), d @ x,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_spmm(self):
+        d = random_sparse(10, 12, seed=5)
+        csr = sparse.dense_to_csr(jnp.asarray(d))
+        B = RNG.normal(size=(12, 7)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(sparse.spmm(csr, B)), d @ B,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_transpose(self):
+        d = random_sparse(8, 5, seed=6)
+        coo = sparse.dense_to_coo(jnp.asarray(d))
+        t = sparse.transpose(coo)
+        np.testing.assert_allclose(np.asarray(sparse.coo_to_dense(t)), d.T,
+                                   rtol=1e-6)
+
+    def test_add_with_overlap(self):
+        a = random_sparse(6, 6, seed=7)
+        b = random_sparse(6, 6, seed=8)
+        ca = sparse.dense_to_coo(jnp.asarray(a))
+        cb = sparse.dense_to_coo(jnp.asarray(b))
+        s = sparse.add(ca, cb)
+        np.testing.assert_allclose(np.asarray(sparse.coo_to_dense(s)), a + b,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_symmetrize_max(self):
+        # positive weights (the kNN-graph use case: structural zeros are
+        # "absent", so max compares stored entries with 0)
+        d = np.abs(np.triu(random_sparse(6, 6, seed=9)))
+        coo = sparse.dense_to_coo(jnp.asarray(d))
+        s = sparse.symmetrize(coo, op="max")
+        out = np.asarray(sparse.coo_to_dense(s))
+        ref = np.maximum(d, d.T)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_degree(self):
+        d = random_sparse(7, 7, seed=10)
+        coo = sparse.dense_to_coo(jnp.asarray(d))
+        np.testing.assert_array_equal(np.asarray(sparse.degree(coo)),
+                                      (d != 0).sum(1))
+
+    def test_row_norm(self):
+        d = random_sparse(9, 4, seed=11)
+        csr = sparse.dense_to_csr(jnp.asarray(d))
+        np.testing.assert_allclose(
+            np.asarray(sparse.row_norm_csr(csr, "l2")),
+            np.linalg.norm(d, axis=1), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(sparse.row_norm_csr(csr, "l1")),
+            np.abs(d).sum(1), rtol=1e-4, atol=1e-5)
+
+    def test_laplacian_spmv(self):
+        # small symmetric adjacency
+        d = random_sparse(8, 8, seed=12)
+        adj = np.abs(np.minimum(d, d.T))
+        np.fill_diagonal(adj, 0)
+        coo = sparse.dense_to_coo(jnp.asarray(adj))
+        lap_csr, diag = sparse.laplacian(coo, normalized=False)
+        x = RNG.normal(size=8).astype(np.float32)
+        L = np.diag(adj.sum(1)) - adj
+        np.testing.assert_allclose(
+            np.asarray(sparse.laplacian_spmv(lap_csr, diag, x)), L @ x,
+            rtol=1e-4, atol=1e-4)
+
+
+class TestDistanceNeighbors:
+    def test_sparse_pairwise_matches_dense(self):
+        a = random_sparse(15, 10, seed=13)
+        b = random_sparse(12, 10, seed=14)
+        ca = sparse.dense_to_csr(jnp.asarray(a))
+        cb = sparse.dense_to_csr(jnp.asarray(b))
+        out = np.asarray(sparse.pairwise_distance_sparse(ca, cb, 0))
+        ref = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
+
+    def test_sparse_knn(self):
+        a = random_sparse(10, 8, seed=15)
+        b = random_sparse(30, 8, seed=16)
+        ca = sparse.dense_to_csr(jnp.asarray(a))
+        cb = sparse.dense_to_csr(jnp.asarray(b))
+        d, i = sparse.brute_force_knn_sparse(ca, cb, 5)
+        ref = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        ti = np.argsort(ref, axis=1)[:, :5]
+        hits = sum(len(set(f) & set(t))
+                   for f, t in zip(np.asarray(i), ti))
+        assert hits / ti.size > 0.95
+
+    def test_knn_graph_symmetric(self, res):
+        X = RNG.normal(size=(50, 4)).astype(np.float32)
+        g = sparse.knn_graph(res, X, 4)
+        dense = np.asarray(sparse.coo_to_dense(g))
+        np.testing.assert_allclose(dense, dense.T, rtol=1e-5, atol=1e-6)
+        # each row has >= k nonzeros (k out-edges plus mirrored in-edges)
+        assert ((dense > 0).sum(1) >= 4).all()
+
+    def test_connect_components(self, res):
+        # two well-separated blobs with distinct labels
+        X = np.concatenate([RNG.normal(size=(10, 2)),
+                            RNG.normal(size=(10, 2)) + 20]).astype(np.float32)
+        labels = np.asarray([0] * 10 + [1] * 10, np.int32)
+        src, dst, dist = sparse.connect_components(res, X, labels)
+        src, dst = np.asarray(src), np.asarray(dst)
+        valid = src >= 0
+        assert valid.sum() == 2  # one candidate per component
+        for s, t in zip(src[valid], dst[valid]):
+            assert labels[s] != labels[t]
+
+
+class TestSolvers:
+    def test_lanczos_smallest_vs_numpy(self, res):
+        # symmetric PSD matrix
+        A = random_sparse(30, 30, seed=17)
+        A = A @ A.T + np.eye(30, dtype=np.float32)
+        csr = sparse.dense_to_csr(jnp.asarray(A))
+        vals, vecs = sparse.eigsh_smallest(res, csr, 3, ncv=25)
+        ref = np.linalg.eigvalsh(A)[:3]
+        np.testing.assert_allclose(np.sort(np.asarray(vals)), ref,
+                                   rtol=1e-2, atol=1e-2)
+        # residuals ||A v - λ v|| small
+        for j in range(3):
+            v = np.asarray(vecs[:, j])
+            lam = float(vals[j])
+            assert np.linalg.norm(A @ v - lam * v) < 0.1 * max(1, abs(lam))
+
+    def test_lanczos_largest(self, res):
+        A = random_sparse(25, 25, seed=18)
+        A = (A + A.T) / 2
+        csr = sparse.dense_to_csr(jnp.asarray(A))
+        vals, _ = sparse.eigsh_largest(res, csr, 2, ncv=22)
+        ref = np.linalg.eigvalsh(A)[::-1][:2]
+        np.testing.assert_allclose(np.asarray(vals), ref, rtol=1e-2,
+                                   atol=1e-2)
+
+    def test_mst_path_graph(self, res):
+        # path graph 0-1-2-3 with increasing weights + one heavy extra edge
+        rows = np.asarray([0, 1, 2, 0, 1, 2, 3, 3], np.int32)
+        cols = np.asarray([1, 2, 3, 3, 0, 1, 2, 0], np.int32)
+        w = np.asarray([1, 2, 3, 10, 1, 2, 3, 10], np.float32)
+        coo = sparse.CooMatrix(jnp.asarray(rows), jnp.asarray(cols),
+                               jnp.asarray(w), (4, 4))
+        src, dst, weight, color = sparse.mst(res, coo)
+        weight = np.asarray(weight)
+        total = weight[np.isfinite(weight)].sum()
+        assert total == 6.0  # 1 + 2 + 3
+        # all vertices in one component
+        assert len(np.unique(np.asarray(color))) == 1
+
+    def test_mst_random_graph_vs_scipy(self, res):
+        try:
+            from scipy.sparse.csgraph import minimum_spanning_tree
+            import scipy.sparse as sp
+        except ImportError:
+            pytest.skip("scipy needed")
+        n = 20
+        d = RNG.random((n, n)).astype(np.float32)
+        d = np.triu(d, 1)
+        full = d + d.T
+        ref = minimum_spanning_tree(sp.csr_matrix(full)).sum()
+        rows, cols = np.nonzero(full)
+        coo = sparse.CooMatrix(jnp.asarray(rows.astype(np.int32)),
+                               jnp.asarray(cols.astype(np.int32)),
+                               jnp.asarray(full[rows, cols]), (n, n))
+        src, dst, weight, color = sparse.mst(res, coo)
+        weight = np.asarray(weight)
+        total = weight[np.isfinite(weight)].sum()
+        np.testing.assert_allclose(total, ref, rtol=1e-4)
+        assert len(np.unique(np.asarray(color))) == 1

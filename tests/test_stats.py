@@ -1,0 +1,187 @@
+"""Stats tests — compare against numpy/scipy/sklearn-style references on
+small random data (the reference's compute-vs-reference pattern, SURVEY.md §4;
+reference tests: cpp/test/stats/*.cu).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu import stats
+
+RNG = np.random.default_rng(0)
+
+
+class TestMoments:
+    def test_mean_stddev_minmax(self):
+        x = RNG.normal(size=(200, 8)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(stats.mean(x)), x.mean(0),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(stats.stddev(x)),
+                                   x.std(0, ddof=1), rtol=1e-4)
+        mn, mx = stats.minmax(x)
+        np.testing.assert_allclose(np.asarray(mn), x.min(0))
+        np.testing.assert_allclose(np.asarray(mx), x.max(0))
+
+    def test_meanvar_rowwise(self):
+        x = RNG.normal(size=(50, 30)).astype(np.float32)
+        mu, var = stats.meanvar(x, rowwise=True)
+        np.testing.assert_allclose(np.asarray(mu), x.mean(1), rtol=1e-5,
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(var), x.var(1, ddof=1),
+                                   rtol=1e-4)
+
+    def test_mean_center_add_roundtrip(self):
+        x = RNG.normal(size=(40, 6)).astype(np.float32)
+        c = stats.mean_center(x)
+        np.testing.assert_allclose(np.asarray(c).mean(0), 0, atol=1e-5)
+        back = stats.mean_add(c, stats.mean(x))
+        np.testing.assert_allclose(np.asarray(back), x, rtol=1e-5, atol=1e-5)
+
+    def test_cov(self):
+        x = RNG.normal(size=(300, 5)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(stats.cov(x)),
+                                   np.cov(x, rowvar=False), rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_histogram(self):
+        x = RNG.uniform(0, 10, size=(500, 3)).astype(np.float32)
+        h = np.asarray(stats.histogram(x, 10, lower=0.0, upper=10.0))
+        for c in range(3):
+            ref, _ = np.histogram(x[:, c], bins=10, range=(0, 10))
+            np.testing.assert_array_equal(h[:, c], ref)
+
+    def test_weighted_mean(self):
+        x = RNG.normal(size=(20, 4)).astype(np.float32)
+        w = RNG.uniform(0.1, 1, size=4).astype(np.float32)
+        out = np.asarray(stats.row_weighted_mean(x, w))
+        ref = (x * w[None, :]).sum(1) / w.sum()
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+class TestClusterMetrics:
+    def test_contingency_and_ari_perfect(self):
+        y = RNG.integers(0, 4, 100)
+        ari = stats.adjusted_rand_index(y, y, n_classes_true=4,
+                                       n_classes_pred=4)
+        np.testing.assert_allclose(float(ari), 1.0, atol=1e-6)
+
+    def test_ari_vs_sklearn_formula(self):
+        y1 = np.asarray([0, 0, 1, 1, 2, 2, 2])
+        y2 = np.asarray([0, 0, 1, 2, 2, 2, 2])
+        try:
+            from sklearn.metrics import adjusted_rand_score
+            ref = adjusted_rand_score(y1, y2)
+        except ImportError:
+            ref = 0.6470588235  # precomputed
+        ari = stats.adjusted_rand_index(y1, y2, n_classes_true=3,
+                                       n_classes_pred=3)
+        np.testing.assert_allclose(float(ari), ref, atol=1e-5)
+
+    def test_rand_index(self):
+        y1 = np.asarray([0, 0, 1, 1])
+        y2 = np.asarray([0, 0, 1, 2])
+        # pairs: (01)+ (23)- agree: (01) same/same, (23) same/diff ->
+        # agreements: all pairs except (2,3): 5/6
+        ri = stats.rand_index(y1, y2)
+        np.testing.assert_allclose(float(ri), 5 / 6, atol=1e-6)
+
+    def test_entropy_uniform(self):
+        y = np.repeat(np.arange(4), 25)
+        e = stats.entropy(y, n_classes=4)
+        np.testing.assert_allclose(float(e), np.log(4), atol=1e-5)
+
+    def test_v_measure_homogeneity_completeness(self):
+        y_true = np.asarray([0, 0, 1, 1])
+        y_pred = np.asarray([0, 0, 1, 1])
+        for f in (stats.homogeneity_score, stats.completeness_score,
+                  stats.v_measure):
+            v = f(y_true, y_pred, n_classes_true=2, n_classes_pred=2)
+            np.testing.assert_allclose(float(v), 1.0, atol=1e-5)
+
+    def test_mutual_info_independent(self):
+        y1 = np.asarray([0, 0, 1, 1] * 25)
+        y2 = np.asarray([0, 1, 0, 1] * 25)
+        mi = stats.mutual_info_score(y1, y2, n_classes_true=2,
+                                     n_classes_pred=2)
+        np.testing.assert_allclose(float(mi), 0.0, atol=1e-5)
+
+    def test_silhouette_vs_sklearn(self):
+        x = RNG.normal(size=(60, 4)).astype(np.float32)
+        x[:30] += 5.0
+        labels = np.asarray([0] * 30 + [1] * 30)
+        from raft_tpu.distance.types import DistanceType
+        s = stats.silhouette_score(x, labels, n_clusters=2,
+                                   metric=DistanceType.L2SqrtExpanded)
+        try:
+            from sklearn.metrics import silhouette_score as sk
+            ref = sk(x, labels)
+            np.testing.assert_allclose(float(s), ref, atol=1e-3)
+        except ImportError:
+            assert float(s) > 0.5
+
+    def test_silhouette_batched_matches(self):
+        x = RNG.normal(size=(50, 4)).astype(np.float32)
+        labels = RNG.integers(0, 3, 50)
+        full = stats.silhouette_score(x, labels, n_clusters=3)
+        batched = stats.silhouette_score(x, labels, n_clusters=3, chunk=16)
+        np.testing.assert_allclose(float(full), float(batched), atol=1e-5)
+
+    def test_dispersion(self):
+        centroids = np.asarray([[0.0, 0.0], [2.0, 0.0]], np.float32)
+        sizes = np.asarray([2, 2], np.int32)
+        # global centroid (1,0); disp = sqrt(2*1 + 2*1) = 2
+        d = stats.dispersion(centroids, sizes)
+        np.testing.assert_allclose(float(d), 2.0, atol=1e-6)
+
+
+class TestRegressionMetrics:
+    def test_accuracy(self):
+        a = np.asarray([1, 2, 3, 4])
+        b = np.asarray([1, 2, 0, 4])
+        np.testing.assert_allclose(float(stats.accuracy(a, b)), 0.75)
+
+    def test_r2(self):
+        y = RNG.normal(size=100).astype(np.float32)
+        np.testing.assert_allclose(float(stats.r2_score(y, y)), 1.0,
+                                   atol=1e-6)
+        y_hat = y + RNG.normal(size=100).astype(np.float32) * 0.1
+        r2 = float(stats.r2_score(y, y_hat))
+        assert 0.9 < r2 <= 1.0
+
+    def test_regression_metrics(self):
+        y = np.asarray([1.0, 2.0, 3.0], np.float32)
+        p = np.asarray([1.5, 2.0, 2.0], np.float32)
+        mae, mse, medae = stats.regression_metrics(p, y)
+        np.testing.assert_allclose(float(mae), 0.5, atol=1e-6)
+        np.testing.assert_allclose(float(mse), (0.25 + 0 + 1) / 3, atol=1e-6)
+        np.testing.assert_allclose(float(medae), 0.5, atol=1e-6)
+
+    def test_information_criterion(self):
+        ll = np.asarray([-100.0, -50.0], np.float32)
+        aic = stats.information_criterion_batched(ll, stats.IC_Type.AIC, 3,
+                                                 1000)
+        np.testing.assert_allclose(np.asarray(aic), [206.0, 106.0])
+        bic = stats.information_criterion_batched(ll, stats.IC_Type.BIC, 3,
+                                                 1000)
+        np.testing.assert_allclose(np.asarray(bic),
+                                   -2 * ll + 3 * np.log(1000), rtol=1e-6)
+
+    def test_kl_divergence(self):
+        p = np.asarray([0.5, 0.5], np.float32)
+        q = np.asarray([0.9, 0.1], np.float32)
+        ref = (0.5 * np.log(0.5 / 0.9) + 0.5 * np.log(0.5 / 0.1))
+        np.testing.assert_allclose(float(stats.kl_divergence(p, q)), ref,
+                                   rtol=1e-4)
+
+    def test_trustworthiness_identity(self):
+        x = RNG.normal(size=(50, 8)).astype(np.float32)
+        t = stats.trustworthiness_score(x, x, 5)
+        np.testing.assert_allclose(float(t), 1.0, atol=1e-5)
+
+    def test_trustworthiness_random_embedding(self):
+        x = RNG.normal(size=(50, 8)).astype(np.float32)
+        emb = RNG.normal(size=(50, 2)).astype(np.float32)
+        t = float(stats.trustworthiness_score(x, emb, 5))
+        assert t < 0.8
