@@ -30,7 +30,14 @@ def get_unique_labels(labels, *, max_labels: int = 0
     count = jnp.sum(first.astype(jnp.int32))
     # compact the firsts to the front (stable, preserving sorted order)
     order = jnp.argsort(~first, stable=True)
-    uniq = s[order][:m]
+    compact = s[order]
+    if m > n:
+        compact = jnp.pad(compact, (0, m - n), mode="edge")
+    compact = compact[:m]
+    # slots >= count hold leftover duplicates (ascending, NOT the largest
+    # label) — overwrite them with the max label so the array stays sorted
+    # and searchsorted in make_monotonic maps every label to its first slot
+    uniq = jnp.where(jnp.arange(m) < count, compact, s[-1])
     return uniq, count
 
 

@@ -290,7 +290,13 @@ def _train_books_per_cluster(res, flat, flat_labels, n_lists, book, n_iters):
     g = jax.random.gumbel(key, (n_lists, n))
     member = (flat_labels[None, :] == jnp.arange(n_lists)[:, None])
     scores = jnp.where(member, g, -jnp.inf)
-    _, idx = jax.lax.top_k(scores, per)               # (n_lists, per)
+    vals, idx = jax.lax.top_k(scores, per)            # (n_lists, per)
+    # clusters with < per members: top_k falls through to -inf scores whose
+    # indices point at OTHER clusters' rows — replace them by cycling over
+    # the cluster's valid members (top_k sorts valid picks first)
+    n_valid = jnp.sum(vals > -jnp.inf, axis=1)        # (n_lists,)
+    j_mod = jnp.arange(per)[None, :] % jnp.maximum(n_valid, 1)[:, None]
+    idx = jnp.take_along_axis(idx, j_mod, axis=1)
     subsets = flat[idx]                               # (n_lists, per, len)
     keys = jax.random.split(res.next_key(), n_lists)
 

@@ -7,10 +7,11 @@ single-linkage) — SURVEY.md §2.5.
 
 TPU design: both are fixed-iteration jittable loops —
 
-- **Lanczos**: classic tridiagonalization with full reorthogonalization
-  (the reference restarts; full reorth at these m is cheaper than restart
-  logic and is XLA-friendly: one (m, n) panel matmul per step).  The small
-  (m, m) tridiagonal eigenproblem solves with ``jnp.linalg.eigh``.
+- **Lanczos**: thick-restart Lanczos (the analogue of the reference's
+  ``restartIter``/``maxIter`` restarted solver) with two-pass full
+  reorthogonalization — XLA-friendly: one (m, n) panel matmul per step,
+  one (m, m) ``jnp.linalg.eigh`` per restart.  ``lanczos_tridiag`` (the
+  single-cycle tridiagonalization) stays exported as a building block.
 - **Boruvka**: edge-list halving — each round every component picks its
   minimum outgoing edge (``segment_min`` over encoded weight+id keys),
   merges via iterated pointer jumping (log-depth label propagation).
@@ -35,6 +36,20 @@ from raft_tpu.sparse.linalg import spmv
 # Lanczos
 # ---------------------------------------------------------------------------
 
+def _breakdown_direction(Vm: jax.Array, n: int, i) -> jax.Array:
+    """Fresh deterministic unit direction orthogonal to the masked panel.
+
+    Used on β-breakdown (invariant subspace found): continuing with a zero
+    vector would append spurious zero eigenvalues to the projected matrix —
+    poison for "smallest" queries.  The ~0 beta splits it into honest
+    diagonal blocks instead.
+    """
+    r = jnp.cos(jnp.arange(n, dtype=jnp.float32) * (1.37 + i))
+    r = r - Vm.T @ (Vm @ r)
+    r = r - Vm.T @ (Vm @ r)
+    return r / jnp.maximum(jnp.linalg.norm(r), 1e-30)
+
+
 def lanczos_tridiag(
     matvec: Callable[[jax.Array], jax.Array],
     n: int,
@@ -45,18 +60,21 @@ def lanczos_tridiag(
 
     def step(carry, i):
         V, alpha, beta, v_prev, v = carry
+        V = V.at[i].set(v)     # panel now includes v_0..v_i (incl. current)
         w = matvec(v)
         a = jnp.dot(w, v)
         w = w - a * v - jnp.where(i > 0, beta[jnp.maximum(i - 1, 0)],
                                   0.0) * v_prev
-        # full reorthogonalization against the panel built so far
+        # full reorthogonalization against the panel incl. the current
+        # vector; two passes ("twice is enough") — one fp32 pass leaves
+        # enough drift to skew the smallest Ritz values at near-full ncv
         mask = (jnp.arange(m) <= i)[:, None]
-        proj = (V * mask) @ w
-        w = w - (V * mask).T @ proj
+        Vm = V * mask
+        for _ in range(2):
+            w = w - Vm.T @ (Vm @ w)
         b = jnp.linalg.norm(w)
-        v_next = jnp.where(b > 1e-10, w / jnp.maximum(b, 1e-30),
-                           jnp.zeros_like(w))
-        V = V.at[i].set(v)
+        v_next = jnp.where(b > 1e-7, w / jnp.maximum(b, 1e-30),
+                           _breakdown_direction(Vm, n, i))
         alpha = alpha.at[i].set(a)
         beta = jnp.where(i < m - 1, beta.at[jnp.minimum(i, m - 2)].set(b),
                          beta)
@@ -71,18 +89,94 @@ def lanczos_tridiag(
     return V, alpha, beta
 
 
-def _eig_from_tridiag(V, alpha, beta, n_components, largest):
-    m = alpha.shape[0]
-    T = (jnp.diag(alpha) + jnp.diag(beta[:m - 1], 1)
-         + jnp.diag(beta[:m - 1], -1))
-    evals, evecs = jnp.linalg.eigh(T)        # ascending
-    if largest:
-        evals = evals[::-1]
-        evecs = evecs[:, ::-1]
-    ritz = V.T @ evecs[:, :n_components]     # (n, k)
-    norms = jnp.linalg.norm(ritz, axis=0)
-    ritz = ritz / jnp.maximum(norms, 1e-30)
-    return evals[:n_components], ritz
+def _thick_restart_lanczos(mv, n, k, m, v0, largest, max_restarts, tol):
+    """Thick-restart Lanczos (Wu & Simon) — the analogue of the reference's
+    restarted solver (lanczos.cuh ``restartIter``/``maxIter`` parameters).
+
+    Keeps the projected operator as a full symmetric (m, m) matrix H (the
+    locked block after a restart is an arrowhead, not tridiagonal) and the
+    basis panel V (m, n).  Each cycle fills columns ``start..m-1`` of H via
+    two-pass Gram–Schmidt projections (the projections ARE the H entries,
+    so no three-term recurrence is relied on).  At restart, the ``l`` best
+    Ritz pairs are locked: V[:l] <- Ritz vectors, H[:l,:l] <- diag(theta),
+    and the cycle continues from index l with the residual vector — the
+    coupling column H[:l, l] falls out of the projections automatically.
+    """
+    l = min(k + max(4, k), m - 2)          # locked block size
+
+    def cycle(V, H, v, start):
+        def step(carry, j):
+            V, H, v = carry
+
+            def do(args):
+                V, H, v = args
+                V = V.at[j].set(v)
+                w = mv(v)
+                mask = (jnp.arange(m) <= j)[:, None]
+                Vm = V * mask
+                p1 = Vm @ w
+                w = w - Vm.T @ p1
+                p2 = Vm @ w
+                w = w - Vm.T @ p2
+                H = H.at[:, j].set(p1 + p2)
+                b = jnp.linalg.norm(w)
+                v_next = jnp.where(b > 1e-7, w / jnp.maximum(b, 1e-30),
+                                   _breakdown_direction(Vm, n, j))
+                return (V, H, v_next), b
+
+            def skip(args):
+                return args, jnp.float32(0)
+
+            (V, H, v), b = jax.lax.cond(j >= start, do, skip, (V, H, v))
+            return (V, H, v), b
+
+        (V, H, v), bs = jax.lax.scan(step, (V, H, v), jnp.arange(m))
+        return V, H, v, bs[m - 1]
+
+    def ritz(H):
+        Hs = jnp.triu(H) + jnp.triu(H, 1).T
+        evals, S = jnp.linalg.eigh(Hs)      # ascending
+        if largest:
+            evals, S = evals[::-1], S[:, ::-1]
+        return evals, S
+
+    # one (m, m) eigh per iteration: body computes the Ritz decomposition
+    # once, uses it for both the convergence estimate (sets the done flag
+    # read by cond) and the restart itself
+    def body(state):
+        V, H, v, b_last, it, _ = state
+        evals, S = ritz(H)
+        scale = jnp.maximum(jnp.abs(evals[:k]), 1e-6)
+        resid = jnp.max(jnp.abs(b_last * S[m - 1, :k]) / scale)
+
+        def do(args):
+            V, H, v = args
+            Y = S[:, :l].T @ V              # (l, n) locked Ritz vectors
+            Vn = jnp.zeros_like(V).at[:l].set(Y)
+            Hn = jnp.zeros_like(H).at[jnp.arange(l), jnp.arange(l)].set(
+                evals[:l])
+            return cycle(Vn, Hn, v, l)
+
+        V, H, v, b_last = jax.lax.cond(
+            resid > tol, do, lambda args: (args[0], args[1], args[2], b_last),
+            (V, H, v))
+        return V, H, v, b_last, it + 1, resid <= tol
+
+    def cond(state):
+        it, done = state[4], state[5]
+        return jnp.logical_and(it < max_restarts, jnp.logical_not(done))
+
+    v = v0 / jnp.maximum(jnp.linalg.norm(v0), 1e-30)
+    V = jnp.zeros((m, n), jnp.float32)
+    H = jnp.zeros((m, m), jnp.float32)
+    V, H, v, b_last = cycle(V, H, v, 0)
+    V, H, _, _, _, _ = jax.lax.while_loop(
+        cond, body, (V, H, v, b_last, jnp.int32(0), jnp.bool_(False)))
+
+    evals, S = ritz(H)
+    vecs = V.T @ S[:, :k]                   # (n, k)
+    vecs = vecs / jnp.maximum(jnp.linalg.norm(vecs, axis=0), 1e-30)
+    return evals[:k], vecs
 
 
 def eigsh_smallest(
@@ -92,6 +186,8 @@ def eigsh_smallest(
     *,
     ncv: int = 0,
     matvec: Optional[Callable[[jax.Array], jax.Array]] = None,
+    max_restarts: int = 30,
+    tol: float = 1e-5,
     seed: int = 0,
 ) -> Tuple[jax.Array, jax.Array]:
     """Smallest eigenpairs of a symmetric operator
@@ -102,19 +198,20 @@ def eigsh_smallest(
     expects(n is not None, "eigsh_smallest: need a CSR matrix or n via A")
     m = ncv or min(max(2 * n_components + 1, 20), n)
     v0 = jax.random.normal(jax.random.key(seed), (n,), jnp.float32)
-    V, alpha, beta = lanczos_tridiag(mv, n, m, v0)
-    return _eig_from_tridiag(V, alpha, beta, n_components, largest=False)
+    return _thick_restart_lanczos(mv, n, n_components, m, v0, False,
+                                  max_restarts, tol)
 
 
 def eigsh_largest(res, A: CsrMatrix, n_components: int, *, ncv: int = 0,
-                  matvec=None, seed: int = 0):
+                  matvec=None, max_restarts: int = 30, tol: float = 1e-5,
+                  seed: int = 0):
     """Reference: lanczos.cuh ``computeLargestEigenvectors``."""
     n = A.shape[0]
     mv = matvec or (lambda x: spmv(A, x))
     m = ncv or min(max(2 * n_components + 1, 20), n)
     v0 = jax.random.normal(jax.random.key(seed), (n,), jnp.float32)
-    V, alpha, beta = lanczos_tridiag(mv, n, m, v0)
-    return _eig_from_tridiag(V, alpha, beta, n_components, largest=True)
+    return _thick_restart_lanczos(mv, n, n_components, m, v0, True,
+                                  max_restarts, tol)
 
 
 # ---------------------------------------------------------------------------
@@ -145,13 +242,24 @@ def _boruvka(rows, cols, weights, n_vertices):
         dst_c = color[cols]
         cross = src_c != dst_c
         w = jnp.where(cross, weights, big)
-        # segment argmin via min over encoded (weight, id) — ids break ties
-        # deterministically (the reference's alteration step)
-        order = jnp.argsort(w, stable=True)
-        # cheaper: for each component take min weight then first edge achieving it
+        # min outgoing edge per component under the total order
+        # (weight, min(u,v), max(u,v)): tie-breaking on the CANONICAL
+        # undirected key (both directions of an edge compare equal) is what
+        # guarantees equal-weight selections can only form 2-cycles, which
+        # the star contraction below resolves (the reference's "alteration"
+        # step serves the same purpose, mst_solver.cuh)
+        cu = jnp.minimum(rows, cols)
+        cv = jnp.maximum(rows, cols)
         wmin = jax.ops.segment_min(w, src_c, num_segments=n_vertices)
-        is_min = cross & (w <= wmin[src_c] + 0.0)
-        # first edge index per component among is_min
+        is_w = cross & (w <= wmin[src_c])
+        cu_k = jnp.where(is_w, cu, n_vertices)
+        cumin = jax.ops.segment_min(cu_k, src_c, num_segments=n_vertices)
+        is_cu = is_w & (cu == cumin[src_c])
+        cv_k = jnp.where(is_cu, cv, n_vertices)
+        cvmin = jax.ops.segment_min(cv_k, src_c, num_segments=n_vertices)
+        is_min = is_cu & (cv == cvmin[src_c])
+        # first edge index per component among the (now unique-undirected)
+        # minimal edges
         eid = jnp.where(is_min, jnp.arange(n_edges), n_edges)
         emin = jax.ops.segment_min(eid, src_c, num_segments=n_vertices)
         has_edge = emin < n_edges

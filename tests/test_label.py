@@ -1,0 +1,71 @@
+"""Label utilities tests (reference: cpp/test/label/label.cu pattern —
+compute-vs-reference on small arrays)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.label import get_unique_labels, make_monotonic, merge_labels
+
+
+class TestClassLabels:
+    def test_unique_sorted(self):
+        labels = jnp.asarray([5, 3, 3, 9, 5, 1], jnp.int32)
+        uniq, count = get_unique_labels(labels)
+        assert int(count) == 4
+        np.testing.assert_array_equal(np.asarray(uniq)[:4], [1, 3, 5, 9])
+
+    def test_unique_duplicate_heavy_padding(self):
+        # regression: padding slots must hold the LARGEST label (keeping the
+        # array sorted), not leftover ascending duplicates
+        labels = jnp.asarray([1, 1, 1, 1, 1, 2, 3], jnp.int32)
+        uniq, count = get_unique_labels(labels)
+        u = np.asarray(uniq)
+        assert int(count) == 3
+        np.testing.assert_array_equal(u[:3], [1, 2, 3])
+        assert (u[3:] == 3).all()
+        assert (np.diff(u) >= 0).all()
+
+    def test_make_monotonic_duplicate_heavy(self):
+        labels = jnp.asarray([1, 1, 1, 1, 1, 2, 3], jnp.int32)
+        out = np.asarray(make_monotonic(labels))
+        np.testing.assert_array_equal(out, [0, 0, 0, 0, 0, 1, 2])
+
+    def test_make_monotonic_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        labels = rng.choice([7, -3, 42, 0, 19], size=50).astype(np.int32)
+        out = np.asarray(make_monotonic(jnp.asarray(labels)))
+        _, ref = np.unique(labels, return_inverse=True)
+        np.testing.assert_array_equal(out, ref)
+
+    def test_unique_max_labels_exceeds_n(self):
+        labels = jnp.asarray([3, 1, 3, 1], jnp.int32)
+        uniq, count = get_unique_labels(labels, max_labels=6)
+        u = np.asarray(uniq)
+        assert u.shape == (6,)
+        assert int(count) == 2
+        np.testing.assert_array_equal(u[:2], [1, 3])
+        assert (u[2:] == 3).all()
+
+    def test_make_monotonic_one_based(self):
+        labels = jnp.asarray([10, 20, 10], jnp.int32)
+        out = np.asarray(make_monotonic(labels, zero_based=False))
+        np.testing.assert_array_equal(out, [1, 2, 1])
+
+
+class TestMergeLabels:
+    def test_merge_unions_groups(self):
+        # a: {0,1} {2,3}; b: {1,2} — union connects all four
+        a = jnp.asarray([0, 0, 2, 2], jnp.int32)
+        b = jnp.asarray([0, 1, 1, 3], jnp.int32)
+        mask = jnp.ones(4, jnp.bool_)
+        out = np.asarray(merge_labels(a, b, mask))
+        assert len(np.unique(out)) == 1
+
+    def test_merge_respects_mask(self):
+        a = jnp.asarray([0, 0, 2, 2], jnp.int32)
+        b = jnp.asarray([0, 1, 1, 3], jnp.int32)
+        mask = jnp.asarray([True, True, False, True])
+        out = np.asarray(merge_labels(a, b, mask))
+        # row 2 masked out: groups {0,1} and {3} stay separate
+        assert out[0] == out[1]
+        assert out[3] != out[0]

@@ -149,10 +149,17 @@ class TestDistanceNeighbors:
         cb = sparse.dense_to_csr(jnp.asarray(b))
         d, i = sparse.brute_force_knn_sparse(ca, cb, 5)
         ref = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
-        ti = np.argsort(ref, axis=1)[:, :5]
-        hits = sum(len(set(f) & set(t))
-                   for f, t in zip(np.asarray(i), ti))
-        assert hits / ti.size > 0.95
+        # tie-aware exactness (zero rows in a/b produce multi-way distance
+        # ties, so index sets are ambiguous — the reference's ANN tests use
+        # distance-tolerant eval too, ann_utils.cuh:125): every selected
+        # neighbor must be within the true k-th distance, and the returned
+        # distances must equal the true sorted top-k.
+        kth = np.sort(ref, axis=1)[:, 4]
+        picked = np.take_along_axis(ref, np.asarray(i), axis=1)
+        assert (picked <= kth[:, None] + 1e-4).all()
+        np.testing.assert_allclose(np.sort(np.asarray(d), axis=1),
+                                   np.sort(ref, axis=1)[:, :5],
+                                   rtol=1e-3, atol=1e-3)
 
     def test_knn_graph_symmetric(self, res):
         X = RNG.normal(size=(50, 4)).astype(np.float32)
