@@ -1,36 +1,122 @@
-"""Benchmark harness — prints ONE JSON line with the headline metric.
+"""Benchmark harness — prints the headline JSON line (+ secondary lines).
 
-Modeled on the reference's ANN bench summary metrics (cpp/bench/ann/scripts/
-eval.pl:26: QPS at recall=0.9/0.95) and the driver's north-star
-(BASELINE.md): IVF QPS@recall95 on a SIFT-like workload (128-dim, batch 5000,
-k=10 — cpp/bench/ann/conf/sift-128-euclidean.json search_basic_param).
+North-star workload (BASELINE.md config 4, mirroring the reference's
+cpp/bench/ann/conf/sift-128-euclidean.json): IVF-PQ build + search on a
+SIFT-1M-scale synthetic set — 1M x 128 fp32, n_lists=4096, pq_dim=64,
+batch=5000, k=10, run_count=3 — reporting QPS at recall >= 0.95
+(cpp/bench/ann/scripts/eval.pl:26 "QPS at recall=0.95").  The harness sweeps
+n_probes upward and reports the fastest operating point that clears the
+recall bar, exactly how the reference harness picks its summary row.
 
-Until IVF-PQ lands this measures IVF-Flat, the closest built stage of the
-flagship pipeline.  ``vs_baseline`` is QPS / 2000 — the reference harness's
-own "recall at QPS=2000" operating point (eval.pl:26) used as the provisional
-scale until driver-recorded baselines exist (BASELINE.json ``published`` is
-``{}``).
+Second line: k-means fit iterations/s at 1M x 128, k=1024 (BASELINE.md
+config 3; reference micro-bench cpp/bench/prims/cluster/kmeans.cu).
+
+``vs_baseline`` is QPS / 2000 — the reference harness's own
+"recall at QPS=2000" operating point (eval.pl:26) used as the provisional
+scale until driver-recorded baselines exist (BASELINE.json ``published``
+is ``{}``).
 """
 
 import json
 import time
 
-import jax
 import numpy as np
 
-N_DB = int(100_000)
+N_DB = 1_000_000
 N_QUERIES = 5_000
 DIM = 128
 K = 10
-N_LISTS = 1024
-N_PROBES = 32
+N_LISTS = 4096
+PQ_DIM = 64
+PROBE_SWEEP = (32, 64, 128)
 MIN_RECALL = 0.95
-QPS_REFERENCE_POINT = 2000.0  # eval.pl:26 "recall at QPS=2000" condition
+RUNS = 3                       # run_count=3, sift-128-euclidean.json
+QPS_REFERENCE_POINT = 2000.0   # eval.pl:26 "recall at QPS=2000" condition
+
+KMEANS_N = 1_000_000
+KMEANS_K = 1024
+KMEANS_ITERS = 20
+
+
+def _recall(found: np.ndarray, gt: np.ndarray) -> float:
+    hits = sum(len(set(f) & set(t)) for f, t in zip(found, gt))
+    return hits / gt.size
+
+
+def bench_ivf_pq(res, db, queries) -> dict:
+    from raft_tpu.neighbors import brute_force, ivf_pq
+
+    # ground truth (the bench's naive_knn analogue)
+    _, gt_i = brute_force.knn(res, db, queries, K)
+    gt_i = np.asarray(gt_i)
+
+    params = ivf_pq.IndexParams(n_lists=N_LISTS, pq_dim=PQ_DIM,
+                                kmeans_n_iters=20)
+    t0 = time.perf_counter()
+    index = ivf_pq.build(res, params, db)
+    index.list_codes.block_until_ready()
+    build_s = time.perf_counter() - t0
+
+    best = None
+    for n_probes in PROBE_SWEEP:
+        sp = ivf_pq.SearchParams(n_probes=n_probes)
+        d, i = ivf_pq.search(res, sp, index, queries, K)   # warmup/compile
+        i.block_until_ready()
+        recall = _recall(np.asarray(i), gt_i)
+        t0 = time.perf_counter()
+        for _ in range(RUNS):
+            d, i = ivf_pq.search(res, sp, index, queries, K)
+        i.block_until_ready()
+        qps = N_QUERIES / ((time.perf_counter() - t0) / RUNS)
+        point = {"n_probes": n_probes, "recall": round(recall, 4),
+                 "qps": round(qps, 1)}
+        if recall >= MIN_RECALL and (best is None or qps > best["qps"]):
+            best = point
+        last = point
+    chosen = best or last
+    met = chosen["recall"] >= MIN_RECALL
+    return {
+        "metric": (f"ivf_pq_qps@recall{MIN_RECALL:.2f}" if met
+                   else f"ivf_pq_qps@recall={chosen['recall']:.3f}"
+                        "(below_target)"),
+        "value": chosen["qps"],
+        "unit": "queries/s",
+        "vs_baseline": round(chosen["qps"] / QPS_REFERENCE_POINT, 3),
+        "detail": {"n_db": N_DB, "dim": DIM, "n_lists": N_LISTS,
+                   "pq_dim": PQ_DIM, "batch": N_QUERIES, "k": K,
+                   "build_s": round(build_s, 1),
+                   "operating_point": chosen},
+    }
+
+
+def bench_kmeans(res, X) -> dict:
+    from raft_tpu.cluster import kmeans
+    from raft_tpu.cluster.kmeans_types import InitMethod, KMeansParams
+
+    # Random init + tol=0: the timed region is KMEANS_ITERS Lloyd
+    # iterations (iter/s is the metric; ++ init would dominate the timing)
+    params = KMeansParams(n_clusters=KMEANS_K, max_iter=KMEANS_ITERS,
+                          tol=0.0, n_init=1, init=InitMethod.Random)
+    c, _, _ = kmeans.fit(res, params, X)       # warmup/compile
+    c.block_until_ready()
+    t0 = time.perf_counter()
+    c, inertia, n_iter = kmeans.fit(res, params, X)
+    c.block_until_ready()
+    elapsed = time.perf_counter() - t0
+    iters_per_s = KMEANS_ITERS / elapsed
+    return {
+        "metric": "kmeans_iters_per_s_1Mx128_k1024",
+        "value": round(iters_per_s, 3),
+        "unit": "iter/s",
+        "vs_baseline": round(iters_per_s, 3),
+        "detail": {"n": KMEANS_N, "dim": DIM, "k": KMEANS_K,
+                   "n_iter": KMEANS_ITERS,
+                   "fit_s": round(elapsed, 2)},
+    }
 
 
 def main() -> None:
     from raft_tpu import DeviceResources
-    from raft_tpu.neighbors import brute_force, ivf_flat
     from raft_tpu.random import make_blobs
 
     res = DeviceResources(seed=0)
@@ -39,38 +125,8 @@ def main() -> None:
     db, queries = X[:N_DB], X[N_DB:]
     db.block_until_ready()
 
-    # ground truth for recall (the bench's naive_knn analogue)
-    gt_d, gt_i = brute_force.knn(res, db, queries, K)
-    gt_i = np.asarray(gt_i)
-
-    params = ivf_flat.IndexParams(n_lists=N_LISTS, kmeans_n_iters=20)
-    index = ivf_flat.build(res, params, db)
-
-    sp = ivf_flat.SearchParams(n_probes=N_PROBES)
-    # warmup (compile)
-    d, i = ivf_flat.search(res, sp, index, queries, K)
-    i.block_until_ready()
-
-    runs = 3  # run_count=3, sift-128-euclidean.json
-    t0 = time.perf_counter()
-    for _ in range(runs):
-        d, i = ivf_flat.search(res, sp, index, queries, K)
-    i.block_until_ready()
-    elapsed = (time.perf_counter() - t0) / runs
-
-    found = np.asarray(i)
-    hits = sum(len(set(f) & set(t)) for f, t in zip(found, gt_i))
-    recall = hits / gt_i.size
-    qps = N_QUERIES / elapsed
-
-    print(json.dumps({
-        "metric": f"ivf_flat_qps@recall{MIN_RECALL:.2f}"
-                  if recall >= MIN_RECALL else
-                  f"ivf_flat_qps@recall={recall:.3f}(below_target)",
-        "value": round(qps, 1),
-        "unit": "queries/s",
-        "vs_baseline": round(qps / QPS_REFERENCE_POINT, 3),
-    }))
+    print(json.dumps(bench_ivf_pq(res, db, queries)), flush=True)
+    print(json.dumps(bench_kmeans(res, db[:KMEANS_N])), flush=True)
 
 
 if __name__ == "__main__":

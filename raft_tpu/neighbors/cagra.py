@@ -13,12 +13,14 @@ top-M buffer and a hashmap visited set.
 TPU design (SURVEY.md §7 flags this as the XLA-hostile one):
 
 - **build** composes the existing IVF-PQ + refine exactly like the reference;
-- **prune** keeps the reference's *rank-based detour* criterion in vectorized
-  form: edge (i→j) is detourable if some higher-ranked neighbor k of i has j
-  among ITS higher-ranked neighbors (a 2-hop path of strictly stronger
-  edges).  One batched membership test per node block — no host loops.
-  Reverse edges then fill remaining degree slots (graph_core.cuh's
-  reverse-edge pass);
+- **prune** keeps the reference's *rank-based detour* criterion, computed in
+  node blocks (``lax.map``): per block, neighbor-of-neighbor lists are
+  sorted once and membership resolves by ``searchsorted`` —
+  O(B·deg²·log deg) and O(B·deg²) memory, never the naive
+  (n, deg, deg, deg) tensor.  The reverse-edge pass
+  (graph_core.cuh's rev_graph) is a device-side sort-based bucketing:
+  edges sorted by (dst, rank) and scattered into per-node reverse slots;
+  leftover slots take the next-best pruned-out forward edges;
 - **search** replaces the data-dependent walk + hashmap with a
   fixed-iteration ``lax.while_loop`` over a static (q, itopk) candidate
   buffer: each step expands the best unvisited candidates' adjacency rows
@@ -155,32 +157,78 @@ def build_knn_graph(
         return knn[:, :intermediate_degree].astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("graph_degree",))
-def _prune_impl(knn_graph, graph_degree):
-    """Rank-based detour pruning (graph_core.cuh:415 ``prune``).
+@functools.partial(jax.jit, static_argnames=("block",))
+def _detour_order(knn_graph, block=256):
+    """Rank-based detour ordering (graph_core.cuh:415 ``prune``).
 
     Edge i→knn[i,r] is *detourable* when ∃ r' < r with knn[i,r'] = k and
-    knn[i,r] ∈ knn[k, :r''] for small r'' — i.e. a 2-hop path through a
-    stronger edge on both hops.  We count, for each edge (i, r), how many
-    higher-ranked neighbors k of i contain j in their own top ranks; edges
-    with the fewest detours win the degree slots (ties → lower rank wins,
-    preserving the reference's rank ordering).
+    knn[i,r] ∈ knn[k, :] — a 2-hop path whose first hop is a strictly
+    stronger edge.  Edges are ordered by (detour_count, original rank);
+    callers slice the first ``graph_degree`` columns.
+
+    Blocked: ``lax.map`` over node blocks; per block the neighbor-of-
+    neighbor lists (B, deg, deg) are sorted once and each membership
+    resolves via ``searchsorted`` — O(B·deg²) memory, no
+    (n, deg, deg, deg) intermediate (that is ~2×10¹⁵ elements at the
+    reference's 1M×128 defaults).
     """
     n, deg = knn_graph.shape
-    # detour_count[i, r] = #{r' < r : j_r ∈ knn[knn[i, r'], :]}
-    neigh_of_neigh = knn_graph[knn_graph]            # (n, deg, deg)
-    j = knn_graph[:, :, None, None]                  # (n, deg, 1, 1)
-    # membership of j_r in the lists of i's stronger neighbors:
-    hit = (neigh_of_neigh[:, None, :, :] == j)       # (n, deg_r, deg_r', deg)
     rank = jnp.arange(deg)
-    stronger = rank[None, :, None] > rank[None, None, :]  # r > r'
-    detours = jnp.sum(jnp.any(hit, axis=-1) & stronger[..., :],
-                      axis=-1)                       # (n, deg)
-    # order edges by (detour_count, original rank)
-    score = detours * deg + rank[None, :]
-    order = jnp.argsort(score, axis=1)
-    pruned = jnp.take_along_axis(knn_graph, order[:, :graph_degree], axis=1)
-    return pruned
+    n_pad = ((n + block - 1) // block) * block
+    knn_p = jnp.pad(knn_graph, ((0, n_pad - n), (0, 0)))
+    blocks = knn_p.reshape(n_pad // block, block, deg)
+
+    def one_block(kb):                               # (B, deg)
+        non = knn_graph[jnp.clip(kb, 0, n - 1)]      # (B, deg, deg)
+        snon = jnp.sort(non, axis=-1)
+
+        def row_member(sn, keys):
+            # sn (deg, deg) row-sorted; keys (deg,) -> member (deg_rp, deg_r)
+            idx = jax.vmap(lambda s: jnp.searchsorted(s, keys))(sn)
+            vals = jnp.take_along_axis(sn, jnp.clip(idx, 0, deg - 1), axis=1)
+            return vals == keys[None, :]
+
+        member = jax.vmap(row_member)(snon, kb)      # (B, rp, r)
+        stronger = rank[:, None] < rank[None, :]     # first hop rp < r
+        detours = jnp.sum(member & stronger[None], axis=1)   # (B, deg)
+        score = detours * deg + rank[None, :]
+        order = jnp.argsort(score, axis=1)
+        return jnp.take_along_axis(kb, order, axis=1)
+
+    out = jax.lax.map(one_block, blocks)
+    return out.reshape(n_pad, deg)[:n]
+
+
+@functools.partial(jax.jit, static_argnames=("n", "rev_cap"))
+def _reverse_edges(fwd, n, rev_cap):
+    """Device-side reverse-edge lists (graph_core.cuh rev_graph).
+
+    For each directed edge (i→j), j collects i into up to ``rev_cap``
+    reverse slots, strongest (lowest-rank) edges first: sort all edges by
+    (dst, rank) via two stable argsorts, compute each edge's position
+    within its dst group, and scatter the first ``rev_cap`` per group.
+    """
+    half = fwd.shape[1]
+    # rank-major edge order is a transpose, not a sort; the single stable
+    # argsort by dst then yields (dst asc, rank asc) order
+    dst = fwd.T.ravel()
+    src = jnp.tile(jnp.arange(n, dtype=jnp.int32), half)
+    o = jnp.argsort(dst, stable=True)
+    dsts = dst[o]
+    srcs = src[o]
+    e = dsts.shape[0]
+    # position within each dst group: running max of group-start indices
+    first = jnp.concatenate(
+        [jnp.ones(1, jnp.bool_), dsts[1:] != dsts[:-1]])
+    starts = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(first, jnp.arange(e), 0))
+    pos = jnp.arange(e) - starts
+    keep = (pos < rev_cap) & (dsts >= 0) & (dsts < n)
+    row = jnp.where(keep, dsts, n)                   # n = dummy row
+    col = jnp.clip(pos, 0, rev_cap - 1)
+    rev = jnp.full((n + 1, rev_cap), -1, jnp.int32)
+    rev = rev.at[row, col].set(jnp.where(keep, srcs, -1))
+    return rev[:n]
 
 
 def prune(res, knn_graph, graph_degree: int) -> jax.Array:
@@ -192,27 +240,21 @@ def prune(res, knn_graph, graph_degree: int) -> jax.Array:
         n, deg = knn_graph.shape
         expects(graph_degree <= deg,
                 "cagra.prune: graph_degree > intermediate degree")
-        forward = _prune_impl(knn_graph, max(graph_degree // 2, 1)
-                              if graph_degree < deg else graph_degree)
-        if forward.shape[1] == graph_degree:
-            return forward
-        # reverse-edge pass (graph_core.cuh rev_graph): nodes pointed *at*
-        # point back, filling the remaining slots
-        half = forward.shape[1]
-        rev_lists = np.full((n, graph_degree - half), -1, np.int32)
-        rev_count = np.zeros(n, np.int32)
-        fwd = np.asarray(forward)
-        for i in range(n):
-            for j in fwd[i]:
-                if 0 <= j < n and rev_count[j] < rev_lists.shape[1]:
-                    rev_lists[j, rev_count[j]] = i
-                    rev_count[j] += 1
-        out = np.concatenate([fwd, rev_lists], axis=1)
-        # fill any -1 slots with wrap-around of forward edges
-        for i in range(n):
-            fill = fwd[i, 0]
-            out[i][out[i] < 0] = fill
-        return jnp.asarray(out, jnp.int32)
+        ordered = _detour_order(knn_graph)
+        half = (max(graph_degree // 2, 1) if graph_degree < deg
+                else graph_degree)
+        fwd = ordered[:, :half]
+        if half == graph_degree:
+            return fwd
+        rev_cap = graph_degree - half
+        rev = _reverse_edges(fwd, n, rev_cap)
+        # leftover slots: next-best pruned-out forward edges (not a repeat
+        # of one edge — that wastes degree budget)
+        fillers = ordered[:, half:half + rev_cap]
+        cand = jnp.concatenate([rev, fillers], axis=1)
+        sel = jnp.argsort(cand < 0, axis=1, stable=True)[:, :rev_cap]
+        rest = jnp.take_along_axis(cand, sel, axis=1)
+        return jnp.concatenate([fwd, rest], axis=1)
 
 
 def build(res, params: IndexParams, dataset) -> Index:

@@ -8,7 +8,7 @@ slice, without hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,6 +17,20 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+# A sitecustomize hook on this machine imports jax at interpreter startup
+# (registering the TPU-tunnel plugin), so the env mutations above can be too
+# late — jax.config snapshots JAX_PLATFORMS at import.  config.update works
+# post-import; XLA_FLAGS is read later, at first backend init, so the env
+# var set above still provides the 8 virtual CPU devices.
+jax.config.update("jax_platforms", "cpu")
+# persistent compilation cache: jit compiles dominate suite runtime on the
+# CPU box; cache hits cut repeat runs to seconds
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                 "/tmp/raft_tpu_jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 
 @pytest.fixture
