@@ -189,13 +189,15 @@ def eigsh_smallest(
     max_restarts: int = 30,
     tol: float = 1e-5,
     seed: int = 0,
+    n: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Smallest eigenpairs of a symmetric operator
     (reference: lanczos.cuh ``computeSmallestEigenvectors``).
+    Matrix-free use: pass ``matvec`` + ``n`` with ``A=None``.
     Returns (eigenvalues (k,), eigenvectors (n, k))."""
-    n = A.shape[0] if A is not None else None
+    n = A.shape[0] if A is not None else n
     mv = matvec or (lambda x: spmv(A, x))
-    expects(n is not None, "eigsh_smallest: need a CSR matrix or n via A")
+    expects(n is not None, "eigsh_smallest: need a CSR matrix or explicit n")
     m = ncv or min(max(2 * n_components + 1, 20), n)
     v0 = jax.random.normal(jax.random.key(seed), (n,), jnp.float32)
     return _thick_restart_lanczos(mv, n, n_components, m, v0, False,
@@ -204,9 +206,10 @@ def eigsh_smallest(
 
 def eigsh_largest(res, A: CsrMatrix, n_components: int, *, ncv: int = 0,
                   matvec=None, max_restarts: int = 30, tol: float = 1e-5,
-                  seed: int = 0):
+                  seed: int = 0, n: Optional[int] = None):
     """Reference: lanczos.cuh ``computeLargestEigenvectors``."""
-    n = A.shape[0]
+    n = A.shape[0] if A is not None else n
+    expects(n is not None, "eigsh_largest: need a CSR matrix or explicit n")
     mv = matvec or (lambda x: spmv(A, x))
     m = ncv or min(max(2 * n_components + 1, 20), n)
     v0 = jax.random.normal(jax.random.key(seed), (n,), jnp.float32)

@@ -1,0 +1,25 @@
+"""Spectral graph partitioning (reference: cpp/include/raft/spectral/)."""
+
+from raft_tpu.spectral.partition import (
+    ClusterSolverConfig,
+    EigenSolverConfig,
+    KMeansSolver,
+    LanczosSolver,
+    analyze_modularity,
+    analyze_partition,
+    fit_embedding,
+    modularity_maximization,
+    partition,
+)
+
+__all__ = [
+    "ClusterSolverConfig",
+    "EigenSolverConfig",
+    "KMeansSolver",
+    "LanczosSolver",
+    "analyze_modularity",
+    "analyze_partition",
+    "fit_embedding",
+    "modularity_maximization",
+    "partition",
+]

@@ -1,0 +1,129 @@
+"""Spectral partitioning tests.
+
+Pattern: compute-vs-reference on structured random graphs (reference tests:
+cpp/test/cluster/, sklearn.SpectralClustering as the oracle where
+available).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import spectral
+from raft_tpu.sparse.formats import dense_to_coo
+from raft_tpu.stats import adjusted_rand_index
+
+K_BLOCKS = 3
+BLOCK = 30
+N = K_BLOCKS * BLOCK
+
+
+def block_graph(seed=0, p_in=0.6, p_out=0.02):
+    """Planted-partition adjacency: dense blocks, sparse across."""
+    rng = np.random.default_rng(seed)
+    labels = np.repeat(np.arange(K_BLOCKS), BLOCK)
+    same = labels[:, None] == labels[None, :]
+    p = np.where(same, p_in, p_out)
+    a = (rng.random((N, N)) < p).astype(np.float32)
+    a = np.triu(a, 1)
+    a = a + a.T
+    return a, labels
+
+
+@pytest.fixture(scope="module")
+def graph():
+    return block_graph()
+
+
+def _solvers(n_eig, n_clusters):
+    es = spectral.LanczosSolver(
+        spectral.EigenSolverConfig(n_eig_vecs=n_eig, max_iter=60, tol=1e-4))
+    cs = spectral.KMeansSolver(
+        spectral.ClusterSolverConfig(n_clusters=n_clusters, max_iter=100))
+    return es, cs
+
+
+class TestPartition:
+    def test_recovers_planted_blocks(self, res, graph):
+        a, labels = graph
+        adj = dense_to_coo(jnp.asarray(a))
+        es, cs = _solvers(K_BLOCKS, K_BLOCKS)
+        clusters, eig_vals, eig_vecs, _ = spectral.partition(res, adj, es, cs)
+        assert eig_vecs.shape == (N, K_BLOCKS)
+        # Laplacian eigenvalues are >= 0, smallest ~0 (connected-ish graph)
+        assert float(eig_vals[0]) < float(eig_vals[-1]) + 1e-6
+        ari = adjusted_rand_index(jnp.asarray(labels), clusters,
+                                  n_classes_true=K_BLOCKS,
+                                  n_classes_pred=K_BLOCKS)
+        assert float(ari) > 0.95
+
+    def test_matches_sklearn(self, res, graph):
+        sklearn = pytest.importorskip("sklearn.cluster")
+        a, labels = graph
+        ref = sklearn.SpectralClustering(
+            n_clusters=K_BLOCKS, affinity="precomputed",
+            random_state=0).fit_predict(a)
+        adj = dense_to_coo(jnp.asarray(a))
+        es, cs = _solvers(K_BLOCKS, K_BLOCKS)
+        clusters, _, _, _ = spectral.partition(res, adj, es, cs)
+        ari = adjusted_rand_index(jnp.asarray(ref), clusters,
+                                  n_classes_true=K_BLOCKS,
+                                  n_classes_pred=K_BLOCKS)
+        assert float(ari) > 0.9
+
+    def test_analyze_partition(self, res, graph):
+        a, labels = graph
+        adj = dense_to_coo(jnp.asarray(a))
+        cut_true, cost_true = spectral.analyze_partition(
+            res, adj, K_BLOCKS, jnp.asarray(labels))
+        rng = np.random.default_rng(1)
+        rand = rng.integers(0, K_BLOCKS, N)
+        cut_rand, cost_rand = spectral.analyze_partition(
+            res, adj, K_BLOCKS, jnp.asarray(rand))
+        # planted partition cuts far fewer edges than a random one
+        assert float(cut_true) < float(cut_rand)
+        assert float(cost_true) < float(cost_rand)
+        # edge_cut equals the direct count of cross-block edge weight
+        cross = a * (labels[:, None] != labels[None, :])
+        np.testing.assert_allclose(float(cut_true), cross.sum() / 2.0,
+                                   rtol=1e-4)
+
+
+class TestModularity:
+    def test_modularity_maximization(self, res, graph):
+        a, labels = graph
+        adj = dense_to_coo(jnp.asarray(a))
+        es, cs = _solvers(K_BLOCKS, K_BLOCKS)
+        clusters, _, _, _ = spectral.modularity_maximization(res, adj, es, cs)
+        ari = adjusted_rand_index(jnp.asarray(labels), clusters,
+                                  n_classes_true=K_BLOCKS,
+                                  n_classes_pred=K_BLOCKS)
+        assert float(ari) > 0.9
+
+    def test_analyze_modularity(self, res, graph):
+        a, labels = graph
+        adj = dense_to_coo(jnp.asarray(a))
+        q_true = spectral.analyze_modularity(res, adj, K_BLOCKS,
+                                             jnp.asarray(labels))
+        rng = np.random.default_rng(2)
+        q_rand = spectral.analyze_modularity(
+            res, adj, K_BLOCKS, jnp.asarray(rng.integers(0, K_BLOCKS, N)))
+        assert float(q_true) > 0.3         # strong community structure
+        assert float(q_true) > float(q_rand)
+        # cross-check against the direct dense formula
+        d = a.sum(axis=1)
+        two_m = d.sum()
+        b = a - np.outer(d, d) / two_m
+        onehot = np.eye(K_BLOCKS)[labels]
+        q_ref = np.trace(onehot.T @ b @ onehot) / two_m
+        np.testing.assert_allclose(float(q_true), q_ref, rtol=1e-3,
+                                   atol=1e-5)
+
+
+class TestEmbedding:
+    def test_fit_embedding_shape_and_separation(self, res, graph):
+        a, labels = graph
+        adj = dense_to_coo(jnp.asarray(a))
+        emb = spectral.fit_embedding(res, adj, 3)
+        assert emb.shape == (N, 3)
+        assert bool(jnp.all(jnp.isfinite(emb)))
