@@ -16,11 +16,13 @@ idiomatically for TPU:
   a comms abstraction over XLA collectives on a ``jax.sharding.Mesh`` (ICI/DCN).
 """
 
+from raft_tpu import config  # noqa: F401
 from raft_tpu.core import (  # noqa: F401
     Resources,
     DeviceResources,
     RaftError,
     expects,
 )
+from raft_tpu.core.outputs import auto_convert_output  # noqa: F401
 
 __version__ = "0.1.0"

@@ -38,6 +38,7 @@ from raft_tpu.distance.fused_l2_nn import fused_l2_nn
 from raft_tpu.distance.pairwise import pairwise_distance
 from raft_tpu.distance.types import DistanceType
 from raft_tpu.utils.precision import get_matmul_precision
+from raft_tpu.core.outputs import auto_convert_output
 
 
 # ---------------------------------------------------------------------------
@@ -228,6 +229,7 @@ def _lloyd(X, centroids0, sample_weight, tol, n_clusters, max_iter, metric):
     return centroids, inertia, n_iter, labels
 
 
+@auto_convert_output
 def fit(
     res,
     params: KMeansParams,
@@ -270,6 +272,7 @@ def fit(
         return best
 
 
+@auto_convert_output
 def predict(
     res,
     params: KMeansParams,
@@ -292,6 +295,7 @@ def predict(
     return labels, jnp.sum(dists * w)
 
 
+@auto_convert_output
 def fit_predict(res, params: KMeansParams, X,
                 sample_weight: Optional[jax.Array] = None,
                 centroids: Optional[jax.Array] = None):
@@ -302,6 +306,7 @@ def fit_predict(res, params: KMeansParams, X,
     return labels, centroids, inertia, n_iter
 
 
+@auto_convert_output
 def transform(res, params: KMeansParams, X, centroids) -> jax.Array:
     """Distance from every sample to every centroid (reference: kmeans.cuh:243)."""
     return pairwise_distance(ensure_array(X, "X"),

@@ -1,0 +1,40 @@
+"""Global output-type configuration.
+
+Parity with ``pylibraft.config`` (`/root/reference/python/pylibraft/pylibraft/
+config.py:15-46` — ``SUPPORTED_OUTPUT_TYPES``, ``output_as_``,
+``set_output_as``).  The reference returns ``device_ndarray`` ("raft") by
+default and can convert to cupy/torch; raft_tpu returns ``jax.Array`` by
+default and can convert to numpy / torch (via dlpack or host copy) or any
+user callable.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+SUPPORTED_OUTPUT_TYPES = ["jax", "numpy", "torch"]
+
+output_as_: Union[str, Callable] = "jax"
+
+
+def set_output_as(output: Union[str, Callable]) -> None:
+    """Set the output format for raft_tpu functions.
+
+    By default raft_tpu returns ``jax.Array`` from public functions.
+    ``set_output_as`` switches the returned arrays to numpy arrays, torch
+    tensors, or the result of an arbitrary callable applied to the
+    ``jax.Array`` (mirroring ``pylibraft.config.set_output_as``,
+    reference config.py:20-46).
+
+    Parameters
+    ----------
+    output : {"jax", "numpy", "torch"} or callable
+    """
+    if output not in SUPPORTED_OUTPUT_TYPES and not callable(output):
+        raise ValueError(f"Unsupported output option {output!r}")
+    global output_as_
+    output_as_ = output
+
+
+def get_output_as() -> Union[str, Callable]:
+    return output_as_

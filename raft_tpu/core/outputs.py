@@ -1,0 +1,93 @@
+"""Output auto-conversion.
+
+Parity with ``pylibraft.common.outputs`` (`/root/reference/python/pylibraft/
+pylibraft/common/outputs.py:29-46` — torch/cupy converters, ``:75`` —
+``auto_convert_output``).  The reference converts ``device_ndarray`` returns
+to the globally configured ``__cuda_array_interface__`` type; raft_tpu
+converts ``jax.Array`` returns to the type configured in
+:mod:`raft_tpu.config` — numpy, torch (dlpack zero-copy when the buffer is
+host-visible, host copy otherwise), or a user callable.
+
+Tuples, lists, and NamedTuples of arrays are converted element-wise with
+their container type preserved (the reference handles tuple/list,
+outputs.py:84-90; NamedTuple support is new because raft_tpu's index/search
+APIs return typed tuples).
+"""
+
+from __future__ import annotations
+
+import functools
+import warnings
+
+import jax
+
+import raft_tpu.config
+
+
+def _import_warn(lib: str) -> None:
+    warnings.warn(f"{lib} is not available and output cannot be converted. "
+                  "Returning original output instead.")
+
+
+def convert_to_torch(arr: jax.Array):
+    """jax.Array -> torch.Tensor (outputs.py:29 ``convert_to_torch``)."""
+    try:
+        import torch
+    except ImportError:
+        _import_warn("PyTorch")
+        return arr
+    try:
+        return torch.from_dlpack(arr)     # zero-copy when host-visible
+    except Exception:
+        import numpy as np
+        return torch.as_tensor(np.asarray(arr))
+
+
+def convert_to_numpy(arr: jax.Array):
+    import numpy as np
+    return np.asarray(arr)
+
+
+def convert_output(arr: jax.Array):
+    """Apply the configured conversion to one array
+    (``convert_to_cai_type`` analogue, outputs.py:52-64)."""
+    output_as = raft_tpu.config.output_as_
+    if callable(output_as):
+        return output_as(arr)
+    if output_as == "jax":
+        return arr
+    if output_as == "numpy":
+        return convert_to_numpy(arr)
+    if output_as == "torch":
+        return convert_to_torch(arr)
+    raise ValueError(f"No valid type conversion found for {output_as!r}")
+
+
+def _convert_value(value):
+    if isinstance(value, jax.core.Tracer):
+        # decorated primitives (select_k, pairwise_distance, ...) are also
+        # called *inside* jitted compositions; converting a tracer would
+        # crash the trace. Pass it through — the outermost decorated,
+        # un-jitted entry point performs the conversion.
+        return value
+    if isinstance(value, jax.Array):
+        return convert_output(value)
+    if isinstance(value, tuple):
+        converted = [_convert_value(v) for v in value]
+        if hasattr(value, "_fields"):     # NamedTuple: rebuild by fields
+            return type(value)(*converted)
+        return tuple(converted)
+    if isinstance(value, list):
+        return [_convert_value(v) for v in value]
+    return value
+
+
+def auto_convert_output(f):
+    """Decorator converting ``jax.Array`` returns (or containers of them)
+    to the configured output type (outputs.py:75 ``auto_convert_output``)."""
+
+    @functools.wraps(f)
+    def wrapper(*args, **kwargs):
+        return _convert_value(f(*args, **kwargs))
+
+    return wrapper

@@ -21,10 +21,12 @@ import jax.numpy as jnp
 
 from raft_tpu.core.error import expects
 from raft_tpu.utils.precision import get_matmul_precision
+from raft_tpu.core.outputs import auto_convert_output
 
 _TILE_N = 2048
 
 
+@auto_convert_output
 def fused_l2_nn(
     x: jax.Array,
     y: jax.Array,
@@ -79,6 +81,7 @@ def fused_l2_nn(
     return best_d, best_i
 
 
+@auto_convert_output
 def fused_l2_nn_min_reduce(x: jax.Array, y: jax.Array, *,
                            sqrt: bool = False) -> Tuple[jax.Array, jax.Array]:
     """Alias matching fused_l2_nn.cuh:205 ``fusedL2NNMinReduce``."""

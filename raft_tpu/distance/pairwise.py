@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.distance.types import DistanceType, resolve_metric
+from raft_tpu.core.outputs import auto_convert_output
 
 # Row-tile size for the VPU (broadcast) path; bounds peak memory at
 # _TILE_M * n * k elements.
@@ -199,6 +200,7 @@ def _minkowski_reduce(p):
     return f
 
 
+@auto_convert_output
 def pairwise_distance(
     x,
     y,
@@ -265,6 +267,7 @@ def pairwise_distance(
     return out.astype(out_t)
 
 
+@auto_convert_output
 def distance(x, y, metric=DistanceType.L2Unexpanded, *,
              metric_arg: float = 2.0) -> jax.Array:
     """Compile-time-metric flavor (reference: distance.cuh:70 ``distance<T>``);

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.core.error import expects
+from raft_tpu.core.outputs import auto_convert_output
 
 # Length beyond which the two-pass tiled path wins (the analogue of the
 # reference's radix_faster heuristic, detail/select_k.cuh:67-89).
@@ -35,6 +36,7 @@ def _top_k_smallest(x: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
     return -vals, idx
 
 
+@auto_convert_output
 def select_k(
     in_val: jax.Array,
     k: int,
@@ -66,6 +68,7 @@ def select_k(
     return vals, idx
 
 
+@auto_convert_output
 def merge_topk(
     best_val: jax.Array,
     best_idx: jax.Array,

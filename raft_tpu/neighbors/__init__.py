@@ -5,6 +5,7 @@ Reference: cpp/include/raft/neighbors/ (SURVEY.md §2.6) — brute-force kNN
 epsilon neighborhood, and versioned index serialization.
 """
 
+from raft_tpu.neighbors import ball_cover  # noqa: F401
 from raft_tpu.neighbors import brute_force  # noqa: F401
 from raft_tpu.neighbors import ivf_flat  # noqa: F401
 from raft_tpu.neighbors import ivf_pq  # noqa: F401

@@ -1,0 +1,256 @@
+"""Random ball cover (RBC) nearest-neighbor search.
+
+API parity with ``raft::neighbors::ball_cover``
+(`/root/reference/cpp/include/raft/neighbors/ball_cover.cuh:62` —
+``build_index``, ``:112`` — ``all_knn_query``, ``:259`` — ``knn_query``;
+index type ``ball_cover_types.hpp`` — ``BallCoverIndex``; impl
+``spatial/knn/detail/ball_cover.cuh``).  RBC (Cayton) samples ~sqrt(n)
+random landmarks, assigns every point to its closest landmark ball, and uses
+the triangle inequality ``d(q, x) >= d(q, L) - radius(L)`` to skip whole
+balls during search.
+
+TPU-native design (vs the reference's warp-level registers + sorted-ball
+kernels): balls are **padded static lists** (same layout as IVF-Flat —
+``ivf_flat._pack_lists``), and search probes balls in ascending
+query-to-landmark-distance order in fixed-size chunks inside a
+``lax.while_loop``.  A per-query suffix minimum of
+``d(q, L_j) - weight * radius_j`` over the remaining (sorted) balls gives an
+exact, O(1)-per-step termination test: once the suffix bound exceeds the
+running k-th distance, no unprobed ball can contain a closer point, which is
+precisely the reference's post-filtering guarantee expressed as a loop bound
+instead of a second filtered pass.  ``weight < 1`` shrinks radii (fewer
+probes, approximate) exactly as documented at ball_cover.cuh:102-110.
+
+Unlike the reference (2-D/3-D only, ball_cover.cuh:66), any dimensionality is
+supported for the L2 metrics; haversine requires 2-D (lat, lon) radians.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.error import expects
+from ..core.mdarray import ensure_array
+from ..core.outputs import auto_convert_output
+from ..core.tracing import range as named_range
+from ..distance.types import DistanceType, resolve_metric
+from ..matrix.select_k import merge_topk, select_k
+from ..utils.precision import get_matmul_precision
+from .ivf_flat import _pack_lists, _round_up
+
+_SUPPORTED = (DistanceType.Haversine, DistanceType.L2SqrtExpanded,
+              DistanceType.L2SqrtUnexpanded, DistanceType.L2Expanded,
+              DistanceType.L2Unexpanded)
+
+
+def _haversine(x: jax.Array, y: jax.Array) -> jax.Array:
+    """Pointwise haversine over broadcastable (..., 2) radians arrays."""
+    dlat = 0.5 * (x[..., 0] - y[..., 0])
+    dlon = 0.5 * (x[..., 1] - y[..., 1])
+    a = jnp.sin(dlat) ** 2 + jnp.cos(x[..., 0]) * jnp.cos(y[..., 0]) \
+        * jnp.sin(dlon) ** 2
+    return 2.0 * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0.0, 1.0)))
+
+
+def _sqrt_metric(metric: DistanceType) -> bool:
+    return metric in (DistanceType.Haversine, DistanceType.L2SqrtExpanded,
+                      DistanceType.L2SqrtUnexpanded)
+
+
+def _cross_dist(q: jax.Array, pts: jax.Array, metric: DistanceType
+                ) -> jax.Array:
+    """(nq, d) x (m, d) -> (nq, m) in REAL distance units — always sqrt for
+    the L2 family, regardless of the metric's output form.  Triangle-
+    inequality pruning (``d(q,L) - r``) is only a valid lower bound in real
+    units: in squared units ``d² - r²`` over-prunes and drops true
+    neighbors.  Output conversion back to squared form happens at the end
+    of the query (a monotone map, so top-k order is unaffected)."""
+    if metric == DistanceType.Haversine:
+        return _haversine(q[:, None, :], pts[None, :, :])
+    ip = jax.lax.dot_general(q, pts, (((1,), (1,)), ((), ())),
+                             precision=get_matmul_precision(),
+                             preferred_element_type=jnp.float32)
+    d = jnp.maximum(jnp.sum(q * q, axis=1)[:, None]
+                    + jnp.sum(pts * pts, axis=1)[None, :] - 2.0 * ip, 0.0)
+    return jnp.sqrt(d)
+
+
+class BallCoverIndex:
+    """``BallCoverIndex`` analogue (reference ball_cover_types.hpp).
+
+    Built state: ``landmarks (L, d)``, padded ball storage
+    ``list_data (L, cap, d)`` / ``list_indices (L, cap)``, per-ball
+    ``radii (L,)`` (in triangle-comparable units — real distance).
+    """
+
+    def __init__(self, handle, X, metric=DistanceType.L2SqrtExpanded,
+                 n_landmarks: Optional[int] = None):
+        X = ensure_array(X, "X")
+        expects(X.ndim == 2, "BallCoverIndex: X must be (n, d)")
+        metric = resolve_metric(metric)
+        expects(metric in _SUPPORTED,
+                f"ball_cover: unsupported metric {metric}")
+        if metric == DistanceType.Haversine:
+            expects(X.shape[1] == 2, "haversine needs (lat, lon) columns")
+        self._handle = handle
+        self.X = X
+        self.metric = metric
+        self.n = X.shape[0]
+        self.dim = X.shape[1]
+        self.n_landmarks = int(n_landmarks or
+                               max(1, int(math.ceil(math.sqrt(self.n)))))
+        self.trained = False
+        self.landmarks = None
+        self.list_data = None
+        self.list_indices = None
+        self.radii = None
+
+
+def build_index(res, index: BallCoverIndex) -> BallCoverIndex:
+    """Sample landmarks, assign every point to its closest ball, compute
+    radii (reference ball_cover.cuh:62 ``build_index`` →
+    detail ``rbc_build_index``)."""
+    with named_range("ball_cover::build_index"):
+        expects(not index.trained, "index already built")
+        X = index.X.astype(jnp.float32)
+        n, L = index.n, index.n_landmarks
+        # uniform random landmark sample — the "random" in random ball cover
+        perm = jax.random.permutation(res.next_key(), n)[:L]
+        landmarks = X[perm]
+        d = _cross_dist(X, landmarks, index.metric)        # (n, L)
+        labels = jnp.argmin(d, axis=1).astype(jnp.int32)
+        member_d = jnp.take_along_axis(d, labels[:, None], axis=1)[:, 0]
+        radii = jnp.zeros((L,), jnp.float32).at[labels].max(member_d)
+        sizes = jax.ops.segment_sum(jnp.ones(n, jnp.int32), labels,
+                                    num_segments=L)
+        capacity = _round_up(max(1, int(jnp.max(sizes))), 32)
+        list_data, list_idx, _ = _pack_lists(
+            X, labels, jnp.arange(n, dtype=jnp.int32), L, capacity)
+        index.landmarks = landmarks
+        index.list_data = list_data
+        index.list_indices = list_idx
+        index.radii = radii
+        index.trained = True
+        return index
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "metric", "chunk", "max_chunks",
+                                    "post_filter"))
+def _query_impl(landmarks, radii, list_data, list_indices, queries, k,
+                metric, chunk, max_chunks, post_filter, weight):
+    nq = queries.shape[0]
+    L = landmarks.shape[0]
+    cap = list_data.shape[1]
+    qf = queries.astype(jnp.float32)
+
+    d_ql = _cross_dist(qf, landmarks, metric)               # (nq, L)
+    order = jnp.argsort(d_ql, axis=1)                       # ascending balls
+    d_sorted = jnp.take_along_axis(d_ql, order, axis=1)
+    r_sorted = radii[order]
+    # pad to a whole number of chunks with a sentinel empty ball (index L)
+    # so chunk slices never clamp and re-probe (which would duplicate
+    # candidates in the merged top-k)
+    W = max_chunks * chunk
+    if W > L:
+        pad = W - L
+        order = jnp.pad(order, ((0, 0), (0, pad)), constant_values=L)
+        d_sorted = jnp.pad(d_sorted, ((0, 0), (0, pad)),
+                           constant_values=jnp.inf)
+        r_sorted = jnp.pad(r_sorted, ((0, 0), (0, pad)))
+    list_data = jnp.concatenate(
+        [list_data, jnp.zeros((1,) + list_data.shape[1:], list_data.dtype)])
+    list_indices = jnp.concatenate(
+        [list_indices, jnp.full((1, cap), -1, list_indices.dtype)])
+    # suffix min of the triangle lower bound over the sorted remainder:
+    # lb[j] = min_{j' >= j} d(q, L_j') - weight * r_j'
+    lb = jax.lax.cummin(d_sorted - weight * r_sorted, axis=1, reverse=True)
+    lb = jnp.concatenate([lb, jnp.full((nq, 1), jnp.inf)], axis=1)
+
+    # all comparisons below are in real distance units (see _cross_dist)
+    def probe_chunk(best_d, best_i, t):
+        sl = jax.lax.dynamic_slice(order, (0, t * chunk), (nq, chunk))
+        data = list_data[sl]                                # (nq, chunk, cap, d)
+        ids = list_indices[sl].reshape(nq, chunk * cap)
+        data = data.reshape(nq, chunk * cap, -1)
+        if metric == DistanceType.Haversine:
+            cd = _haversine(qf[:, None, :], data)
+        else:
+            ip = jnp.einsum("qd,qcd->qc", qf, data,
+                            precision=get_matmul_precision())
+            cd = jnp.sqrt(jnp.maximum(
+                jnp.sum(qf * qf, axis=1)[:, None]
+                + jnp.sum(data * data, axis=-1) - 2.0 * ip, 0.0))
+        cd = jnp.where(ids >= 0, cd, jnp.inf)
+        kt = min(k, cd.shape[1])
+        td, ti = select_k(cd, kt, in_idx=ids, select_min=True)
+        return merge_topk(best_d, best_i, td, ti, select_min=True)
+
+    init_d = jnp.full((nq, k), jnp.inf, jnp.float32)
+    init_i = jnp.full((nq, k), -1, jnp.int32)
+    # first pass: the closest `chunk` balls (reference first phase — the
+    # closest-landmark sweep)
+    best_d, best_i = probe_chunk(init_d, init_i, 0)
+
+    if post_filter and max_chunks > 1:
+        def cond(state):
+            best_d, _, t = state
+            # any query whose k-th distance can still be beaten by a ball in
+            # the un-probed suffix?
+            return jnp.logical_and(
+                t < max_chunks,
+                jnp.any(lb[:, t * chunk] < best_d[:, -1]))
+
+        def body(state):
+            best_d, best_i, t = state
+            nd, ni = probe_chunk(best_d, best_i, t)
+            return nd, ni, t + 1
+
+        best_d, best_i, _ = jax.lax.while_loop(
+            cond, body, (best_d, best_i, jnp.int32(1)))
+
+    if not _sqrt_metric(metric):      # squared-form output metrics
+        best_d = best_d * best_d
+    return best_d, best_i
+
+
+def _query(res, index: BallCoverIndex, queries, k: int,
+           perform_post_filtering: bool, weight: float
+           ) -> Tuple[jax.Array, jax.Array]:
+    expects(index.trained, "ball cover index not built")
+    queries = ensure_array(queries, "queries").astype(jnp.float32)
+    expects(queries.ndim == 2 and queries.shape[1] == index.dim,
+            "ball_cover: query dim mismatch")
+    L = index.n_landmarks
+    chunk = min(L, max(1, k))
+    max_chunks = -(-L // chunk)
+    return _query_impl(index.landmarks, index.radii, index.list_data,
+                       index.list_indices, queries, int(k), index.metric,
+                       chunk, max_chunks, bool(perform_post_filtering),
+                       jnp.float32(weight))
+
+
+@auto_convert_output
+def all_knn_query(res, index: BallCoverIndex, k: int, *,
+                  perform_post_filtering: bool = True, weight: float = 1.0
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """All-neighbors kNN over the index's own points, building the index if
+    needed (reference ball_cover.cuh:112)."""
+    with named_range("ball_cover::all_knn_query"):
+        if not index.trained:
+            build_index(res, index)
+        return _query(res, index, index.X, k, perform_post_filtering, weight)
+
+
+@auto_convert_output
+def knn_query(res, index: BallCoverIndex, queries, k: int, *,
+              perform_post_filtering: bool = True, weight: float = 1.0
+              ) -> Tuple[jax.Array, jax.Array]:
+    """kNN of out-of-index queries (reference ball_cover.cuh:259)."""
+    with named_range("ball_cover::knn_query"):
+        return _query(res, index, queries, k, perform_post_filtering, weight)

@@ -28,6 +28,7 @@ from raft_tpu.core.tracing import range as named_range
 from raft_tpu.distance.pairwise import pairwise_distance
 from raft_tpu.distance.types import DistanceType
 from raft_tpu.matrix.select_k import merge_topk, select_k
+from raft_tpu.core.outputs import auto_convert_output
 
 _TILE_N = 8192
 
@@ -64,6 +65,7 @@ def _knn_impl(database, queries, k, metric, metric_arg, tile_n):
     return best_d, best_i
 
 
+@auto_convert_output
 def knn(
     res,
     database,
@@ -96,6 +98,7 @@ def knn(
         return d, i
 
 
+@auto_convert_output
 def knn_merge_parts(
     in_keys: jax.Array,
     in_values: jax.Array,

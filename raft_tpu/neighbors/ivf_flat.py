@@ -39,6 +39,7 @@ from raft_tpu.core.tracing import range as named_range
 from raft_tpu.distance.types import DistanceType
 from raft_tpu.matrix.select_k import merge_topk, select_k
 from raft_tpu.utils.precision import get_matmul_precision
+from raft_tpu.core.outputs import auto_convert_output
 
 _LIST_ALIGN = 32  # reference: list sizes rounded to warp multiples (ivf_list.hpp)
 
@@ -284,6 +285,7 @@ def _search_impl(centers, list_data, list_indices, queries, k, n_probes,
     return best_d, best_i
 
 
+@auto_convert_output
 def search(res, params: SearchParams, index: Index, queries, k: int
            ) -> Tuple[jax.Array, jax.Array]:
     """Search the index (reference: ivf_flat.cuh:389).

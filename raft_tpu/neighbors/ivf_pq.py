@@ -51,6 +51,7 @@ from raft_tpu.distance.types import DistanceType
 from raft_tpu.matrix.select_k import merge_topk, select_k
 from raft_tpu.neighbors.ivf_flat import _pack_lists, _round_up, _LIST_ALIGN
 from raft_tpu.utils.precision import get_matmul_precision
+from raft_tpu.core.outputs import auto_convert_output
 
 
 class CodebookKind:
@@ -437,6 +438,7 @@ def _search_impl(centers, codebooks, list_codes, list_indices, rotation,
     return best_d, best_i
 
 
+@auto_convert_output
 def search(res, params: SearchParams, index: Index, queries, k: int
            ) -> Tuple[jax.Array, jax.Array]:
     """Search (reference: ivf_pq.cuh:342).  Returns (distances, indices)."""

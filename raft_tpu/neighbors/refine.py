@@ -24,6 +24,7 @@ from raft_tpu.core.tracing import range as named_range
 from raft_tpu.distance.types import DistanceType
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.utils.precision import get_matmul_precision
+from raft_tpu.core.outputs import auto_convert_output
 
 
 @functools.partial(jax.jit, static_argnames=("k", "metric"))
@@ -54,6 +55,7 @@ def _refine_impl(dataset, queries, candidates, k, metric):
     return vals, idx
 
 
+@auto_convert_output
 def refine(
     res,
     dataset,

@@ -13,8 +13,10 @@ import jax.numpy as jnp
 
 from raft_tpu.core.error import expects
 from raft_tpu.random.rng import RngState, _as_state
+from raft_tpu.core.outputs import auto_convert_output
 
 
+@auto_convert_output
 def make_blobs(
     n_samples: int,
     n_features: int,
@@ -50,6 +52,7 @@ def make_blobs(
     return data, labels.astype(jnp.int32)
 
 
+@auto_convert_output
 def make_regression(
     n_samples: int,
     n_features: int,

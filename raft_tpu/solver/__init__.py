@@ -1,0 +1,13 @@
+"""Solvers — linear assignment (LAP).
+
+Reference surface: ``raft::solver`` (`/root/reference/cpp/include/raft/solver/
+linear_assignment.cuh`, legacy alias ``lap/lap.cuh``).
+"""
+
+from .linear_assignment import (  # noqa: F401
+    LapSolution,
+    LinearAssignmentProblem,
+    solve,
+)
+
+__all__ = ["LapSolution", "LinearAssignmentProblem", "solve"]

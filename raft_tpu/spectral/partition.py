@@ -211,6 +211,19 @@ def _modularity_matvec(adj_csr, degree: jax.Array, total_w: jax.Array):
     return mv
 
 
+def _modularity_operator(adj: CooMatrix):
+    """Shared setup for modularity clustering and scoring: degree vector
+    (sentinel padding rows masked), total weight, and the B-matvec closure.
+    Returns ``(mv, total_w)``."""
+    n = adj.shape[0]
+    csr = coo_to_csr(adj)
+    d = jax.ops.segment_sum(
+        jnp.where(adj.rows < n, adj.vals.astype(jnp.float32), 0),
+        jnp.minimum(adj.rows, n - 1).astype(jnp.int32), num_segments=n)
+    total_w = jnp.maximum(jnp.sum(d), 1e-30)
+    return _modularity_matvec(csr, d, total_w), total_w
+
+
 def modularity_maximization(
     res,
     adj: CooMatrix,
@@ -225,12 +238,7 @@ def modularity_maximization(
     Returns ``(clusters, eig_vals, eig_vecs, residual)``.
     """
     n = adj.shape[0]
-    csr = coo_to_csr(adj)
-    d = jax.ops.segment_sum(
-        jnp.where(adj.rows < n, adj.vals.astype(jnp.float32), 0),
-        jnp.minimum(adj.rows, n - 1).astype(jnp.int32), num_segments=n)
-    total_w = jnp.maximum(jnp.sum(d), 1e-30)
-    mv = _modularity_matvec(csr, d, total_w)
+    mv, _ = _modularity_operator(adj)
     eig_vals, eig_vecs = eigen_solver.solve_largest_eigenvectors(res, mv, n)
     emb = _scale_obs(_whiten(eig_vecs))
     clusters, residual = cluster_solver.solve(res, emb)
@@ -246,13 +254,7 @@ def analyze_modularity(
     ``analyzeModularity`` — Q = (1/2m) sum_i x_i^T B x_i over cluster
     indicators x_i.
     """
-    n = adj.shape[0]
-    csr = coo_to_csr(adj)
-    d = jax.ops.segment_sum(
-        jnp.where(adj.rows < n, adj.vals.astype(jnp.float32), 0),
-        jnp.minimum(adj.rows, n - 1).astype(jnp.int32), num_segments=n)
-    total_w = jnp.maximum(jnp.sum(d), 1e-30)
-    mv = _modularity_matvec(csr, d, total_w)
+    mv, total_w = _modularity_operator(adj)
     onehot = jax.nn.one_hot(clusters, n_clusters, dtype=jnp.float32)
     bx = jax.vmap(mv, in_axes=1, out_axes=1)(onehot)
     return jnp.sum(onehot * bx) / total_w

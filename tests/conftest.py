@@ -33,6 +33,12 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running; the fast gate tier runs with -m 'not slow'")
+
+
 @pytest.fixture
 def res():
     from raft_tpu import DeviceResources

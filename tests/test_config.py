@@ -1,0 +1,104 @@
+"""Output-type config / auto-convert tests.
+
+Mirrors the reference's
+python/pylibraft/pylibraft/test/test_config.py:46 ``test_auto_convert_output``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import raft_tpu.config
+from raft_tpu import auto_convert_output
+
+
+@auto_convert_output
+def gen_arrays(m, n, t=None):
+    a = jnp.zeros((m, n), jnp.float32)
+    if t is None:
+        return a
+    if t == tuple:
+        return a, jnp.ones((m, n), jnp.float32)
+    if t == list:
+        return [a, jnp.ones((m, n), jnp.float32)]
+
+
+@pytest.fixture(autouse=True)
+def _restore_config():
+    yield
+    raft_tpu.config.set_output_as("jax")
+
+
+@pytest.mark.parametrize(
+    "out_type",
+    [
+        ("jax", jax.Array),
+        ("numpy", np.ndarray),
+        ("torch", torch.Tensor),
+        (lambda arr: np.asarray(arr), np.ndarray),
+    ],
+    ids=["jax", "numpy", "torch", "callable"],
+)
+@pytest.mark.parametrize("gen_t", [None, tuple, list])
+def test_auto_convert_output(out_type, gen_t):
+    conf, t = out_type
+    raft_tpu.config.set_output_as(conf)
+    output = gen_arrays(1, 5, gen_t)
+    if not isinstance(output, (list, tuple)):
+        assert isinstance(output, t)
+    else:
+        for o in output:
+            assert isinstance(o, t)
+
+
+def test_invalid_option_rejected():
+    with pytest.raises(ValueError):
+        raft_tpu.config.set_output_as("cupy")
+
+
+def test_namedtuple_preserved(res):
+    """raft_tpu index/search APIs return NamedTuples; the container type and
+    field names must survive conversion."""
+    from raft_tpu.distance import fused_l2_nn
+    raft_tpu.config.set_output_as("numpy")
+    x = np.random.default_rng(0).random((16, 8)).astype(np.float32)
+    y = np.random.default_rng(1).random((8, 8)).astype(np.float32)
+    out = fused_l2_nn(x, y)
+    leaves = out if isinstance(out, (list, tuple)) else [out]
+    for leaf in leaves:
+        assert isinstance(leaf, np.ndarray)
+
+
+def test_composite_jit_functions_with_non_jax_output(res):
+    """Regression: decorated primitives (select_k, pairwise_distance) are
+    also called inside jitted compositions (knn, kmeans) — conversion must
+    not touch tracers."""
+    from raft_tpu.cluster import kmeans
+    from raft_tpu.neighbors import brute_force
+    rng = np.random.default_rng(0)
+    X = rng.random((64, 8)).astype(np.float32)
+    raft_tpu.config.set_output_as("numpy")
+    d, i = brute_force.knn(res, X, X[:8], 4)
+    assert isinstance(d, np.ndarray) and isinstance(i, np.ndarray)
+    params = kmeans.KMeansParams(n_clusters=4, max_iter=5)
+    centroids, inertia, n_iter = kmeans.fit(res, params, X)
+    assert isinstance(centroids, np.ndarray)
+
+
+def test_end_to_end_pairwise(res):
+    """pylibraft round-trip: numpy in -> configured type out, values equal."""
+    from raft_tpu.distance import pairwise_distance
+    rng = np.random.default_rng(2)
+    x = rng.random((10, 4)).astype(np.float32)
+
+    raft_tpu.config.set_output_as("torch")
+    d_torch = pairwise_distance(x, x, metric="euclidean")
+    assert isinstance(d_torch, torch.Tensor)
+
+    raft_tpu.config.set_output_as("jax")
+    d_jax = pairwise_distance(x, x, metric="euclidean")
+    assert isinstance(d_jax, jax.Array)
+    np.testing.assert_allclose(np.asarray(d_jax), d_torch.numpy(), rtol=1e-5)

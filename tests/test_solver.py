@@ -1,0 +1,121 @@
+"""LAP solver tests.
+
+Mirrors the reference's Hungarian-vs-known-optimum strategy
+(cpp/test/linalg/... has no LAP test; the contract here is VERDICT-driven:
+match ``scipy.optimize.linear_sum_assignment`` costs on random matrices).
+"""
+
+import numpy as np
+import pytest
+from scipy.optimize import linear_sum_assignment
+
+from raft_tpu import solver
+
+
+def _assert_valid_assignment(row, col, n):
+    row = np.asarray(row)
+    col = np.asarray(col)
+    assert sorted(row.tolist()) == list(range(n))   # a permutation
+    # col_assignment is the inverse permutation
+    assert np.array_equal(col[row], np.arange(n))
+
+
+class TestSolve:
+    @pytest.mark.parametrize("n", [1, 2, 16, 100])
+    def test_matches_scipy_small(self, res, n):
+        rng = np.random.default_rng(n)
+        cost = rng.random((n, n)).astype(np.float32)
+        sol = solver.solve(res, cost)
+        _assert_valid_assignment(sol.row_assignment, sol.col_assignment, n)
+        ri, ci = linear_sum_assignment(cost)
+        expected = cost[ri, ci].sum()
+        got = cost[np.arange(n), np.asarray(sol.row_assignment)].sum()
+        np.testing.assert_allclose(got, expected, rtol=1e-5)
+
+    def test_matches_scipy_200(self, res):
+        rng = np.random.default_rng(7)
+        cost = rng.random((200, 200)).astype(np.float32)
+        sol = solver.solve(res, cost)
+        _assert_valid_assignment(sol.row_assignment, sol.col_assignment, 200)
+        ri, ci = linear_sum_assignment(cost)
+        np.testing.assert_allclose(
+            float(sol.obj_primal), cost[ri, ci].sum(), rtol=1e-5)
+
+    @pytest.mark.slow
+    def test_matches_scipy_500(self, res):
+        rng = np.random.default_rng(7)
+        cost = rng.random((500, 500)).astype(np.float32)
+        sol = solver.solve(res, cost)
+        _assert_valid_assignment(sol.row_assignment, sol.col_assignment, 500)
+        ri, ci = linear_sum_assignment(cost)
+        np.testing.assert_allclose(
+            float(sol.obj_primal), cost[ri, ci].sum(), rtol=1e-5)
+
+    @pytest.mark.slow
+    def test_matches_scipy_2048(self, res):
+        rng = np.random.default_rng(11)
+        cost = rng.random((2048, 2048)).astype(np.float32)
+        sol = solver.solve(res, cost)
+        _assert_valid_assignment(sol.row_assignment, sol.col_assignment, 2048)
+        ri, ci = linear_sum_assignment(cost)
+        np.testing.assert_allclose(
+            float(sol.obj_primal), cost[ri, ci].sum(), rtol=1e-5)
+
+    def test_integer_costs_exact(self, res):
+        rng = np.random.default_rng(3)
+        cost = rng.integers(0, 1000, size=(64, 64)).astype(np.float32)
+        sol = solver.solve(res, cost)
+        ri, ci = linear_sum_assignment(cost)
+        assert float(sol.obj_primal) == pytest.approx(cost[ri, ci].sum())
+
+    def test_maximize(self, res):
+        rng = np.random.default_rng(5)
+        cost = rng.random((32, 32)).astype(np.float32)
+        sol = solver.solve(res, cost, maximize=True)
+        ri, ci = linear_sum_assignment(cost, maximize=True)
+        np.testing.assert_allclose(
+            float(sol.obj_primal), cost[ri, ci].sum(), rtol=1e-5)
+
+    def test_batched(self, res):
+        rng = np.random.default_rng(9)
+        cost = rng.random((4, 48, 48)).astype(np.float32)
+        sol = solver.solve(res, cost)
+        for b in range(4):
+            ri, ci = linear_sum_assignment(cost[b])
+            np.testing.assert_allclose(
+                float(sol.obj_primal[b]), cost[b][ri, ci].sum(), rtol=1e-5)
+
+    def test_duals_feasible_and_tight(self, res):
+        """u_i + v_j <= c_ij (feasible) and dual ~ primal (strong duality)."""
+        rng = np.random.default_rng(13)
+        cost = rng.random((64, 64)).astype(np.float32)
+        sol = solver.solve(res, cost)
+        u = np.asarray(sol.row_duals)[:, None]
+        v = np.asarray(sol.col_duals)[None, :]
+        assert np.all(u + v <= cost + 1e-5)
+        np.testing.assert_allclose(
+            float(sol.obj_dual), float(sol.obj_primal), rtol=1e-4)
+
+
+class TestClassSurface:
+    def test_class_solve_and_getters(self, res):
+        rng = np.random.default_rng(21)
+        cost = rng.random((2, 32, 32)).astype(np.float32)
+        lap = solver.LinearAssignmentProblem(res, size=32, batchsize=2)
+        row, col = lap.solve(cost)
+        for b in range(2):
+            _assert_valid_assignment(row[b], col[b], 32)
+            ri, ci = linear_sum_assignment(cost[b])
+            np.testing.assert_allclose(
+                float(lap.primal_objective_value(b)),
+                cost[b][ri, ci].sum(), rtol=1e-5)
+            assert lap.row_dual_vector(b).shape == (32,)
+            assert lap.col_dual_vector(b).shape == (32,)
+            np.testing.assert_allclose(float(lap.dual_objective_value(b)),
+                                       float(lap.primal_objective_value(b)),
+                                       rtol=1e-4)
+
+    def test_shape_validation(self, res):
+        lap = solver.LinearAssignmentProblem(res, size=8, batchsize=1)
+        with pytest.raises(Exception):
+            lap.solve(np.zeros((4, 4), np.float32))
