@@ -25,4 +25,4 @@ from raft_tpu.core import (  # noqa: F401
 )
 from raft_tpu.core.outputs import auto_convert_output  # noqa: F401
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"

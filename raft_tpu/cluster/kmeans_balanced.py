@@ -89,11 +89,15 @@ def _balanced_loop(X, centroids0, key, n_clusters, n_iters, metric):
         avg = jnp.float32(n) / n_clusters
         small = sizes.astype(jnp.float32) < (avg / _BALANCE_RATIO)
         key, kc = jax.random.split(key)
-        # one candidate point per cluster, drawn ∝ assignment distance
+        # one candidate point per cluster, drawn ∝ assignment distance via
+        # inverse-CDF (cumsum + searchsorted).  NOT the gumbel-matrix trick:
+        # an (n_clusters, n) gumbel draw per iteration is O(K·n) randomness —
+        # at IVF scale (K~4k, n~500k) that is gigabytes per Lloyd step and
+        # dominated the whole IVF-PQ build.
         w = jnp.maximum(dists - jnp.min(dists), 0.0) + 1e-6
-        logits = jnp.log(w)
-        g = jax.random.gumbel(kc, (n_clusters, n))
-        cand = jnp.argmax(logits[None, :] + g, axis=1)
+        cdf = jnp.cumsum(w)
+        u = jax.random.uniform(kc, (n_clusters,)) * cdf[-1]
+        cand = jnp.clip(jnp.searchsorted(cdf, u), 0, n - 1)
         centers = jnp.where(small[:, None], xf[cand], centers)
         if metric == DistanceType.InnerProduct:
             # spherical k-means: keep centroids on the unit sphere

@@ -28,6 +28,7 @@ from raft_tpu.sparse.formats import CooMatrix
 from raft_tpu.sparse.linalg import symmetrize
 from raft_tpu.sparse.neighbors import connect_components, knn_graph
 from raft_tpu.sparse.solver import mst
+from raft_tpu.core.outputs import raw
 
 
 class LinkageDistance:
@@ -105,7 +106,7 @@ def single_linkage(
             # reference's pairwise connectivity path
             from raft_tpu.distance.pairwise import pairwise_distance
             from raft_tpu.sparse.formats import dense_to_coo
-            d = pairwise_distance(X, X, metric)
+            d = raw(pairwise_distance)(X, X, metric)
             d = d.at[jnp.arange(n), jnp.arange(n)].set(0.0)
             graph = dense_to_coo(d)
 

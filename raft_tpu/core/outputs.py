@@ -40,7 +40,9 @@ def convert_to_torch(arr: jax.Array):
         return torch.from_dlpack(arr)     # zero-copy when host-visible
     except Exception:
         import numpy as np
-        return torch.as_tensor(np.asarray(arr))
+        # copy: np.asarray over a jax buffer is read-only, and torch
+        # aliasing read-only memory is undefined behavior on write
+        return torch.as_tensor(np.array(arr))
 
 
 def convert_to_numpy(arr: jax.Array):
@@ -91,3 +93,14 @@ def auto_convert_output(f):
         return _convert_value(f(*args, **kwargs))
 
     return wrapper
+
+
+def raw(f):
+    """The undecorated implementation of an auto-converted public function.
+
+    Internal library composition must stay in ``jax.Array`` land regardless
+    of the user's configured output type — a decorated primitive called from
+    un-jitted library code would otherwise hand numpy/torch values to jax
+    ops (``.at[]``, ``lax.top_k``) mid-pipeline.
+    """
+    return getattr(f, "__wrapped__", f)

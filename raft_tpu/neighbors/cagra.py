@@ -49,6 +49,7 @@ from raft_tpu.matrix.select_k import merge_topk, select_k
 from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
 from raft_tpu.neighbors.refine import refine
 from raft_tpu.utils.precision import get_matmul_precision
+from raft_tpu.core.outputs import raw
 
 
 @dataclasses.dataclass
@@ -140,7 +141,7 @@ def build_knn_graph(
         for start in range(0, n, batch):
             q = dataset[start:start + batch]
             _, cand = ivf_pq_mod.search(res, sp, pq_index, q, top_k)
-            _, idx = refine(res, dataset, q, cand,
+            _, idx = raw(refine)(res, dataset, q, cand,
                             min(intermediate_degree + 1, top_k),
                             metric=DistanceType.L2Expanded
                             if p.metric != DistanceType.InnerProduct

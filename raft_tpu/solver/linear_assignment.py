@@ -196,6 +196,11 @@ def solve(res, cost, *, maximize: bool = False) -> LapSolution:
                            col_duals=-sol.col_duals,
                            obj_primal=-sol.obj_primal,
                            obj_dual=-sol.obj_dual)
+    # the auction round cap (a while_loop safety bound) leaves rows at -1 if
+    # ever exhausted; never return a silently-invalid assignment
+    expects(bool(jnp.all(sol.row_assignment >= 0)),
+            "LAP auction did not converge within the round cap — "
+            "degenerate cost structure; rescale costs or report a bug")
     return sol
 
 

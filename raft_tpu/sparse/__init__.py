@@ -28,6 +28,14 @@ from raft_tpu.sparse.linalg import (  # noqa: F401
     laplacian,
     laplacian_spmv,
 )
+from raft_tpu.sparse.op import (  # noqa: F401
+    coo_remove_scalar,
+    coo_remove_zeros,
+    csr_row_slice,
+    csr_row_op,
+    compute_duplicates_mask,
+    max_duplicates,
+)
 from raft_tpu.sparse.distance import pairwise_distance_sparse  # noqa: F401
 from raft_tpu.sparse.neighbors import (  # noqa: F401
     brute_force_knn_sparse,

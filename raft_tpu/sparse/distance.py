@@ -19,7 +19,8 @@ import jax.numpy as jnp
 from raft_tpu.core.error import expects
 from raft_tpu.distance.pairwise import pairwise_distance
 from raft_tpu.distance.types import DistanceType
-from raft_tpu.sparse.formats import CsrMatrix, csr_to_dense
+from raft_tpu.sparse.formats import CsrMatrix
+from raft_tpu.core.outputs import raw
 
 _TILE_ROWS = 2048
 
@@ -32,18 +33,30 @@ def pairwise_distance_sparse(
     metric_arg: float = 2.0,
 ) -> jax.Array:
     """All-pairs distances between CSR row sets (reference:
-    sparse/distance/distance.cuh:68).  Returns dense (m, n)."""
+    sparse/distance/distance.cuh:68).  Returns dense (m, n).
+
+    Both sides are densified in row *blocks* (never the whole operand):
+    peak extra HBM is O(2 · tile · dim), independent of m and n, matching
+    the reference's tiled CSR×CSR traversal in spirit while keeping the
+    inner product on the MXU.
+    """
     expects(x.shape[1] == y.shape[1],
             "sparse pairwise: feature dims differ")
-    yd = csr_to_dense(y)
-    m = x.shape[0]
-    outs = []
-    for start in range(0, m, _TILE_ROWS):
-        stop = min(start + _TILE_ROWS, m)
-        xd = _dense_rows(x, start, stop)
-        outs.append(pairwise_distance(xd, yd, metric,
-                                      metric_arg=metric_arg))
-    return jnp.concatenate(outs, axis=0)
+    m, n = x.shape[0], y.shape[0]
+    row_blocks = []
+    for xs in range(0, m, _TILE_ROWS):
+        xe = min(xs + _TILE_ROWS, m)
+        xd = _dense_rows(x, xs, xe)
+        cols = []
+        for ys in range(0, n, _TILE_ROWS):
+            ye = min(ys + _TILE_ROWS, n)
+            yd = _dense_rows(y, ys, ye)
+            cols.append(raw(pairwise_distance)(xd, yd, metric,
+                                          metric_arg=metric_arg))
+        row_blocks.append(jnp.concatenate(cols, axis=1)
+                          if len(cols) > 1 else cols[0])
+    return (jnp.concatenate(row_blocks, axis=0)
+            if len(row_blocks) > 1 else row_blocks[0])
 
 
 def _dense_rows(csr: CsrMatrix, start: int, stop: int) -> jax.Array:

@@ -21,6 +21,7 @@ from raft_tpu.distance.types import DistanceType
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.sparse.distance import pairwise_distance_sparse
 from raft_tpu.sparse.formats import CooMatrix, CsrMatrix, coo_sort
+from raft_tpu.core.outputs import raw
 
 
 def brute_force_knn_sparse(
@@ -34,7 +35,7 @@ def brute_force_knn_sparse(
     (reference: sparse/neighbors/brute_force.cuh)."""
     d = pairwise_distance_sparse(x, y, metric)
     select_min = metric != DistanceType.InnerProduct
-    return select_k(d, k, select_min=select_min)
+    return raw(select_k)(d, k, select_min=select_min)
 
 
 def knn_graph(
@@ -82,7 +83,7 @@ def connect_components(
     # full pairwise with same-component masking; for the sizes single-linkage
     # handles (fix-up stage) the dense (n, n) block is acceptable, as the
     # reference's fix-up also does an all-pairs NN over components
-    d = pairwise_distance(X, X, metric)
+    d = raw(pairwise_distance)(X, X, metric)
     same = labels[:, None] == labels[None, :]
     d = jnp.where(same, jnp.inf, d)
     best_j = jnp.argmin(d, axis=1).astype(jnp.int32)      # (n,)

@@ -1,0 +1,129 @@
+"""Sparse element/row operations over COO/CSR.
+
+Reference surface: ``cpp/include/raft/sparse/op/`` — ``filter.cuh``
+(:46 ``coo_remove_scalar``, :85 ``coo_remove_zeros``), ``slice.cuh``
+(:40 ``csr_row_slice_indptr``, :65 ``csr_row_slice_populate``),
+``row_op.cuh`` (:39 ``csr_row_op``), ``reduce.cuh``
+(:49 ``compute_duplicates_mask``, :72 ``max_duplicates``);
+``sort.cuh`` lives in :mod:`raft_tpu.sparse.formats` (``coo_sort``).
+
+TPU design: nnz is static under XLA, so "removal" keeps the storage size
+and moves dropped entries to the padding convention (``row == n_rows``,
+val 0) — they sort to the end and every downstream segment reduction
+ignores them.  This is the same static-capacity trade the IVF list layout
+makes; callers that need a tight buffer re-materialize on host.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.sparse.formats import CooMatrix, CsrMatrix, coo_sort
+
+
+def _drop(coo: CooMatrix, keep: jax.Array) -> CooMatrix:
+    """Move entries with ``keep == False`` to padding and re-sort so live
+    entries are contiguous at the front."""
+    n_rows = coo.shape[0]
+    rows = jnp.where(keep, coo.rows, n_rows)
+    cols = jnp.where(keep, coo.cols, 0)
+    vals = jnp.where(keep, coo.vals, 0)
+    return coo_sort(CooMatrix(rows, cols, vals, coo.shape))
+
+
+def coo_remove_scalar(coo: CooMatrix, scalar) -> CooMatrix:
+    """Remove entries equal to ``scalar``
+    (reference: sparse/op/filter.cuh:46,72)."""
+    live = coo.rows < coo.shape[0]
+    return _drop(coo, live & (coo.vals != scalar))
+
+
+def coo_remove_zeros(coo: CooMatrix) -> CooMatrix:
+    """Reference: sparse/op/filter.cuh:85."""
+    return coo_remove_scalar(coo, 0)
+
+
+def csr_row_slice(csr: CsrMatrix, start_row: int, stop_row: int
+                  ) -> CsrMatrix:
+    """Rows ``[start_row, stop_row)`` as a new CSR
+    (reference: sparse/op/slice.cuh:40 ``csr_row_slice_indptr`` + :65
+    ``csr_row_slice_populate``, fused).  Keeps the parent's nnz capacity;
+    out-of-slice entries become padding.
+    """
+    n_rows, n_cols = csr.shape
+    expects(0 <= start_row <= stop_row <= n_rows,
+            "csr_row_slice: bad row range")
+    out_rows = stop_row - start_row
+    rows = csr.row_ids()
+    keep = (rows >= start_row) & (rows < stop_row)
+    new_rows = jnp.where(keep, rows - start_row, out_rows)
+    sliced = coo_sort(CooMatrix(new_rows,
+                                jnp.where(keep, csr.indices, 0),
+                                jnp.where(keep, csr.data, 0),
+                                (out_rows, n_cols)))
+    counts = jax.ops.segment_sum(
+        jnp.where(sliced.rows < out_rows, 1, 0).astype(jnp.int32),
+        jnp.minimum(sliced.rows, max(out_rows - 1, 0)).astype(jnp.int32),
+        num_segments=max(out_rows, 1))[:out_rows]
+    indptr = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                              jnp.cumsum(counts).astype(jnp.int32)])
+    return CsrMatrix(indptr, sliced.cols, sliced.vals, (out_rows, n_cols))
+
+
+def csr_row_op(csr: CsrMatrix, op: Callable) -> CsrMatrix:
+    """Apply a row-indexed op over the values
+    (reference: sparse/op/row_op.cuh:39 ``csr_row_op`` — the CUDA version
+    hands each row's [start, stop) range to a device lambda; the TPU form
+    hands the whole value vector plus its row ids to a vectorized callable).
+
+    ``op(row_ids, nnz_index, data) -> new_data``; padding slots keep 0.
+    """
+    rows = csr.row_ids()
+    new_data = op(rows, jnp.arange(csr.nnz), csr.data)
+    new_data = jnp.where(rows < csr.shape[0], new_data, 0)
+    return CsrMatrix(csr.indptr, csr.indices, new_data, csr.shape)
+
+
+def compute_duplicates_mask(coo: CooMatrix) -> jax.Array:
+    """1 for the first occurrence of each (row, col) in sorted order, 0 for
+    its duplicates (reference: sparse/op/reduce.cuh:49).  Input must be
+    sorted (``coo_sort``); padding slots get 0."""
+    n_rows = coo.shape[0]
+    first = jnp.ones(coo.nnz, jnp.int32)
+    same = (coo.rows[1:] == coo.rows[:-1]) & (coo.cols[1:] == coo.cols[:-1])
+    first = first.at[1:].set(jnp.where(same, 0, 1))
+    return jnp.where(coo.rows < n_rows, first, 0)
+
+
+def max_duplicates(coo: CooMatrix) -> CooMatrix:
+    """Combine duplicate (row, col) entries keeping the max value
+    (reference: sparse/op/reduce.cuh:72 ``max_duplicates``).  Output keeps
+    the input's nnz capacity with combined entries compacted to the front.
+    """
+    coo = coo_sort(coo)
+    n_rows = coo.shape[0]
+    mask = compute_duplicates_mask(coo)
+    # group id per entry = running count of firsts - 1
+    gid = jnp.cumsum(mask) - 1
+    live = coo.rows < n_rows
+    gid = jnp.where(live, gid, coo.nnz - 1)
+    maxv = jnp.full((coo.nnz,), -jnp.inf, jnp.float32) \
+        .at[gid].max(jnp.where(live, coo.vals.astype(jnp.float32),
+                               -jnp.inf))
+    n_groups = jnp.sum(mask)
+    slot = jnp.arange(coo.nnz)
+    is_first = mask == 1
+    # scatter the first-occurrence (row, col) into group slots
+    g_rows = jnp.full((coo.nnz,), n_rows, coo.rows.dtype) \
+        .at[jnp.where(is_first, gid, coo.nnz - 1)].set(
+            jnp.where(is_first, coo.rows, n_rows), mode="drop")
+    g_cols = jnp.zeros((coo.nnz,), coo.cols.dtype) \
+        .at[jnp.where(is_first, gid, coo.nnz - 1)].set(
+            jnp.where(is_first, coo.cols, 0), mode="drop")
+    g_vals = jnp.where(slot < n_groups, maxv, 0).astype(coo.vals.dtype)
+    g_rows = jnp.where(slot < n_groups, g_rows, n_rows)
+    return CooMatrix(g_rows, g_cols, g_vals, coo.shape)

@@ -21,6 +21,7 @@ from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.distance.pairwise import pairwise_distance
 from raft_tpu.distance.types import DistanceType
+from raft_tpu.core.outputs import raw
 
 
 def contingency_matrix(y_true, y_pred, *, n_classes_true: int,
@@ -154,7 +155,7 @@ def silhouette_score(
     def tile_scores(xt, lt):
         # distances of the row tile against the FULL dataset (columns are
         # never padded, so sums are exact)
-        d = pairwise_distance(xt, X, metric)                # (c, n)
+        d = raw(pairwise_distance)(xt, X, metric)                # (c, n)
         sums = d @ one_hot                                  # (c, k)
         own = jnp.take_along_axis(sums, lt[:, None], axis=1)[:, 0]
         own_count = counts[lt]

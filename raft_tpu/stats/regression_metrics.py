@@ -16,6 +16,7 @@ from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.distance.pairwise import pairwise_distance
 from raft_tpu.distance.types import DistanceType
+from raft_tpu.core.outputs import raw
 
 
 def accuracy(predictions, ref_predictions) -> jax.Array:
@@ -98,8 +99,8 @@ def trustworthiness_score(X, X_embedded, n_neighbors: int,
     expects(n_neighbors < n // 2,
             "trustworthiness: n_neighbors must be < n/2")
 
-    d_orig = pairwise_distance(X, X, metric)
-    d_emb = pairwise_distance(X_embedded, X_embedded, metric)
+    d_orig = raw(pairwise_distance)(X, X, metric)
+    d_emb = raw(pairwise_distance)(X_embedded, X_embedded, metric)
     big = jnp.max(d_orig) + 1.0
     d_orig = d_orig.at[jnp.arange(n), jnp.arange(n)].set(big)
     d_emb = d_emb.at[jnp.arange(n), jnp.arange(n)].set(big)

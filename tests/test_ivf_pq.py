@@ -152,6 +152,30 @@ class TestIvfPq:
         np.testing.assert_allclose(np.asarray(d1), np.asarray(d2),
                                    rtol=1e-5)
 
+    def test_recon_path_matches_lut_path(self, res, dataset):
+        """The bf16 reconstruction scan computes the same quantized distance
+        as the LUT formulation — indices should agree except for bf16
+        rounding flips near ties."""
+        db, q = dataset
+        params = ivf_pq.IndexParams(n_lists=16, pq_dim=8, kmeans_n_iters=5)
+        index = ivf_pq.build(res, params, db)
+        assert index.list_recon is not None
+        assert index.list_recon.dtype == jnp.bfloat16
+        k = 10
+        d_r, i_r = ivf_pq.search(
+            res, ivf_pq.SearchParams(n_probes=8), index, q, k)
+        d_l, i_l = ivf_pq.search(
+            res, ivf_pq.SearchParams(n_probes=8, use_reconstruction=False),
+            index, q, k)
+        i_r, i_l = np.asarray(i_r), np.asarray(i_l)
+        overlap = sum(len(set(a) & set(b)) for a, b in zip(i_r, i_l))
+        assert overlap / i_l.size >= 0.9
+        # bf16 reconstructions round the decoded residuals (~0.4%/element);
+        # distances agree coarsely — still far tighter than the reference's
+        # fp8 LUT option
+        np.testing.assert_allclose(np.asarray(d_r), np.asarray(d_l),
+                                   rtol=0.15, atol=0.2)
+
     def test_pq_bits_4(self, res, dataset):
         db, q = dataset
         params = ivf_pq.IndexParams(n_lists=16, pq_dim=32, pq_bits=4,

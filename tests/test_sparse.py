@@ -61,6 +61,78 @@ class TestFormats:
         assert back[0, 0] == 5.0 and back[1, 1] == -3.0 and back[2, 2] == 0
 
 
+class TestOps:
+    """sparse/op/ parity: filter, slice, row_op, duplicate reduce."""
+
+    def test_coo_remove_scalar_and_zeros(self):
+        d = random_sparse(10, 8, seed=3)
+        d[d != 0] = np.round(d[d != 0] * 2)  # make some entries equal 2.0
+        coo = sparse.dense_to_coo(jnp.asarray(d))
+        out = sparse.coo_remove_scalar(coo, 2.0)
+        expect = d.copy()
+        expect[expect == 2.0] = 0.0
+        np.testing.assert_allclose(np.asarray(sparse.coo_to_dense(out)),
+                                   expect, rtol=1e-6)
+        # removed entries become padding (sorted to the end)
+        rows = np.asarray(out.rows)
+        live = rows < 10
+        assert not np.any(np.diff(live.astype(int)) > 0)  # no live after pad
+        z = sparse.coo_remove_zeros(out)
+        np.testing.assert_allclose(np.asarray(sparse.coo_to_dense(z)),
+                                   expect, rtol=1e-6)
+
+    def test_csr_row_slice(self):
+        d = random_sparse(12, 6, seed=4)
+        csr = sparse.dense_to_csr(jnp.asarray(d))
+        sl = sparse.csr_row_slice(csr, 3, 9)
+        assert sl.shape == (6, 6)
+        np.testing.assert_allclose(np.asarray(sparse.csr_to_dense(sl)),
+                                   d[3:9], rtol=1e-6)
+        # indptr is rebased to the slice
+        assert int(sl.indptr[0]) == 0
+        assert int(sl.indptr[-1]) == int(np.count_nonzero(d[3:9]))
+
+    def test_csr_row_op(self):
+        d = random_sparse(8, 5, seed=5)
+        csr = sparse.dense_to_csr(jnp.asarray(d))
+        # scale each row's values by (row index + 1)
+        out = sparse.csr_row_op(
+            csr, lambda rows, idx, data: data * (rows + 1.0))
+        expect = d * (np.arange(8)[:, None] + 1.0)
+        np.testing.assert_allclose(np.asarray(sparse.csr_to_dense(out)),
+                                   expect, rtol=1e-6)
+
+    def test_max_duplicates(self):
+        rows = jnp.asarray([0, 0, 0, 1, 1, 2], jnp.int32)
+        cols = jnp.asarray([1, 1, 2, 0, 0, 2], jnp.int32)
+        vals = jnp.asarray([3.0, 5.0, 1.0, -2.0, -7.0, 4.0], jnp.float32)
+        coo = sparse.CooMatrix(rows, cols, vals, (3, 3))
+        out = sparse.max_duplicates(coo)
+        dense = np.asarray(sparse.coo_to_dense(out))
+        expect = np.zeros((3, 3), np.float32)
+        expect[0, 1] = 5.0   # max(3, 5)
+        expect[0, 2] = 1.0
+        expect[1, 0] = -2.0  # max(-2, -7)
+        expect[2, 2] = 4.0
+        np.testing.assert_allclose(dense, expect)
+        mask = np.asarray(sparse.compute_duplicates_mask(
+            sparse.coo_sort(coo)))
+        assert mask.sum() == 4
+
+    def test_sparse_distance_blocks_match_small(self):
+        """Tiled two-sided densification must equal the naive dense result
+        (regression for the full-y densification)."""
+        from raft_tpu.distance import pairwise_distance
+        dx = random_sparse(7, 9, seed=6)
+        dy = random_sparse(11, 9, seed=7)
+        out = sparse.pairwise_distance_sparse(
+            sparse.dense_to_csr(jnp.asarray(dx)),
+            sparse.dense_to_csr(jnp.asarray(dy)))
+        expect = np.asarray(pairwise_distance(dx, dy))
+        np.testing.assert_allclose(np.asarray(out), expect,
+                                   rtol=1e-4, atol=1e-5)
+
+
 class TestLinalg:
     def test_spmv(self):
         d = random_sparse(20, 15, seed=4)
