@@ -8,10 +8,16 @@ TPU-native: one backend — XLA collectives on a ``jax.sharding.Mesh`` (ICI
 within a slice, DCN across slices); process bootstrap via jax.distributed.
 """
 
-from raft_tpu.comms.comms import Comms, op_t, status_t  # noqa: F401
+from raft_tpu.comms.comms import (  # noqa: F401
+    Comms,
+    P2pRequest,
+    op_t,
+    status_t,
+)
 from raft_tpu.comms.session import (  # noqa: F401
     CommsSession,
     inject_comms_on_handle,
     local_handle,
+    make_2d_session,
 )
 from raft_tpu.comms import self_test  # noqa: F401

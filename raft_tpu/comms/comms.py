@@ -58,6 +58,20 @@ class status_t:
     ABORT = 2
 
 
+@dataclasses.dataclass
+class P2pRequest:
+    """A posted isend/irecv awaiting waitall (reference: the request_t
+    handles of comms.hpp:146-168).  ``pattern`` is the full rank→peer map;
+    ``data`` holds the delivered buffer for recv requests after waitall."""
+
+    kind: str                    # "send" | "recv"
+    comms: "Comms"
+    payload: Optional[object]
+    pattern: Tuple[int, ...]
+    tag: int
+    data: Optional[object] = None
+
+
 @dataclasses.dataclass(frozen=True)
 class Comms:
     """Communicator bound to a named mesh axis (reference: comms_t,
@@ -139,7 +153,77 @@ class Comms:
                 "reducescatter supports SUM (as XLA psum_scatter)")
         return jax.lax.psum_scatter(x, self.axis_name, tiled=True)
 
-    # -- point-to-point (UCX tagged-messaging analogue) --------------------
+    # -- tagged point-to-point (UCX isend/irecv/waitall analogue) ----------
+    #
+    # The reference's UCX path (comms.hpp:146-160 isend/irecv, :168 waitall;
+    # ucp_helper.hpp) posts per-rank absolute-destination messages matched
+    # by tag at completion.  XLA has no dynamic routing: a communication
+    # pattern must be static at trace time.  The honest TPU translation
+    # keeps the *posting* API (absolute ranks, tags, deferred completion)
+    # but takes the full rank→rank pattern up front — every rank runs the
+    # same program, so rank r's destination is ``dst[r]`` of a shared list.
+    # waitall() fuses all posted messages of a tag into ONE ppermute (the
+    # tag plays NCCL-group/UCX-tag's role of batching and matching).
+
+    def isend(self, x, dst: Sequence[int], tag: int = 0) -> "P2pRequest":
+        """Post a send: rank r's buffer goes to absolute rank ``dst[r]``
+        (reference: comms.hpp:146 ``isend``).  Completion at waitall()."""
+        n = self.get_size()
+        expects(isinstance(n, int), "isend needs a static axis size")
+        dsts = [int(d) % n for d in dst]
+        expects(len(dsts) == n, f"isend: dst must list all {n} ranks")
+        expects(sorted(dsts) == list(range(n)),
+                "isend: dst pattern must be a permutation (XLA p2p is a "
+                "static ppermute; overlapping destinations need two tags)")
+        return P2pRequest(kind="send", comms=self, payload=x,
+                          pattern=tuple(dsts), tag=tag)
+
+    def irecv(self, src: Sequence[int], tag: int = 0) -> "P2pRequest":
+        """Post a receive: rank r expects the message sent by absolute rank
+        ``src[r]`` under ``tag`` (reference: comms.hpp:156 ``irecv``).  The
+        buffer materializes at waitall()."""
+        n = self.get_size()
+        expects(isinstance(n, int), "irecv needs a static axis size")
+        srcs = [int(s) % n for s in src]
+        expects(len(srcs) == n, f"irecv: src must list all {n} ranks")
+        return P2pRequest(kind="recv", comms=self, payload=None,
+                          pattern=tuple(srcs), tag=tag)
+
+    def waitall(self, requests: Sequence["P2pRequest"]):
+        """Complete posted p2p requests (reference: comms.hpp:168
+        ``waitall``).  Matches send/recv pairs by tag, checks the patterns
+        agree, issues one ppermute per tag, and fills each recv request's
+        ``.data``.  Returns the list of delivered recv buffers in posting
+        order."""
+        sends = {r.tag: r for r in requests if r.kind == "send"}
+        recvs = [r for r in requests if r.kind == "recv"]
+        expects(len(sends) == len([r for r in requests
+                                   if r.kind == "send"]),
+                "waitall: one send per tag (batch distinct messages under "
+                "distinct tags)")
+        delivered = []
+        for r in recvs:
+            expects(r.tag in sends, f"waitall: no send posted for tag "
+                                    f"{r.tag}")
+            s = sends[r.tag]
+            expects(s.comms.axis_name == r.comms.axis_name,
+                    "waitall: send and recv posted on different "
+                    "communicators for tag "
+                    f"{r.tag} ({s.comms.axis_name} vs {r.comms.axis_name})")
+            # consistency: the sender targeting rank k must be the rank k
+            # expects — dst[src[k]] == k
+            for k, src_k in enumerate(r.pattern):
+                expects(s.pattern[src_k] == k,
+                        "waitall: send dst pattern and recv src pattern "
+                        f"disagree at rank {k}")
+            perm = [(rank, dst) for rank, dst in enumerate(s.pattern)]
+            # permute on the axis the requests were POSTED on (not the
+            # communicator waitall happens to be called through)
+            r.data = jax.lax.ppermute(s.payload, s.comms.axis_name, perm)
+            delivered.append(r.data)
+        return delivered
+
+    # -- point-to-point (shift patterns) -----------------------------------
     def device_sendrecv(self, x, dst: int, src: int):
         """Simultaneous send-to-dst / recv-from-src
         (reference: device_sendrecv).  Expressed as a ppermute: every rank
@@ -170,11 +254,33 @@ class Comms:
         return jax.lax.all_gather(x, self.axis_name)
 
     # -- split / sync ------------------------------------------------------
-    def comm_split(self, axis_name: str) -> "Comms":
-        """Sub-communicator on another mesh axis (reference: comm_split,
-        core/comms.hpp:272 — 2D row/col grids).  On TPU the 2D grid is the
-        mesh itself; splitting = binding to the other axis."""
-        return Comms(axis_name=axis_name)
+    def comm_split(self, axis_name: Optional[str] = None, key: int = 0, *,
+                   grouped_by: Optional[str] = None) -> "Comms":
+        """Sub-communicator (reference: comm_split, core/comms.hpp:272 —
+        the 2D row/col grid pattern of resource/sub_comms.hpp).
+
+        On TPU the 2D grid is the *mesh* itself, declared up front
+        (``session.make_2d_session``); splitting means binding to one of
+        its axes:
+
+        - ``comm_split("row")`` — explicit axis bind (the 0.1.x API);
+        - ``comm_split(grouped_by="row")`` — MPI-color style: ranks
+          sharing a row-index form a communicator, which on a
+          ("row", "col") mesh is the communicator ALONG "col" (and vice
+          versa).  ``key`` (rank reordering) is accepted for signature
+          parity; mesh-axis order already fixes ranks.
+        """
+        del key
+        expects((axis_name is None) != (grouped_by is None),
+                "comm_split: pass exactly one of axis_name / grouped_by")
+        if axis_name is not None:
+            return Comms(axis_name=axis_name)
+        expects(grouped_by in ("row", "col"),
+                "comm_split: grouped_by must be 'row' or 'col' (the "
+                "2D-grid contract); arbitrary groupings require declaring "
+                "them as a mesh axis up front")
+        # same row-index ⇒ communicate along the col axis, and vice versa
+        return Comms(axis_name="col" if grouped_by == "row" else "row")
 
     def barrier(self):
         """Reference: barrier.  A psum of a scalar is a full barrier in the

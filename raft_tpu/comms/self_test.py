@@ -149,9 +149,49 @@ def perform_test_comm_split(session: CommsSession) -> bool:
         col = row.comm_split("col")
         a = row.allreduce(jnp.ones((), jnp.float32))
         b = col.allreduce(a)
-        return b[None]
+        # MPI-style color split: ranks sharing a row-index communicate
+        # along "col" — summing row indices over that communicator gives
+        # row_index * n_cols
+        same_row = row.comm_split(grouped_by="row")
+        ri = jax.lax.axis_index("row").astype(jnp.float32)
+        row_sum = same_row.allreduce(ri)
+        ok = row_sum == ri * col.get_size()
+        return (b * ok)[None]
 
     shard = jax.shard_map(body, mesh=mesh2, in_specs=P(),
                           out_specs=P(("row", "col")), check_vma=False)
     res = np.asarray(jax.jit(shard)())
     return bool((res == n).all())
+
+
+def perform_test_comms_isend_irecv(session: CommsSession) -> bool:
+    """Tagged p2p: a ring exchange and a pair swap posted under two tags,
+    completed by one waitall (reference: test.hpp
+    test_pointToPoint_simple_send_recv — UCX tags over absolute ranks)."""
+    comms = session.comms()
+    n = comms.get_size()
+    if n < 2:
+        return True
+
+    ring_dst = [(r + 1) % n for r in range(n)]
+    ring_src = [(r - 1) % n for r in range(n)]
+    # pairwise swap; for odd n the last rank self-sends (stays a permutation)
+    swap = [r + 1 if r % 2 == 0 and r + 1 < n
+            else (r - 1 if r % 2 == 1 else r) for r in range(n)]
+
+    def body():
+        mine = jax.lax.axis_index(session.axis_name).astype(jnp.float32)
+        reqs = [
+            comms.isend(mine, ring_dst, tag=0),
+            comms.irecv(ring_src, tag=0),
+            comms.isend(mine * 10.0, swap, tag=1),
+            comms.irecv(swap, tag=1),        # swap is its own inverse
+        ]
+        ring_got, swap_got = comms.waitall(reqs)
+        ok_ring = ring_got == (mine - 1) % n
+        ok_swap = swap_got == jnp.asarray(swap, jnp.float32)[
+            jax.lax.axis_index(session.axis_name)] * 10.0
+        return (ok_ring & ok_swap)[None]
+
+    res = np.asarray(_run(session, body))
+    return bool(res.all())

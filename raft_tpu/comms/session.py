@@ -87,6 +87,22 @@ class CommsSession:
         self._initialized = False
 
 
+def make_2d_session(rows: int, cols: int,
+                    devices: Optional[Sequence[jax.Device]] = None,
+                    axis_name: str = "row") -> "CommsSession":
+    """Session over a 2-D (row, col) device grid — the reference's
+    sub-communicator pattern (core/resource/sub_comms.hpp; comm_split
+    core/comms.hpp:272).  ``comms().comm_split(color=...)`` then yields the
+    row/col communicators."""
+    devs = list(devices) if devices is not None else jax.devices()
+    expects(len(devs) >= rows * cols,
+            f"make_2d_session: need {rows * cols} devices, "
+            f"have {len(devs)}")
+    mesh = jax.sharding.Mesh(
+        np.asarray(devs[:rows * cols]).reshape(rows, cols), ("row", "col"))
+    return CommsSession(mesh=mesh, axis_name=axis_name)
+
+
 def local_handle(session_id: str, seed: int = 0) -> DeviceResources:
     """Fetch a handle bound to a registered session (reference:
     raft_dask/common/comms.py:245 ``local_handle``)."""

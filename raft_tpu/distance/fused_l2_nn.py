@@ -44,6 +44,15 @@ def fused_l2_nn(
     m, k = x.shape
     n = y.shape[0]
     tile_n = min(tile_n, n)
+    # bound the (m, tile_n) working tile: at m=1M, tile_n=2048 it is 8 GB
+    # fp32 — chunk the x side so the transient stays ~1 GB
+    tile_m = 131_072
+    if m > tile_m:
+        outs = [fused_l2_nn.__wrapped__(x[s:s + tile_m], y, sqrt=sqrt,
+                                        tile_n=tile_n)
+                for s in range(0, m, tile_m)]
+        return (jnp.concatenate([o[0] for o in outs]),
+                jnp.concatenate([o[1] for o in outs]))
     n_tiles = -(-n // tile_n)
     padded = n_tiles * tile_n
 

@@ -197,45 +197,79 @@ def _subspace_split(x: jax.Array, pq_dim: int) -> jax.Array:
     return x.reshape(n, pq_dim, rd // pq_dim)
 
 
+# codebook k-means needs ~book_size * a-few-hundred rows; more adds wall
+# clock without moving the centroids
+_BOOK_TRAIN_ROWS = 65_536
+
+
 @functools.partial(jax.jit, static_argnames=("book_size", "n_iters"))
 def _train_books_per_subspace(resid_sub, keys, book_size, n_iters):
-    """vmap balanced k-means over subspaces.
+    """Balanced k-means per subspace, sequential over subspaces.
 
     resid_sub: (pq_dim, n, pq_len) -> codebooks (pq_dim, book, pq_len).
     Reference: train_per_subset (ivf_pq_build.cuh:337) loops
-    build_clusters per subspace; here one vmapped compilation.
+    build_clusters per subspace.  ``lax.map`` (NOT vmap): a vmapped
+    balanced loop materializes the (pq_dim, n, book) distance tile at
+    once — 16 GB at SIFT-1M scale — while the sequential map peaks at one
+    subspace's tile.  Rows are subsampled to _BOOK_TRAIN_ROWS (strided —
+    the trainset is already caller-shuffled).
     """
-    def one(sub, key):
-        n = sub.shape[0]
-        stride = max(n // book_size, 1)
+    n = resid_sub.shape[1]
+    if n > _BOOK_TRAIN_ROWS:
+        stride = n // _BOOK_TRAIN_ROWS
+        resid_sub = resid_sub[:, ::stride][:, :_BOOK_TRAIN_ROWS]
+
+    def one(args):
+        sub, key = args
+        m = sub.shape[0]
+        stride = max(m // book_size, 1)
         c0 = sub[::stride][:book_size]
         c0 = jnp.pad(c0, ((0, book_size - c0.shape[0]), (0, 0)), mode="edge")
         centers, _ = kmeans_balanced._balanced_loop(
             sub, c0, key, book_size, n_iters, DistanceType.L2Expanded)
         return centers
 
-    return jax.vmap(one)(resid_sub, keys)
+    return jax.lax.map(one, (resid_sub, keys))
 
 
 def _encode(codebooks, resid, codebook_kind, labels=None):
     """PQ-encode residuals (n, pq_dim, pq_len) -> (n, pq_dim) uint8.
 
     Reference: process_and_fill_codes_kernel (ivf_pq_build.cuh:944) — the
-    per-subspace argmin over the codebook.
+    per-subspace argmin over the codebook.  Chunked over rows with
+    ``lax.map``: the full (n, pq_dim, book) distance tensor is 32 GB at
+    SIFT-1M scale.
     """
-    if codebook_kind == CodebookKind.PER_SUBSPACE:
-        # d[n, j, k] = ||resid[n,j,:] - cb[j,k,:]||^2; argmin over k
-        ip = jnp.einsum("njl,jkl->njk", resid, codebooks,
-                        precision=get_matmul_precision())
-        cb_sq = jnp.sum(codebooks * codebooks, axis=-1)  # (j, k)
-        d = cb_sq[None, :, :] - 2.0 * ip
-    else:
-        cb = codebooks[labels]                            # (n, book, pq_len)
-        ip = jnp.einsum("njl,nkl->njk", resid, cb,
-                        precision=get_matmul_precision())
-        cb_sq = jnp.sum(cb * cb, axis=-1)                 # (n, k)
-        d = cb_sq[:, None, :] - 2.0 * ip
-    return jnp.argmin(d, axis=-1).astype(jnp.uint8)
+    n = resid.shape[0]
+    chunk = 65_536
+
+    def enc(args):
+        r, lab = args
+        if codebook_kind == CodebookKind.PER_SUBSPACE:
+            # d[c, j, k] = ||r[c,j,:] - cb[j,k,:]||^2; argmin over k
+            ip = jnp.einsum("njl,jkl->njk", r, codebooks,
+                            precision=get_matmul_precision())
+            cb_sq = jnp.sum(codebooks * codebooks, axis=-1)  # (j, k)
+            d = cb_sq[None, :, :] - 2.0 * ip
+        else:
+            cb = codebooks[lab]                          # (c, book, pq_len)
+            ip = jnp.einsum("njl,nkl->njk", r, cb,
+                            precision=get_matmul_precision())
+            cb_sq = jnp.sum(cb * cb, axis=-1)            # (c, k)
+            d = cb_sq[:, None, :] - 2.0 * ip
+        return jnp.argmin(d, axis=-1).astype(jnp.uint8)
+
+    if labels is None:
+        labels = jnp.zeros(n, jnp.int32)
+    if n <= chunk:
+        return enc((resid, labels))
+    n_pad = -(-n // chunk) * chunk
+    rp = jnp.pad(resid, ((0, n_pad - n), (0, 0), (0, 0)))
+    lp = jnp.pad(labels, (0, n_pad - n))
+    rp = rp.reshape(n_pad // chunk, chunk, *resid.shape[1:])
+    lp = lp.reshape(n_pad // chunk, chunk)
+    out = jax.lax.map(enc, (rp, lp))
+    return out.reshape(n_pad, -1)[:n]
 
 
 def build(res, params: IndexParams, dataset) -> Index:
@@ -617,7 +651,8 @@ def serialize(res, stream: BinaryIO, index: Index) -> None:
         ser.serialize_mdspan(res, stream, arr)
 
 
-def deserialize(res, stream: BinaryIO) -> Index:
+def deserialize(res, stream: BinaryIO, *,
+                cache_reconstructions: bool = True) -> Index:
     version = int(ser.deserialize_scalar(res, stream))
     if version != _SERIALIZATION_VERSION:
         raise ValueError(
@@ -628,6 +663,11 @@ def deserialize(res, stream: BinaryIO) -> Index:
     pq_bits = int(ser.deserialize_scalar(res, stream))
     arrays = [jnp.asarray(ser.deserialize_mdspan(res, stream))
               for _ in range(6)]
-    # the reconstruction cache is derived state: re-decode from codes
-    return _with_recon(res, Index(*arrays, metric=metric,
-                                  codebook_kind=kind, pq_bits=pq_bits))
+    index = Index(*arrays, metric=metric, codebook_kind=kind,
+                  pq_bits=pq_bits)
+    # the reconstruction cache is derived state: re-decode from codes —
+    # unless the caller opted out (indexes too large for the cache, the
+    # same regime as IndexParams.cache_reconstructions=False)
+    if cache_reconstructions:
+        index = _with_recon(res, index)
+    return index

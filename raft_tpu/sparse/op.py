@@ -111,9 +111,14 @@ def max_duplicates(coo: CooMatrix) -> CooMatrix:
     gid = jnp.cumsum(mask) - 1
     live = coo.rows < n_rows
     gid = jnp.where(live, gid, coo.nnz - 1)
-    maxv = jnp.full((coo.nnz,), -jnp.inf, jnp.float32) \
-        .at[gid].max(jnp.where(live, coo.vals.astype(jnp.float32),
-                               -jnp.inf))
+    # reduce in the values' own dtype (a float32 detour would corrupt
+    # int64 / float64 values beyond 2^24)
+    if jnp.issubdtype(coo.vals.dtype, jnp.floating):
+        lowest = jnp.array(-jnp.inf, coo.vals.dtype)
+    else:
+        lowest = jnp.array(jnp.iinfo(coo.vals.dtype).min, coo.vals.dtype)
+    maxv = jnp.full((coo.nnz,), lowest, coo.vals.dtype) \
+        .at[gid].max(jnp.where(live, coo.vals, lowest))
     n_groups = jnp.sum(mask)
     slot = jnp.arange(coo.nnz)
     is_first = mask == 1
