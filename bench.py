@@ -31,7 +31,7 @@ PQ_DIM = 64
 # (n_probes, refine_ratio) operating points — the reference harness sweeps
 # n_probes and supports refine_ratio for raft_ivf_pq
 # (cpp/bench/ann/conf/sift-128-euclidean.json)
-OPERATING_POINTS = ((32, 1), (64, 1), (32, 2), (64, 2), (128, 2))
+OPERATING_POINTS = ((32, 1), (64, 1), (32, 2), (64, 2), (96, 2), (128, 2))
 MIN_RECALL = 0.95
 # SIFT-like synthetic data: descriptors have low intrinsic dimensionality
 # (~16) embedded in 128-d; uniform random 128-d is adversarial to PQ (all
