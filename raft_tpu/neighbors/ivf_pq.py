@@ -490,14 +490,12 @@ def _search_impl_recon(centers, list_recon, list_indices, rotation, queries,
         _, probes = jax.lax.top_k(2.0 * q_dot_c - c_sq[None, :], n_probes)
 
     worst = -jnp.inf if ip_metric else jnp.inf
+    cap = list_recon.shape[1]
     # loop-invariant: per-row squared norms of the residual reconstructions
     rec_sq = jnp.sum(list_recon.astype(jnp.float32) ** 2, axis=-1)
 
-    init = (jnp.full((nq, k), worst, jnp.float32),
-            jnp.full((nq, k), -1, jnp.int32))
-
-    def probe_step(carry, p):
-        best_d, best_i = carry
+    def probe_distances(p):
+        """(q, cap) quantized distances + ids for probe rank p."""
         lists = probes[:, p]                         # (q,)
         data = list_recon[lists]                     # (q, cap, rot) bf16
         ids = list_indices[lists]                    # (q, cap)
@@ -507,7 +505,6 @@ def _search_impl_recon(centers, list_recon, list_indices, rotation, queries,
             ip = jnp.einsum("qd,qcd->qc", qb, data,
                             preferred_element_type=jnp.float32)
             d = ip + jnp.take_along_axis(q_dot_c, lists[:, None], axis=1)
-            d = jnp.where(ids >= 0, d, worst)
         else:
             # residual space: ||resid_q - dec_resid||^2 — small magnitudes,
             # so the bf16 MXU pass loses no meaningful precision
@@ -516,14 +513,49 @@ def _search_impl_recon(centers, list_recon, list_indices, rotation, queries,
                             preferred_element_type=jnp.float32)
             d = jnp.maximum(jnp.sum(sub * sub, axis=1)[:, None]
                             + rec_sq[lists] - 2.0 * ip, 0.0)
-            d = jnp.where(ids >= 0, d, worst)
-        kt = min(k, d.shape[1])
-        td, ti = select_k(d, kt, in_idx=ids, select_min=not ip_metric)
-        return merge_topk(best_d, best_i, td, ti,
-                          select_min=not ip_metric), None
+        return jnp.where(ids >= 0, d, worst), ids
 
-    (best_d, best_i), _ = jax.lax.scan(probe_step, init,
+    # Two scan structures, same math: accumulating every probe's distance
+    # block then doing ONE select_k beats
+    # per-probe top_k + merge by ~30% (measured on v5e at 500k/128 probes —
+    # the VPU sort work of n_probes small top_ks dominates the saved HBM
+    # round-trip).  Guard the accumulation buffer to ~2.5 GB; the per-probe
+    # merge path remains for huge fan-outs.
+    if nq * n_probes * cap * 8 <= 2_500_000_000:
+        def acc_step(carry, p):
+            alld, alli = carry
+            d, ids = probe_distances(p)
+            alld = jax.lax.dynamic_update_slice(alld, d, (0, p * cap))
+            alli = jax.lax.dynamic_update_slice(alli, ids, (0, p * cap))
+            return (alld, alli), None
+
+        alld = jnp.full((nq, n_probes * cap), worst, jnp.float32)
+        alli = jnp.full((nq, n_probes * cap), -1, jnp.int32)
+        (alld, alli), _ = jax.lax.scan(acc_step, (alld, alli),
                                        jnp.arange(n_probes))
+        kt = min(k, n_probes * cap)
+        best_d, best_i = select_k(alld, kt, in_idx=alli,
+                                  select_min=not ip_metric)
+        if kt < k:  # fewer candidates than k: pad with sentinels
+            best_d = jnp.pad(best_d, ((0, 0), (0, k - kt)),
+                             constant_values=worst)
+            best_i = jnp.pad(best_i, ((0, 0), (0, k - kt)),
+                             constant_values=-1)
+    else:
+        init = (jnp.full((nq, k), worst, jnp.float32),
+                jnp.full((nq, k), -1, jnp.int32))
+
+        def probe_step(carry, p):
+            best_d, best_i = carry
+            d, ids = probe_distances(p)
+            kt = min(k, d.shape[1])
+            td, ti = select_k(d, kt, in_idx=ids,
+                              select_min=not ip_metric)
+            return merge_topk(best_d, best_i, td, ti,
+                              select_min=not ip_metric), None
+
+        (best_d, best_i), _ = jax.lax.scan(probe_step, init,
+                                           jnp.arange(n_probes))
     if metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded):
         best_d = jnp.sqrt(jnp.maximum(best_d, 0.0))
     return best_d, best_i

@@ -1,0 +1,13 @@
+"""Hand-written Pallas TPU kernels.
+
+The analogue of the reference's bespoke-CUDA-kernel layer (the ``.cu``
+instantiation units of cpp/src and the custom kernels under detail/ —
+SURVEY.md §2.10): ops XLA cannot fuse or schedule optimally get explicit
+VMEM-resident Pallas implementations here.  Each kernel ships with an
+interpreter-mode test (CPU) and an on-chip parity check against its XLA
+formulation.
+"""
+
+from raft_tpu.ops.fused_l2_nn_pallas import fused_l2_nn_pallas  # noqa: F401
+
+__all__ = ["fused_l2_nn_pallas"]

@@ -1,0 +1,133 @@
+"""Pallas TPU kernel: fused L2 distance + 1-NN argmin.
+
+The k-means / IVF hot kernel (reference: distance/fused_l2_nn.cuh:100
+``fusedL2NN`` — a CUTLASS-tiled GEMM with a custom argmin epilogue in
+registers; detail/fused_l2_nn.cuh).  The XLA formulation
+(:mod:`raft_tpu.distance.fused_l2_nn`) scans y tiles and materializes an
+(m, tile_n) distance block in HBM per step; this kernel keeps the distance
+tile in VMEM and fuses the argmin epilogue right after the MXU dot —
+the same register-resident epilogue property the CUDA kernel buys, expressed
+as a Pallas grid over (m tiles, n tiles) with the n axis innermost
+accumulating into the output block.
+
+Grid layout:
+  grid = (m/TILE_M, n/TILE_N); x block (TILE_M, k) revisits across j;
+  y block (TILE_N, k) marches; outputs (1, TILE_M) revisit across j and
+  accumulate the running (min, argmin).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_TILE_M = 256
+_TILE_N = 512
+_BIG = 3.0e38  # Python float: jnp scalars would be captured as consts
+
+
+def _kernel(x_ref, y_ref, xsq_ref, ysq_ref, out_d_ref, out_i_ref, *,
+            precision):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        out_d_ref[...] = jnp.full_like(out_d_ref, _BIG)
+        out_i_ref[...] = jnp.zeros_like(out_i_ref)
+
+    x = x_ref[...]                                   # (TILE_M, k)
+    y = y_ref[...]                                   # (TILE_N, k)
+    # MXU: (TILE_M, k) @ (k, TILE_N), fp32 accumulate; precision follows
+    # the library policy (HIGHEST = fp32-true multi-pass, as the XLA path)
+    ip = jax.lax.dot_general(x, y, (((1,), (1,)), ((), ())),
+                             precision=precision,
+                             preferred_element_type=jnp.float32)
+    d = xsq_ref[...].reshape(-1, 1) + ysq_ref[...].reshape(1, -1) \
+        - 2.0 * ip                                   # (TILE_M, TILE_N)
+    # argmin epilogue, VMEM-resident: min + first-match index
+    tile_min = jnp.min(d, axis=1)
+    iota = jax.lax.broadcasted_iota(jnp.int32, d.shape, 1)
+    tile_arg = jnp.min(jnp.where(d == tile_min[:, None], iota,
+                                 jnp.int32(2 ** 30)), axis=1)
+    tile_arg = tile_arg + j * _TILE_N
+
+    best = out_d_ref[0, :]
+    upd = tile_min < best
+    out_d_ref[0, :] = jnp.where(upd, tile_min, best)
+    out_i_ref[0, :] = jnp.where(upd, tile_arg, out_i_ref[0, :])
+
+
+def fused_l2_nn_pallas(x: jax.Array, y: jax.Array, *, sqrt: bool = False,
+                       interpret: bool = False, precision=None
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """(m, k), (n, k) -> (min L2^2 distance (m,), argmin (m,) int32).
+
+    Drop-in for :func:`raft_tpu.distance.fused_l2_nn.fused_l2_nn`'s core.
+    ``interpret=True`` runs the Pallas interpreter (CPU-testable).
+    The precision policy is resolved HERE (eager boundary) and keys the jit
+    cache — reading the global inside the trace would go stale under
+    ``matmul_precision()``.
+    """
+    from raft_tpu.utils.precision import get_matmul_precision
+    if precision is None:
+        precision = get_matmul_precision()
+    return _pallas_jit(x, y, sqrt=sqrt, interpret=interpret,
+                       precision=precision)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("sqrt", "interpret", "precision"))
+def _pallas_jit(x: jax.Array, y: jax.Array, *, sqrt: bool,
+                interpret: bool, precision) -> Tuple[jax.Array, jax.Array]:
+    m, k = x.shape
+    n = y.shape[0]
+    xf = x.astype(jnp.float32)
+    yf = y.astype(jnp.float32)
+
+    m_pad = -(-m // _TILE_M) * _TILE_M
+    n_pad = -(-n // _TILE_N) * _TILE_N
+    xp = jnp.pad(xf, ((0, m_pad - m), (0, 0)))
+    yp = jnp.pad(yf, ((0, n_pad - n), (0, 0)))
+    xsq = jnp.sum(xp * xp, axis=1).reshape(1, m_pad)
+    # padded y rows get +BIG norms so they never win the argmin
+    ysq = jnp.sum(yp * yp, axis=1)
+    ysq = jnp.where(jnp.arange(n_pad) < n, ysq,
+                    jnp.float32(_BIG)).reshape(1, n_pad)
+
+    grid = (m_pad // _TILE_M, n_pad // _TILE_N)
+    out_d, out_i = pl.pallas_call(
+        functools.partial(_kernel, precision=precision),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_TILE_M, k), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_TILE_N, k), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _TILE_M), lambda i, j: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _TILE_N), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, _TILE_M), lambda i, j: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _TILE_M), lambda i, j: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, m_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, m_pad), jnp.int32),
+        ],
+        interpret=interpret,
+    )(xp, yp, xsq, ysq)
+
+    best_d = jnp.maximum(out_d[0, :m], 0.0)
+    best_i = out_i[0, :m]
+    if sqrt:
+        best_d = jnp.sqrt(best_d)
+    return best_d, best_i
