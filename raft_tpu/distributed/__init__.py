@@ -6,5 +6,6 @@ the BASELINE configs require the algorithms too, so raft_tpu ships
 reference-quality MNMG k-means and kNN natively.
 """
 
+from raft_tpu.distributed import ann  # noqa: F401
 from raft_tpu.distributed import kmeans  # noqa: F401
 from raft_tpu.distributed import knn  # noqa: F401

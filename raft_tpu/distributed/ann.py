@@ -1,0 +1,168 @@
+"""Multi-device (MNMG) IVF-PQ: sharded build + search-with-merge.
+
+The reference ships the seam, not the algorithm: row-sharded ANN with
+per-part search and a top-k merge (``knn_merge_parts``,
+neighbors/brute_force.cuh:80; the ANN bench's ``multigpu`` option,
+docs/source/cuda_ann_benchmarks.md:163; CAGRA's explicit multi-GPU chunking,
+detail/cagra/graph_core.cuh:333-369).  raft_tpu provides the full algorithm:
+
+- **build**: rows are split across the mesh axis; each shard trains its own
+  local IVF-PQ index over its rows (ids pre-offset to global), and the local
+  indexes are stacked leaf-wise into one device-sharded pytree — shard i's
+  leaves live on device i (``P(axis)`` on the stacked axis).
+- **search**: one ``shard_map`` — every device searches its local shard with
+  the single-chip kernel (queries replicated), then an ``all_gather`` of the
+  (q, k) candidates (tiny payload over ICI) and a replicated merge-select.
+
+This is the same shard → local select_k → all_gather → merge shape as
+:mod:`raft_tpu.distributed.knn`, applied to the compressed index.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import ensure_array
+from raft_tpu.core.tracing import range as named_range
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.matrix.select_k import select_k
+from raft_tpu.neighbors import ivf_pq
+
+P = jax.sharding.PartitionSpec
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class DistributedIndex:
+    """Leaf-stacked local IVF-PQ indexes: every leaf carries a leading
+    mesh-axis dimension (n_dev, ...) sharded one shard per device."""
+
+    centers: jax.Array        # (n_dev, n_lists, rot_dim)
+    codebooks: jax.Array
+    list_codes: jax.Array     # (n_dev, n_lists, cap, pq_dim)
+    list_indices: jax.Array   # (n_dev, n_lists, cap) — GLOBAL ids
+    list_sizes: jax.Array
+    rotation: jax.Array       # (n_dev, dim, rot_dim)
+    list_recon: jax.Array     # (n_dev, n_lists, cap, rot_dim) bf16
+    metric: int = DistanceType.L2Expanded
+    size: int = 0
+
+    @property
+    def n_shards(self) -> int:
+        return self.centers.shape[0]
+
+    def tree_flatten(self):
+        return ((self.centers, self.codebooks, self.list_codes,
+                 self.list_indices, self.list_sizes, self.rotation,
+                 self.list_recon), (self.metric, self.size))
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves, metric=aux[0], size=aux[1])
+
+
+def build(handle, params: ivf_pq.IndexParams, dataset) -> DistributedIndex:
+    """Shard rows over the handle's mesh and build one local index per
+    shard (ids globally offset).  ``params.n_lists`` is per shard."""
+    with named_range("distributed::ivf_pq_build"):
+        expects(handle.comms_initialized(),
+                "distributed.ann.build: handle has no comms (use "
+                "CommsSession.worker_handle())")
+        comms = handle.get_comms()
+        mesh = handle.mesh
+        axis = comms.axis_name
+        dataset = ensure_array(dataset, "dataset")
+        n = dataset.shape[0]
+        n_dev = mesh.shape[axis]
+        expects(n % n_dev == 0,
+                f"distributed.ann.build: n ({n}) must divide evenly over "
+                f"{n_dev} devices (pad the input)")
+        per = n // n_dev
+        expects(params.cache_reconstructions,
+                "distributed.ann: the sharded search kernel runs the "
+                "reconstruction path; cache_reconstructions must be True")
+
+        locals_ = []
+        for s in range(n_dev):
+            shard = dataset[s * per:(s + 1) * per]
+            idx = ivf_pq.build(handle, params, shard)
+            # globalize ids: local slot ids are 0..per-1 over the shard
+            idx.list_indices = jnp.where(
+                idx.list_indices >= 0, idx.list_indices + s * per, -1)
+            locals_.append(idx)
+
+        cap = max(ix.capacity for ix in locals_)
+
+        def pad_cap(a, fill):
+            return jnp.pad(a, ((0, 0), (0, cap - a.shape[1]))
+                           + ((0, 0),) * (a.ndim - 2),
+                           constant_values=fill)
+
+        stacked = DistributedIndex(
+            centers=jnp.stack([ix.centers for ix in locals_]),
+            codebooks=jnp.stack([ix.codebooks for ix in locals_]),
+            list_codes=jnp.stack([pad_cap(ix.list_codes, 0)
+                                  for ix in locals_]),
+            list_indices=jnp.stack([pad_cap(ix.list_indices, -1)
+                                    for ix in locals_]),
+            list_sizes=jnp.stack([ix.list_sizes for ix in locals_]),
+            rotation=jnp.stack([ix.rotation for ix in locals_]),
+            list_recon=jnp.stack([pad_cap(ix.list_recon, 0)
+                                  for ix in locals_]),
+            metric=params.metric, size=n)
+        # one shard per device along the mesh axis
+        leaves, aux = stacked.tree_flatten()
+        placed = tuple(
+            jax.device_put(leaf, jax.sharding.NamedSharding(
+                mesh, P(axis, *([None] * (leaf.ndim - 1)))))
+            for leaf in leaves)
+        return DistributedIndex.tree_unflatten(aux, placed)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "n_probes", "metric",
+                                             "axis_name", "mesh"))
+def _dist_search(index_leaves, queries, k, n_probes, metric, axis_name,
+                 mesh):
+    centers, _, _, list_indices, _, rotation, list_recon = index_leaves
+    specs = tuple(P(axis_name, *([None] * (leaf.ndim - 1)))
+                  for leaf in index_leaves)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(specs, P()), out_specs=(P(), P()),
+                       check_vma=False)
+    def run(leaves, q):
+        centers, _, _, list_indices, _, rotation, list_recon = leaves
+        ld, li = ivf_pq._search_impl_recon(
+            centers[0], list_recon[0], list_indices[0], rotation[0], q,
+            k, n_probes, metric)
+        select_min = metric != DistanceType.InnerProduct
+        all_d = jax.lax.all_gather(ld, axis_name)   # (n_dev, q, k)
+        all_i = jax.lax.all_gather(li, axis_name)
+        nq = q.shape[0]
+        return select_k(
+            jnp.transpose(all_d, (1, 0, 2)).reshape(nq, -1), k,
+            in_idx=jnp.transpose(all_i, (1, 0, 2)).reshape(nq, -1),
+            select_min=select_min)
+
+    return run(index_leaves, queries)
+
+
+def search(handle, params: ivf_pq.SearchParams, index: DistributedIndex,
+           queries, k: int) -> Tuple[jax.Array, jax.Array]:
+    """Sharded search + merge; returns replicated (distances, global ids)
+    of shape (q, k)."""
+    with named_range("distributed::ivf_pq_search"):
+        expects(handle.comms_initialized(),
+                "distributed.ann.search: handle has no comms")
+        comms = handle.get_comms()
+        queries = ensure_array(queries, "queries")
+        n_probes = min(params.n_probes, index.centers.shape[1])
+        leaves, _ = index.tree_flatten()
+        return _dist_search(tuple(leaves), queries, int(k), n_probes,
+                            index.metric, comms.axis_name, handle.mesh)
