@@ -88,6 +88,11 @@ def build(handle, params: ivf_pq.IndexParams, dataset) -> DistributedIndex:
                 "distributed.ann: the sharded search kernel runs the "
                 "reconstruction path; cache_reconstructions must be True")
 
+        expects(mesh.devices.ndim == 1,
+                "distributed.ann.build: a 1-D mesh is required (reshape "
+                "2D grids to the data axis for index sharding)")
+        devs = mesh.devices.ravel()
+
         locals_ = []
         for s in range(n_dev):
             shard = dataset[s * per:(s + 1) * per]
@@ -104,32 +109,33 @@ def build(handle, params: ivf_pq.IndexParams, dataset) -> DistributedIndex:
                            + ((0, 0),) * (a.ndim - 2),
                            constant_values=fill)
 
-        stacked = DistributedIndex(
-            centers=jnp.stack([ix.centers for ix in locals_]),
-            codebooks=jnp.stack([ix.codebooks for ix in locals_]),
-            list_codes=jnp.stack([pad_cap(ix.list_codes, 0)
-                                  for ix in locals_]),
-            list_indices=jnp.stack([pad_cap(ix.list_indices, -1)
-                                    for ix in locals_]),
-            list_sizes=jnp.stack([ix.list_sizes for ix in locals_]),
-            rotation=jnp.stack([ix.rotation for ix in locals_]),
-            list_recon=jnp.stack([pad_cap(ix.list_recon, 0)
-                                  for ix in locals_]),
-            metric=params.metric, size=n)
-        # one shard per device along the mesh axis
-        leaves, aux = stacked.tree_flatten()
-        placed = tuple(
-            jax.device_put(leaf, jax.sharding.NamedSharding(
-                mesh, P(axis, *([None] * (leaf.ndim - 1)))))
-            for leaf in leaves)
-        return DistributedIndex.tree_unflatten(aux, placed)
+        per_shard_leaves = [
+            (ix.centers, ix.codebooks, pad_cap(ix.list_codes, 0),
+             pad_cap(ix.list_indices, -1), ix.list_sizes, ix.rotation,
+             pad_cap(ix.list_recon, 0))
+            for ix in locals_]
+
+        # Assemble each stacked leaf from per-device shards — never
+        # materializing the (n_dev, ...) stack on one device, whose HBM the
+        # full index may not fit (the regime MNMG sharding exists for).
+        placed = []
+        for li in range(len(per_shard_leaves[0])):
+            shards = [jax.device_put(per_shard_leaves[s][li][None],
+                                     devs[s]) for s in range(n_dev)]
+            shape = (n_dev,) + per_shard_leaves[0][li].shape
+            sharding = jax.sharding.NamedSharding(
+                mesh, P(axis, *([None] * (len(shape) - 1))))
+            placed.append(jax.make_array_from_single_device_arrays(
+                shape, sharding, shards))
+        return DistributedIndex.tree_unflatten(
+            (params.metric, n), tuple(placed))
 
 
 @functools.partial(jax.jit, static_argnames=("k", "n_probes", "metric",
                                              "axis_name", "mesh"))
 def _dist_search(index_leaves, queries, k, n_probes, metric, axis_name,
                  mesh):
-    centers, _, _, list_indices, _, rotation, list_recon = index_leaves
+    # only the leaves the recon search kernel consumes are threaded through
     specs = tuple(P(axis_name, *([None] * (leaf.ndim - 1)))
                   for leaf in index_leaves)
 
@@ -137,7 +143,7 @@ def _dist_search(index_leaves, queries, k, n_probes, metric, axis_name,
                        in_specs=(specs, P()), out_specs=(P(), P()),
                        check_vma=False)
     def run(leaves, q):
-        centers, _, _, list_indices, _, rotation, list_recon = leaves
+        centers, list_indices, rotation, list_recon = leaves
         ld, li = ivf_pq._search_impl_recon(
             centers[0], list_recon[0], list_indices[0], rotation[0], q,
             k, n_probes, metric)
@@ -163,6 +169,7 @@ def search(handle, params: ivf_pq.SearchParams, index: DistributedIndex,
         comms = handle.get_comms()
         queries = ensure_array(queries, "queries")
         n_probes = min(params.n_probes, index.centers.shape[1])
-        leaves, _ = index.tree_flatten()
-        return _dist_search(tuple(leaves), queries, int(k), n_probes,
+        leaves = (index.centers, index.list_indices, index.rotation,
+                  index.list_recon)
+        return _dist_search(leaves, queries, int(k), n_probes,
                             index.metric, comms.axis_name, handle.mesh)
