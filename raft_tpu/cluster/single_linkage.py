@@ -52,7 +52,13 @@ def _host_union_find_labels(src, dst, w, n, n_clusters
                             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Sort MST edges by weight, union in order, stop at n_clusters
     components (reference: detail/agglomerative.cuh build_dendrogram_host +
-    extract_flattened_clusters)."""
+    extract_flattened_clusters).  Runs the native C++ union-find when the
+    compiled library is available (raft_tpu.native); this pure-Python body
+    is the fallback and the reference implementation for its tests."""
+    from raft_tpu import native
+    out = native.build_dendrogram(src, dst, w, n, n_clusters)
+    if out is not None:
+        return out
     order = np.argsort(w, kind="stable")
     parent = np.arange(n)
 
@@ -135,19 +141,24 @@ def single_linkage(
                                                 DistanceType.L2SqrtUnexpanded)
                                   else cw[ok]])
             # recompute components on host union-find over current edges
-            parent = np.arange(n)
+            from raft_tpu import native
+            cc = native.connected_components(src_h, dst_h, n)
+            if cc is not None:
+                colors = cc[0]
+            else:
+                parent = np.arange(n)
 
-            def find(x):
-                while parent[x] != x:
-                    parent[x] = parent[parent[x]]
-                    x = parent[x]
-                return x
+                def find(x):
+                    while parent[x] != x:
+                        parent[x] = parent[parent[x]]
+                        x = parent[x]
+                    return x
 
-            for a, b in zip(src_h, dst_h):
-                ra, rb = find(int(a)), find(int(b))
-                if ra != rb:
-                    parent[max(ra, rb)] = min(ra, rb)
-            colors = np.asarray([find(i) for i in range(n)])
+                for a, b in zip(src_h, dst_h):
+                    ra, rb = find(int(a)), find(int(b))
+                    if ra != rb:
+                        parent[max(ra, rb)] = min(ra, rb)
+                colors = np.asarray([find(i) for i in range(n)])
             guard += 1
 
         labels, dendrogram, heights = _host_union_find_labels(
