@@ -37,7 +37,7 @@ from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.core.tracing import range as named_range
 from raft_tpu.distance.types import DistanceType
-from raft_tpu.matrix.select_k import merge_topk, select_k
+from raft_tpu.matrix.select_k import select_k
 from raft_tpu.utils.precision import get_matmul_precision
 from raft_tpu.core.outputs import auto_convert_output
 
@@ -254,14 +254,18 @@ def _search_impl(centers, list_data, list_indices, queries, k, n_probes,
         coarse = c_sq[None, :] - 2.0 * q_dot_c  # + q² is rank-invariant
         _, probes = jax.lax.top_k(-coarse, n_probes)
 
-    # ---- fine: scan probed lists, merge running top-k --------------------
+    # ---- fine: scan probed lists, hierarchical select --------------------
+    # per-probe local top-k inside the scan + ONE final select over the
+    # n_probes*k survivors (exact — probe lists are disjoint; same
+    # restructure as ivf_pq._search_impl_recon, where the trace showed the
+    # per-probe merge chain / single wide sort dominating)
     worst = -jnp.inf if ip_metric else jnp.inf
     q_sq = jnp.sum(qf * qf, axis=1)
-    init = (jnp.full((nq, k), worst, jnp.float32),
-            jnp.full((nq, k), -1, jnp.int32))
+    cap = list_data.shape[1]
+    kt = min(k, cap)
 
     def probe_step(carry, p):
-        best_d, best_i = carry
+        alld, alli = carry
         lists = probes[:, p]                        # (q,)
         data = list_data[lists].astype(jnp.float32)  # (q, cap, d)
         ids = list_indices[lists]                   # (q, cap)
@@ -273,13 +277,23 @@ def _search_impl(centers, list_data, list_indices, queries, k, n_probes,
             d_sq = jnp.sum(data * data, axis=-1)
             d = jnp.maximum(q_sq[:, None] + d_sq - 2.0 * ip, 0.0)
             d = jnp.where(ids >= 0, d, worst)
-        kt = min(k, d.shape[1])
         td, ti = select_k(d, kt, in_idx=ids, select_min=not ip_metric)
-        return merge_topk(best_d, best_i, td, ti,
-                          select_min=not ip_metric), None
+        alld = jax.lax.dynamic_update_slice(alld, td, (0, p * kt))
+        alli = jax.lax.dynamic_update_slice(alli, ti, (0, p * kt))
+        return (alld, alli), None
 
-    (best_d, best_i), _ = jax.lax.scan(probe_step, init,
-                                       jnp.arange(n_probes))
+    init = (jnp.full((nq, n_probes * kt), worst, jnp.float32),
+            jnp.full((nq, n_probes * kt), -1, jnp.int32))
+    (alld, alli), _ = jax.lax.scan(probe_step, init,
+                                   jnp.arange(n_probes))
+    kf = min(k, n_probes * kt)
+    best_d, best_i = select_k(alld, kf, in_idx=alli,
+                              select_min=not ip_metric)
+    if kf < k:
+        best_d = jnp.pad(best_d, ((0, 0), (0, k - kf)),
+                         constant_values=worst)
+        best_i = jnp.pad(best_i, ((0, 0), (0, k - kf)),
+                         constant_values=-1)
     if metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded):
         best_d = jnp.sqrt(jnp.maximum(best_d, 0.0))
     return best_d, best_i
