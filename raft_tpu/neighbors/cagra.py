@@ -118,7 +118,7 @@ def build_knn_graph(
     intermediate_degree: int,
     *,
     params: Optional[IndexParams] = None,
-    batch: int = 2048,
+    batch: int = 8192,
 ) -> jax.Array:
     """All-nodes kNN graph via IVF-PQ + exact refine
     (reference: cagra.cuh:77 → cagra_build.cuh:43-171).

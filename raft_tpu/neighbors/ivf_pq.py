@@ -48,7 +48,7 @@ from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.core.tracing import range as named_range
 from raft_tpu.distance.types import DistanceType
-from raft_tpu.matrix.select_k import merge_topk, select_k
+from raft_tpu.matrix.select_k import select_k
 from raft_tpu.neighbors.ivf_flat import _pack_lists, _round_up, _LIST_ALIGN
 from raft_tpu.utils.precision import get_matmul_precision
 from raft_tpu.core.outputs import auto_convert_output
@@ -574,14 +574,14 @@ def _search_impl(centers, codebooks, list_codes, list_indices, rotation,
         _, probes = jax.lax.top_k(2.0 * q_dot_c - c_sq[None, :], n_probes)
 
     worst = -jnp.inf if ip_metric else jnp.inf
-    init = (jnp.full((nq, k), worst, jnp.float32),
-            jnp.full((nq, k), -1, jnp.int32))
+    cap = list_codes.shape[1]
+    kt = min(k, cap)
     cb_sq = jnp.sum(codebooks.astype(jnp.float32) ** 2, axis=-1)
 
     q_sub = _subspace_split(qrot, pq_dim)               # (q, j, l)
 
     def probe_step(carry, p):
-        best_d, best_i = carry
+        alld, alli = carry
         lists = probes[:, p]                            # (q,)
         if ip_metric:
             # score = q·x ≈ q·center + Σ_j <q_j, cb[code_j]>: the LUT is the
@@ -618,13 +618,24 @@ def _search_impl(centers, codebooks, list_codes, list_indices, rotation,
             # comparability in the merged top-k
             d = d + jnp.sum(sub * sub, axis=(1, 2))[:, None]
         d = jnp.where(ids >= 0, d, worst)
-        kt = min(k, d.shape[1])
         td, ti = select_k(d, kt, in_idx=ids, select_min=not ip_metric)
-        return merge_topk(best_d, best_i, td, ti,
-                          select_min=not ip_metric), None
+        alld = jax.lax.dynamic_update_slice(alld, td, (0, p * kt))
+        alli = jax.lax.dynamic_update_slice(alli, ti, (0, p * kt))
+        return (alld, alli), None
 
-    (best_d, best_i), _ = jax.lax.scan(probe_step, init,
-                                       jnp.arange(n_probes))
+    # hierarchical select (exact; see _search_impl_recon)
+    init = (jnp.full((nq, n_probes * kt), worst, jnp.float32),
+            jnp.full((nq, n_probes * kt), -1, jnp.int32))
+    (alld, alli), _ = jax.lax.scan(probe_step, init,
+                                   jnp.arange(n_probes))
+    kf = min(k, n_probes * kt)
+    best_d, best_i = select_k(alld, kf, in_idx=alli,
+                              select_min=not ip_metric)
+    if kf < k:
+        best_d = jnp.pad(best_d, ((0, 0), (0, k - kf)),
+                         constant_values=worst)
+        best_i = jnp.pad(best_i, ((0, 0), (0, k - kf)),
+                         constant_values=-1)
     if metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded):
         best_d = jnp.sqrt(jnp.maximum(best_d, 0.0))
     return best_d, best_i
