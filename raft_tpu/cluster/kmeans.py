@@ -38,7 +38,7 @@ from raft_tpu.distance.fused_l2_nn import fused_l2_nn
 from raft_tpu.distance.pairwise import pairwise_distance
 from raft_tpu.distance.types import DistanceType
 from raft_tpu.utils.precision import get_matmul_precision
-from raft_tpu.core.outputs import auto_convert_output
+from raft_tpu.core.outputs import auto_convert_output, raw
 
 
 # ---------------------------------------------------------------------------
@@ -59,12 +59,12 @@ def min_cluster_and_distance(
     L2 metrics, raw metric values otherwise.
     """
     if metric in (DistanceType.L2Expanded, DistanceType.L2Unexpanded):
-        d, i = fused_l2_nn(X, centroids)
+        d, i = raw(fused_l2_nn)(X, centroids)
         return i, d
     if metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded):
-        d, i = fused_l2_nn(X, centroids, sqrt=True)
+        d, i = raw(fused_l2_nn)(X, centroids, sqrt=True)
         return i, d
-    dmat = pairwise_distance(X, centroids, metric)
+    dmat = raw(pairwise_distance)(X, centroids, metric)
     return jnp.argmin(dmat, axis=1).astype(jnp.int32), jnp.min(dmat, axis=1)
 
 
@@ -300,18 +300,19 @@ def fit_predict(res, params: KMeansParams, X,
                 sample_weight: Optional[jax.Array] = None,
                 centroids: Optional[jax.Array] = None):
     """Reference: cluster/kmeans.cuh:214.  Returns (labels, centroids, inertia, n_iter)."""
-    centroids, inertia, n_iter = fit(res, params, X, sample_weight, centroids)
-    labels, inertia = predict(res, params, X, centroids,
-                              sample_weight=sample_weight)
+    centroids, inertia, n_iter = raw(fit)(res, params, X, sample_weight,
+                                          centroids)
+    labels, inertia = raw(predict)(res, params, X, centroids,
+                                   sample_weight=sample_weight)
     return labels, centroids, inertia, n_iter
 
 
 @auto_convert_output
 def transform(res, params: KMeansParams, X, centroids) -> jax.Array:
     """Distance from every sample to every centroid (reference: kmeans.cuh:243)."""
-    return pairwise_distance(ensure_array(X, "X"),
-                             ensure_array(centroids, "centroids"),
-                             params.metric)
+    return raw(pairwise_distance)(ensure_array(X, "X"),
+                                  ensure_array(centroids, "centroids"),
+                                  params.metric)
 
 
 def find_k(
@@ -333,7 +334,7 @@ def find_k(
 
     def fit_k(k):
         p = KMeansParams(n_clusters=k, max_iter=max_iter, tol=tol)
-        c, inertia, _ = fit(res, p, X)
+        c, inertia, _ = raw(fit)(res, p, X)
         return c, float(inertia)
 
     # Coarse scan then local refine — the reference bisects the elbow of the

@@ -32,6 +32,7 @@ from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.core.tracing import range as named_range
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn
 from raft_tpu.distance.types import DistanceType
+from raft_tpu.core.outputs import raw
 from raft_tpu.utils.precision import get_matmul_precision
 
 # Clusters smaller than avg_size / _BALANCE_RATIO get re-seeded each round
@@ -50,7 +51,7 @@ def _assign(X: jax.Array, centroids: jax.Array, metric: int
             precision=get_matmul_precision(),
             preferred_element_type=jnp.float32)
         return jnp.argmax(ip, axis=1).astype(jnp.int32), -jnp.max(ip, axis=1)
-    return tuple(reversed(fused_l2_nn(X, centroids)))
+    return tuple(reversed(raw(fused_l2_nn)(X, centroids)))
 
 
 def calc_centers_and_sizes(

@@ -92,7 +92,8 @@ def make_2d_session(rows: int, cols: int,
                     axis_name: str = "row") -> "CommsSession":
     """Session over a 2-D (row, col) device grid — the reference's
     sub-communicator pattern (core/resource/sub_comms.hpp; comm_split
-    core/comms.hpp:272).  ``comms().comm_split(color=...)`` then yields the
+    core/comms.hpp:272).  ``comms().comm_split(grouped_by="row"|"col")``
+    (MPI-color style; ``key`` accepted for parity) then yields the
     row/col communicators."""
     devs = list(devices) if devices is not None else jax.devices()
     expects(len(devs) >= rows * cols,

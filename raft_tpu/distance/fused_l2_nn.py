@@ -21,7 +21,7 @@ import jax.numpy as jnp
 
 from raft_tpu.core.error import expects
 from raft_tpu.utils.precision import get_matmul_precision
-from raft_tpu.core.outputs import auto_convert_output
+from raft_tpu.core.outputs import auto_convert_output, raw
 
 _TILE_N = 2048
 
@@ -124,4 +124,4 @@ _fused_l2_nn_jit = jax.jit(_impl,
 def fused_l2_nn_min_reduce(x: jax.Array, y: jax.Array, *,
                            sqrt: bool = False) -> Tuple[jax.Array, jax.Array]:
     """Alias matching fused_l2_nn.cuh:205 ``fusedL2NNMinReduce``."""
-    return fused_l2_nn(x, y, sqrt=sqrt)
+    return raw(fused_l2_nn)(x, y, sqrt=sqrt)

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.distance.types import DistanceType, resolve_metric
-from raft_tpu.core.outputs import auto_convert_output
+from raft_tpu.core.outputs import auto_convert_output, raw
 
 # Row-tile size for the VPU (broadcast) path; bounds peak memory at
 # _TILE_M * n * k elements.
@@ -272,4 +272,4 @@ def distance(x, y, metric=DistanceType.L2Unexpanded, *,
              metric_arg: float = 2.0) -> jax.Array:
     """Compile-time-metric flavor (reference: distance.cuh:70 ``distance<T>``);
     identical here since XLA specializes per trace."""
-    return pairwise_distance(x, y, metric, metric_arg=metric_arg)
+    return raw(pairwise_distance)(x, y, metric, metric_arg=metric_arg)

@@ -28,7 +28,7 @@ from raft_tpu.core.tracing import range as named_range
 from raft_tpu.distance.pairwise import pairwise_distance
 from raft_tpu.distance.types import DistanceType
 from raft_tpu.matrix.select_k import merge_topk, select_k
-from raft_tpu.core.outputs import auto_convert_output
+from raft_tpu.core.outputs import auto_convert_output, raw
 
 _TILE_N = 8192
 
@@ -139,7 +139,7 @@ def knn_merge_parts(
     ids = in_values + translations[:, None, None]
     keys = jnp.transpose(in_keys, (1, 0, 2)).reshape(nq, n_parts * k)
     vals = jnp.transpose(ids, (1, 0, 2)).reshape(nq, n_parts * k)
-    return select_k(keys, k, in_idx=vals, select_min=select_min)
+    return raw(select_k)(keys, k, in_idx=vals, select_min=select_min)
 
 
 def tiled_brute_force_knn(res, database, queries, k, **kw):

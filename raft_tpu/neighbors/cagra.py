@@ -49,7 +49,7 @@ from raft_tpu.matrix.select_k import merge_topk, select_k
 from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
 from raft_tpu.neighbors.refine import refine
 from raft_tpu.utils.precision import get_matmul_precision
-from raft_tpu.core.outputs import raw
+from raft_tpu.core.outputs import auto_convert_output, raw
 
 
 @dataclasses.dataclass
@@ -140,7 +140,7 @@ def build_knn_graph(
         rows = []
         for start in range(0, n, batch):
             q = dataset[start:start + batch]
-            _, cand = ivf_pq_mod.search(res, sp, pq_index, q, top_k)
+            _, cand = raw(ivf_pq_mod.search)(res, sp, pq_index, q, top_k)
             _, idx = raw(refine)(res, dataset, q, cand,
                             min(intermediate_degree + 1, top_k),
                             metric=DistanceType.L2Expanded
@@ -383,6 +383,7 @@ def _search_impl(dataset, graph, queries, seed_ids, k, itopk, search_width,
     return out_d, out_i
 
 
+@auto_convert_output
 def search(res, params: SearchParams, index: Index, queries, k: int
            ) -> Tuple[jax.Array, jax.Array]:
     """Greedy graph-walk search (reference: cagra.cuh:205)."""

@@ -72,20 +72,54 @@ def test_namedtuple_preserved(res):
         assert isinstance(leaf, np.ndarray)
 
 
-def test_composite_jit_functions_with_non_jax_output(res):
-    """Regression: decorated primitives (select_k, pairwise_distance) are
-    also called inside jitted compositions (knn, kmeans) — conversion must
-    not touch tracers."""
+@pytest.mark.parametrize(
+    "conf, t",
+    [
+        ("numpy", np.ndarray),
+        ("torch", torch.Tensor),
+        (lambda arr: np.asarray(arr), np.ndarray),
+    ],
+    ids=["numpy", "torch", "callable"],
+)
+def test_composite_jit_functions_with_non_jax_output(res, conf, t):
+    """Regression: decorated primitives (select_k, pairwise_distance,
+    fused_l2_nn) are called both inside jitted compositions (tracers must
+    pass through) and *eagerly* from other library code (kmeans.predict,
+    cagra.build via ivf_pq.search) — internal eager call sites must use
+    ``raw()`` so a torch/callable output type never leaks jax-incompatible
+    values mid-pipeline."""
     from raft_tpu.cluster import kmeans
     from raft_tpu.neighbors import brute_force
     rng = np.random.default_rng(0)
     X = rng.random((64, 8)).astype(np.float32)
-    raft_tpu.config.set_output_as("numpy")
+    raft_tpu.config.set_output_as(conf)
     d, i = brute_force.knn(res, X, X[:8], 4)
-    assert isinstance(d, np.ndarray) and isinstance(i, np.ndarray)
+    assert isinstance(d, t) and isinstance(i, t)
     params = kmeans.KMeansParams(n_clusters=4, max_iter=5)
     centroids, inertia, n_iter = kmeans.fit(res, params, X)
-    assert isinstance(centroids, np.ndarray)
+    assert isinstance(centroids, t)
+    labels, _ = kmeans.predict(res, params, X, np.asarray(centroids))
+    assert isinstance(labels, t)
+    out = kmeans.fit_predict(res, params, X)
+    assert isinstance(out[0], t)
+    cost = kmeans.cluster_cost(jnp.asarray(X),
+                               jnp.asarray(np.asarray(centroids)))
+    assert float(cost) >= 0
+
+
+@pytest.mark.parametrize("conf, t", [("torch", torch.Tensor)], ids=["torch"])
+def test_cagra_build_with_non_jax_output(res, conf, t):
+    """cagra.build composes ivf_pq.search + refine eagerly; it must work
+    (and return the configured type) under any output config."""
+    from raft_tpu.neighbors import cagra
+    rng = np.random.default_rng(1)
+    X = rng.random((256, 16)).astype(np.float32)
+    raft_tpu.config.set_output_as(conf)
+    index = cagra.build(res, cagra.IndexParams(
+        graph_degree=8, intermediate_graph_degree=16), X)
+    d, i = cagra.search(res, cagra.SearchParams(itopk_size=16), index,
+                        X[:8], 4)
+    assert isinstance(i, t)
 
 
 def test_end_to_end_pairwise(res):
