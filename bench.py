@@ -31,7 +31,8 @@ PQ_DIM = 64
 # (n_probes, refine_ratio) operating points — the reference harness sweeps
 # n_probes and supports refine_ratio for raft_ivf_pq
 # (cpp/bench/ann/conf/sift-128-euclidean.json)
-OPERATING_POINTS = ((32, 1), (64, 1), (32, 2), (64, 2), (96, 2), (128, 2))
+OPERATING_POINTS = ((32, 1), (64, 1), (32, 2), (64, 2), (72, 2), (80, 2),
+                    (96, 2), (128, 2))
 MIN_RECALL = 0.95
 # SIFT-like synthetic data: descriptors have low intrinsic dimensionality
 # (~16) embedded in 128-d; uniform random 128-d is adversarial to PQ (all
@@ -80,12 +81,13 @@ def bench_ivf_pq(res, db, queries) -> dict:
             return i
 
         i = query()                                        # warmup/compile
-        i.block_until_ready()
         recall = _recall(np.asarray(i), gt_i)
         t0 = time.perf_counter()
         for _ in range(RUNS):
             i = query()
-        i.block_until_ready()
+        # host readback, not block_until_ready: the latter has been observed
+        # to return early over the remote-tunnel backend, overstating QPS
+        np.asarray(i)
         qps = N_QUERIES / ((time.perf_counter() - t0) / RUNS)
         return {"n_probes": n_probes, "refine_ratio": refine_ratio,
                 "recall": round(recall, 4), "qps": round(qps, 1)}
@@ -127,7 +129,7 @@ def bench_kmeans(res, X) -> dict:
     c.block_until_ready()
     t0 = time.perf_counter()
     c, inertia, n_iter = kmeans.fit(res, params, X)
-    c.block_until_ready()
+    np.asarray(c)       # host readback (see bench_ivf_pq note)
     elapsed = time.perf_counter() - t0
     iters_per_s = KMEANS_ITERS / elapsed
     return {
@@ -235,7 +237,7 @@ def run_conf(conf_path: str) -> None:
             for _ in range(runs):
                 for q in q_batches:
                     i = query(q)
-            i.block_until_ready()
+            np.asarray(i)       # host readback (see bench_ivf_pq note)
             per_run = (time.perf_counter() - t0) / runs
             results.append({
                 "name": entry["name"], "search_param": sp,

@@ -1,0 +1,99 @@
+"""A/B: probe-order vs grouped IVF-PQ recon search at the bench workload.
+
+Run on the real chip:  python profiles/ab_search.py [--trace]
+Times each impl with host-readback timing; --trace captures a profiler
+trace of both variants under profiles/.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, ".")
+    import bench
+    from raft_tpu import DeviceResources
+    from raft_tpu.neighbors import ivf_pq
+
+    bench._setup_jax_cache()
+    res = DeviceResources(seed=0)
+    db, queries = bench._make_dataset({"n_db": 1_000_000, "dim": 128,
+                                       "latent_dim": 16, "noise": 0.05,
+                                       "n_queries": 5_000})
+    params = ivf_pq.IndexParams(n_lists=4096, pq_dim=64, kmeans_n_iters=20)
+    t0 = time.perf_counter()
+    index = ivf_pq.build(res, params, db)
+    jax.block_until_ready(index.list_codes)
+    print("build_s", round(time.perf_counter() - t0, 1))
+
+    n_probes = 96
+    k = 20
+    m = index.metric
+
+    from raft_tpu.neighbors import grouped
+
+    probes = ivf_pq._select_clusters(index.centers, index.rotation,
+                                     queries, n_probes, m)
+    n_groups = grouped.round_groups(
+        int(grouped.num_groups(probes, index.n_lists)))
+    cap = index.capacity
+    G, rot = grouped.GROUP, index.rot_dim
+    block = grouped.block_size(n_groups, G * cap * 8, cap * rot * 2,
+                               G * rot * 4)
+    print("n_groups", n_groups, "cap", cap, "block", block)
+
+    def run_probe_order():
+        d, i = ivf_pq._search_impl_recon(
+            index.centers, index.list_recon, index.list_indices,
+            index.rotation, queries, k, n_probes, m,
+            list_recon_sq=index.list_recon_sq)
+        return i
+
+    def run_grouped(p):
+        d, i = ivf_pq._search_impl_recon_grouped(
+            index.centers, index.list_recon, index.list_recon_sq,
+            index.list_indices, index.rotation, queries, p, k, m,
+            n_groups, block)
+        return i
+
+    def run_grouped_with_sync():
+        p = ivf_pq._select_clusters(index.centers, index.rotation,
+                                    queries, n_probes, m)
+        _ = grouped.round_groups(int(grouped.num_groups(p, index.n_lists)))
+        return run_grouped(p)
+
+    def run_grouped_pallas(p):
+        d, i = ivf_pq._search_impl_recon_grouped(
+            index.centers, index.list_recon, index.list_recon_sq,
+            index.list_indices, index.rotation, queries, p, k, m,
+            n_groups, block, use_pallas=True)
+        return i
+
+    variants = [("probe_order", run_probe_order),
+                ("grouped_presel", lambda: run_grouped(probes)),
+                ("grouped_pallas", lambda: run_grouped_pallas(probes)),
+                ("grouped_sync", run_grouped_with_sync)]
+    for name, fn in variants:
+        i = fn()
+        np.asarray(i)                    # warm
+        t0 = time.perf_counter()
+        for _ in range(3):
+            i = fn()
+        np.asarray(i)
+        dt = (time.perf_counter() - t0) / 3
+        print(f"{name}: {dt*1000:.1f} ms/batch  ({5000/dt:.0f} qps)")
+
+    if "--trace" in sys.argv:
+        with jax.profiler.trace("profiles/ab_trace"):
+            np.asarray(run_probe_order())
+            np.asarray(run_grouped(probes))
+        print("trace written to profiles/ab_trace")
+
+
+if __name__ == "__main__":
+    main()

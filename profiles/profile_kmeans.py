@@ -1,0 +1,44 @@
+"""Profile the k-means Lloyd loop at the bench workload (1M x 128, k=1024).
+
+Run on the real chip:  python profiles/profile_kmeans.py
+Prints fit timing and writes a trace under profiles/kmeans_trace.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    sys.path.insert(0, ".")
+    import bench
+    from raft_tpu import DeviceResources
+    from raft_tpu.cluster import kmeans
+    from raft_tpu.cluster.kmeans_types import InitMethod, KMeansParams
+
+    bench._setup_jax_cache()
+    res = DeviceResources(seed=0)
+    db, _ = bench._make_dataset({"n_db": 1_000_000, "dim": 128,
+                                 "latent_dim": 16, "noise": 0.05,
+                                 "n_queries": 1})
+    params = KMeansParams(n_clusters=1024, max_iter=20, tol=0.0, n_init=1,
+                          init=InitMethod.Random)
+    c, _, _ = kmeans.fit(res, params, db)     # warm
+    np.asarray(c)
+    t0 = time.perf_counter()
+    c, inertia, n_iter = kmeans.fit(res, params, db)
+    np.asarray(c)
+    dt = time.perf_counter() - t0
+    print(f"fit: {dt*1000:.0f} ms  ({20/dt:.1f} iter/s)")
+
+    with jax.profiler.trace("profiles/kmeans_trace"):
+        c, inertia, n_iter = kmeans.fit(res, params, db)
+        np.asarray(c)
+    print("trace written to profiles/kmeans_trace")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,211 @@
+"""List-centric grouped scan machinery shared by the IVF searches.
+
+The probe-order scan (one step per probe rank) re-reads every probed list's
+data once per probing query — at SIFT-1M bench shapes that is ~55 GB of
+HBM gather traffic per 5000-query batch, and the per-query einsum is a
+batched mat-vec the MXU cannot tile.  The measured trace
+(`profiles/ab_trace`, round 3) shows the scan's gather+einsum fusion
+bandwidth-bound at ~320 GB/s.
+
+The grouped scan inverts the loop the way the reference's
+``compute_similarity_kernel`` assigns one CTA per (list, query-group)
+(ivf_pq_search.cuh:611): (query, probe) pairs are bucketed BY LIST, so each
+list's data is read once.  A first cut bucketed pairs into one
+``qcap``-wide bucket per list; probe-popularity skew made ``qcap`` ~3.3x
+the mean occupancy and the padding inflated both the GEMM and the select
+by the same factor (measured slower than probe-order).  This module
+implements the fix: **fixed-size pair groups** — each list's pair count is
+padded to a multiple of ``G`` (128, a full MXU tile of queries), so hot
+lists get several groups instead of widening every bucket.  Padding
+overhead is bounded by ``n_lists·G/2`` slots total (~16% at bench shapes),
+independent of skew.
+
+The number of groups is data-dependent; callers host-sync it (an
+(n_lists,)-reduction — the same O(1) transfer the qcap design needed) and
+pass it as a static arg, rounded up so per-batch variation reuses the
+compiled executable.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+GROUP = 128          # pair-group size: one full MXU tile of queries
+_GROUP_ROUND = 256   # n_groups rounding quantum (compile-cache stability)
+
+
+def num_groups(probes: jax.Array, n_lists: int) -> jax.Array:
+    """Total fixed-size groups needed: sum over lists of ceil(count/G).
+    Callers host-sync this scalar and pass it to :func:`round_groups`."""
+    counts = jax.ops.segment_sum(
+        jnp.ones(probes.size, jnp.int32), probes.reshape(-1),
+        num_segments=n_lists)
+    return jnp.sum(-(-counts // GROUP))
+
+
+num_groups = jax.jit(num_groups, static_argnames=("n_lists",))
+
+
+def round_groups(n: int) -> int:
+    """Round the host-synced group count for executable reuse."""
+    return -(-max(n, 1) // _GROUP_ROUND) * _GROUP_ROUND
+
+
+def cached_groups(index_obj, key, probes: jax.Array, n_lists: int):
+    """Group count for dispatch, avoiding a per-batch host sync.
+
+    First call per ``key`` (= (nq, n_probes)) blocks on the tiny
+    (n_lists,)-reduction and caches the rounded count on the index object.
+    Subsequent calls dispatch with the cached value immediately and return
+    the in-flight device count as ``pending``; the caller hands it to
+    :func:`commit_groups` *after* enqueueing the search, where the host
+    read only waits for the already-finished reduction — the pipeline
+    never stalls on it.  If the read reveals the batch actually needed
+    more groups than the cache (probe-distribution shift), commit_groups
+    reports it and the caller re-dispatches with the corrected count —
+    results stay exact in every case; only shift batches pay a second
+    pass.  The cache grows monotonically (max) so the re-dispatch happens
+    at most once per shift.
+    """
+    cache = getattr(index_obj, "_group_cache", None)
+    if cache is None:
+        cache = {}
+        object.__setattr__(index_obj, "_group_cache", cache)
+    count_dev = num_groups(probes, n_lists)
+    if key in cache:
+        return cache[key], count_dev
+    cache[key] = round_groups(int(count_dev))
+    return cache[key], None
+
+
+def commit_groups(index_obj, key, pending) -> int:
+    """Fold an in-flight group count into the cache (see cached_groups).
+
+    Returns the batch's true rounded group count if it EXCEEDED the value
+    the caller dispatched with (caller must re-dispatch at that size for
+    exact results), else 0."""
+    if pending is None:
+        return 0
+    cache = index_obj._group_cache
+    dispatched = cache[key]
+    true_n = round_groups(int(pending))
+    cache[key] = max(dispatched, true_n)
+    return true_n if true_n > dispatched else 0
+
+
+def build_groups(probes: jax.Array, n_lists: int, n_groups: int
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """Bucket (query, probe) pairs into fixed-size per-list groups.
+
+    Returns ``(group_list, slot_pairs)``:
+
+    - ``group_list`` (n_groups,) int32 — the list each group scans (tail
+      groups beyond the real count alias the last list; their slots are
+      empty);
+    - ``slot_pairs`` (n_groups, GROUP) int32 — flattened pair index
+      (q * n_probes + probe_rank) per slot, with ``P = probes.size`` as
+      the empty-slot sentinel (scatters through it are dropped).
+
+    Pair → (group, slot): sort pairs by list; pair with in-list rank r of
+    list l lands in group ``group_start[l] + r // G``, slot ``r % G``.
+    """
+    P = probes.size
+    pl = probes.reshape(-1)
+    order = jnp.argsort(pl)
+    pl_s = pl[order]
+    counts = jax.ops.segment_sum(jnp.ones(P, jnp.int32), pl,
+                                 num_segments=n_lists)
+    groups_per_list = -(-counts // GROUP)
+    gstart = jnp.cumsum(groups_per_list) - groups_per_list
+    group_list = jnp.repeat(jnp.arange(n_lists, dtype=jnp.int32),
+                            groups_per_list, total_repeat_length=n_groups)
+    starts = jnp.cumsum(counts) - counts
+    rank = jnp.arange(P) - starts[pl_s]
+    g = gstart[pl_s] + rank // GROUP
+    s = rank % GROUP
+    slot_pairs = jnp.full((n_groups, GROUP), P, jnp.int32)
+    slot_pairs = slot_pairs.at[g, s].set(order, mode="drop")
+    return group_list, slot_pairs
+
+
+def finalize_topk(outd: jax.Array, outi: jax.Array, nq: int, k: int,
+                  select_min: bool, sqrt: bool, select_k_fn
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Final hierarchical select over the per-pair top-kt survivors.
+
+    ``outd``/``outi`` are (P, kt) — or already (nq, n_probes*kt) — laid
+    out so reshaping to (nq, n_probes*kt) groups each query's candidates
+    (pair id is q * n_probes + probe_rank).  Shared epilogue of every
+    probe-order and grouped scan: one narrow select, sentinel padding to
+    k, optional sqrt for the sqrt-L2 metrics.
+    """
+    worst = jnp.inf if select_min else -jnp.inf
+    alld = outd.reshape(nq, -1)
+    alli = outi.reshape(nq, -1)
+    kf = min(k, alld.shape[1])
+    best_d, best_i = select_k_fn(alld, kf, in_idx=alli,
+                                 select_min=select_min)
+    if kf < k:
+        best_d = jnp.pad(best_d, ((0, 0), (0, k - kf)),
+                         constant_values=worst)
+        best_i = jnp.pad(best_i, ((0, 0), (0, k - kf)),
+                         constant_values=-1)
+    if sqrt:
+        best_d = jnp.sqrt(jnp.maximum(best_d, 0.0))
+    return best_d, best_i
+
+
+def block_size(n_groups: int, *per_group_bytes: int,
+               budget: int = 96 << 20, quantum: int = 16) -> int:
+    """Groups per scan step such that the listed per-group transients stay
+    under ``budget`` bytes."""
+    per = max(sum(per_group_bytes), 1)
+    b = budget // per
+    b = max(quantum, b - b % quantum)
+    return min(b, n_groups)
+
+
+def scan_and_scatter(group_list, slot_pairs, P, cap, k, select_min, block,
+                     select_k_fn, distance_block):
+    """Shared scan driver: for each block of groups, compute distances via
+    ``distance_block(gl, slot) -> ((B, GROUP, cap) masked distances,
+    (B, cap) candidate ids)`` and take each pair-row's local top-kt.
+
+    Per-block results are emitted as scan *outputs* and scattered into the
+    (P, kt) buffers ONCE after the loop — a (P, kt) scan carry would be
+    copied every iteration by the in-loop scatter (measured ~150 MB/block
+    at bench shapes).  Candidate ids are resolved by gathering the block's
+    (B, cap) id rows at the selected positions, which broadcasting
+    ``take_along_axis`` does without materializing a (B, GROUP, cap) id
+    tensor.  Sentinel slots scatter out of bounds and are dropped; the
+    clamped tail block emits duplicate pairs with identical values, so the
+    final scatter stays idempotent."""
+    n_groups = group_list.shape[0]
+    worst = jnp.inf if select_min else -jnp.inf
+    kt = min(k, cap)
+
+    n_blocks = -(-n_groups // block)
+    block_starts = jnp.minimum(jnp.arange(n_blocks) * block,
+                               n_groups - block)
+
+    def step(_, start):
+        gl = jax.lax.dynamic_slice(group_list, (start,), (block,))
+        slot = jax.lax.dynamic_slice(slot_pairs, (start, 0), (block, GROUP))
+        d, ids = distance_block(gl, slot)            # (B, G, cap), (B, cap)
+        td, pos = select_k_fn(d.reshape(block * GROUP, cap), kt,
+                              select_min=select_min)
+        ti = jnp.take_along_axis(ids[:, None, :],
+                                 pos.reshape(block, GROUP, kt), axis=2)
+        return None, (td, ti.reshape(block * GROUP, kt), slot.reshape(-1))
+
+    _, (tds, tis, flats) = jax.lax.scan(step, None, block_starts)
+    flat = flats.reshape(-1)
+    outd = jnp.full((P, kt), worst, jnp.float32)
+    outi = jnp.full((P, kt), -1, jnp.int32)
+    outd = outd.at[flat].set(tds.reshape(-1, kt), mode="drop")
+    outi = outi.at[flat].set(tis.reshape(-1, kt), mode="drop")
+    return outd, outi

@@ -133,6 +133,44 @@ def _pack_lists(dataset: jax.Array, labels: jax.Array, source_ids: jax.Array,
     return list_data, list_idx, sizes
 
 
+@jax.jit
+def _append_lists_multi(bufs, rows, list_idx: jax.Array,
+                        list_sizes: jax.Array, new_labels: jax.Array,
+                        new_ids: jax.Array):
+    """Scatter-append rows into existing padded lists — the O(n_new)
+    extend fast path (callers must have verified no list overflows the
+    current capacity).  The reference's extend likewise appends in place
+    when lists have headroom and only reallocates grown lists
+    (ivf_list.hpp resize semantics).
+
+    ``bufs``/``rows`` are matching tuples of per-list storages and their
+    new rows (IVF-PQ appends codes + recon cache + recon norms in one
+    pass); the slot layout is computed once and shared."""
+    n_lists = list_sizes.shape[0]
+    n_new = new_ids.shape[0]
+    order = jnp.argsort(new_labels)
+    sl = new_labels[order]
+    new_counts = jax.ops.segment_sum(jnp.ones(n_new, jnp.int32), new_labels,
+                                     num_segments=n_lists)
+    starts = jnp.cumsum(new_counts) - new_counts
+    slot = list_sizes[sl] + (jnp.arange(n_new) - starts[sl])
+    bufs = tuple(b.at[sl, slot].set(r[order].astype(b.dtype))
+                 for b, r in zip(bufs, rows))
+    list_idx = list_idx.at[sl, slot].set(new_ids[order].astype(jnp.int32))
+    return bufs, list_idx, list_sizes + new_counts
+
+
+def _append_lists(list_data: jax.Array, list_idx: jax.Array,
+                  list_sizes: jax.Array, new_rows: jax.Array,
+                  new_labels: jax.Array, new_ids: jax.Array
+                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Single-payload convenience wrapper over _append_lists_multi."""
+    (list_data,), list_idx, sizes = _append_lists_multi(
+        (list_data,), (new_rows,), list_idx, list_sizes, new_labels,
+        new_ids)
+    return list_data, list_idx, sizes
+
+
 def build(res, params: IndexParams, dataset) -> Index:
     """Build an IVF-Flat index (reference: ivf_flat.cuh:65).
 
@@ -177,9 +215,11 @@ def build(res, params: IndexParams, dataset) -> Index:
 def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
     """Add vectors to an index (reference: ivf_flat.cuh:201 ``extend``).
 
-    Rebuilds the padded list storage at the new capacity (the reference
-    reallocates lists that outgrow their capacity too — ivf_list.hpp); the
-    coarse centers optionally drift when ``adaptive_centers`` is set
+    Fast path (no list outgrows the current capacity): one O(n_new)
+    scatter-append into the existing padded storage.  Slow path (some list
+    overflows): flatten + repack at a larger capacity — the reference
+    likewise reallocates lists that outgrow their capacity (ivf_list.hpp).
+    The coarse centers optionally drift when ``adaptive_centers`` is set
     (ivf_flat_types.hpp adaptive_centers semantics).
     """
     with named_range("ivf_flat::extend"):
@@ -197,8 +237,40 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
                                    else DistanceType.L2Expanded)
         new_labels = kmeans_balanced.predict(res, bal, new_vectors,
                                              index.centers)
+        new_counts = jax.ops.segment_sum(
+            jnp.ones(n_new, jnp.int32), new_labels,
+            num_segments=index.n_lists)
+        needed = index.list_sizes + new_counts
 
-        # existing rows, flattened back out of the padded storage
+        # one host sync over an (n_lists,) reduction decides the path — the
+        # only data-dependent choice (capacity is a static shape)
+        if int(jnp.max(needed)) <= index.capacity:
+            list_data, list_idx, sizes = _append_lists(
+                index.list_data, index.list_indices, index.list_sizes,
+                new_vectors, new_labels, new_indices)
+            centers = index.centers
+            if index.adaptive_centers:
+                # incremental drift: centers approximate list means, so the
+                # updated mean is the size-weighted blend with the new rows
+                # (reference: ivf_flat_build extend center update)
+                new_sums = jax.ops.segment_sum(
+                    new_vectors.astype(jnp.float32), new_labels,
+                    num_segments=index.n_lists)
+                blend = (centers * index.list_sizes[:, None] + new_sums
+                         ) / jnp.maximum(needed, 1)[:, None]
+                centers = jnp.where((new_counts > 0)[:, None], blend, centers)
+                if index.metric == DistanceType.InnerProduct:
+                    # spherical quantizer: keep the unit-norm invariant the
+                    # build-time balanced k-means enforces
+                    centers = centers / jnp.maximum(
+                        jnp.linalg.norm(centers, axis=1, keepdims=True),
+                        1e-12)
+            return Index(centers=centers, list_data=list_data,
+                         list_indices=list_idx, list_sizes=sizes,
+                         metric=index.metric,
+                         adaptive_centers=index.adaptive_centers)
+
+        # slow path: existing rows, flattened back out of the padded storage
         old_valid = index.list_indices >= 0
         old_labels = jnp.repeat(jnp.arange(index.n_lists, dtype=jnp.int32),
                                 index.capacity)[old_valid.ravel()]
@@ -210,10 +282,7 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
         all_ids = jnp.concatenate([old_ids, new_indices.astype(jnp.int32)])
         all_labels = jnp.concatenate([old_labels, new_labels])
 
-        sizes = jax.ops.segment_sum(
-            jnp.ones(all_labels.shape[0], jnp.int32), all_labels,
-            num_segments=index.n_lists)
-        capacity = _round_up(max(int(jnp.max(sizes)), _LIST_ALIGN),
+        capacity = _round_up(max(int(jnp.max(needed)), _LIST_ALIGN),
                              _LIST_ALIGN)
         list_data, list_idx, sizes = _pack_lists(
             all_vecs, all_labels, all_ids, index.n_lists, capacity)
@@ -227,6 +296,9 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
                                        num_segments=index.n_lists)
             means = sums / jnp.maximum(sizes, 1)[:, None]
             centers = jnp.where((sizes > 0)[:, None], means, centers)
+            if index.metric == DistanceType.InnerProduct:
+                centers = centers / jnp.maximum(
+                    jnp.linalg.norm(centers, axis=1, keepdims=True), 1e-12)
 
         return Index(centers=centers, list_data=list_data,
                      list_indices=list_idx, list_sizes=sizes,
@@ -243,16 +315,7 @@ def _search_impl(centers, list_data, list_indices, queries, k, n_probes,
     ip_metric = metric == DistanceType.InnerProduct
 
     # ---- coarse: pick n_probes lists per query (select_clusters analogue) --
-    q_dot_c = jax.lax.dot_general(qf, cf, (((1,), (1,)), ((), ())),
-                                  precision=get_matmul_precision(),
-                                  preferred_element_type=jnp.float32)
-    if ip_metric:
-        coarse = q_dot_c
-        _, probes = jax.lax.top_k(coarse, n_probes)
-    else:
-        c_sq = jnp.sum(cf * cf, axis=1)
-        coarse = c_sq[None, :] - 2.0 * q_dot_c  # + q² is rank-invariant
-        _, probes = jax.lax.top_k(-coarse, n_probes)
+    probes = _select_clusters(centers, queries, n_probes, metric)
 
     # ---- fine: scan probed lists, hierarchical select --------------------
     # per-probe local top-k inside the scan + ONE final select over the
@@ -286,17 +349,75 @@ def _search_impl(centers, list_data, list_indices, queries, k, n_probes,
             jnp.full((nq, n_probes * kt), -1, jnp.int32))
     (alld, alli), _ = jax.lax.scan(probe_step, init,
                                    jnp.arange(n_probes))
-    kf = min(k, n_probes * kt)
-    best_d, best_i = select_k(alld, kf, in_idx=alli,
-                              select_min=not ip_metric)
-    if kf < k:
-        best_d = jnp.pad(best_d, ((0, 0), (0, k - kf)),
-                         constant_values=worst)
-        best_i = jnp.pad(best_i, ((0, 0), (0, k - kf)),
-                         constant_values=-1)
-    if metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded):
-        best_d = jnp.sqrt(jnp.maximum(best_d, 0.0))
-    return best_d, best_i
+    from raft_tpu.neighbors import grouped
+    return grouped.finalize_topk(
+        alld, alli, nq, k, not ip_metric,
+        metric in (DistanceType.L2SqrtExpanded,
+                   DistanceType.L2SqrtUnexpanded), select_k)
+
+
+@functools.partial(jax.jit, static_argnames=("n_probes", "metric"))
+def _select_clusters(centers, queries, n_probes, metric):
+    """Coarse top-``n_probes`` ranking (the select_clusters analogue)."""
+    qf = queries.astype(jnp.float32)
+    cf = centers.astype(jnp.float32)
+    q_dot_c = jax.lax.dot_general(qf, cf, (((1,), (1,)), ((), ())),
+                                  precision=get_matmul_precision(),
+                                  preferred_element_type=jnp.float32)
+    if metric == DistanceType.InnerProduct:
+        _, probes = jax.lax.top_k(q_dot_c, n_probes)
+    else:
+        c_sq = jnp.sum(cf * cf, axis=1)
+        _, probes = jax.lax.top_k(2.0 * q_dot_c - c_sq[None, :], n_probes)
+    return probes
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric", "n_groups",
+                                             "block"))
+def _search_impl_grouped(centers, list_data, list_indices, queries, probes,
+                         k, metric, n_groups, block):
+    """List-centric scan over fixed-size pair groups: each group is GROUP
+    (query, probe) pairs of one list, so list vectors are read ~once and
+    the distance block is a full batched MXU GEMM.  See
+    :mod:`raft_tpu.neighbors.grouped` for the design; distances here are
+    exact fp32 (same restructure as ivf_pq._search_impl_recon_grouped).
+    """
+    from raft_tpu.neighbors import grouped
+
+    nq, n_probes = probes.shape
+    P = nq * n_probes
+    n_lists = centers.shape[0]
+    cap = list_data.shape[1]
+    ip_metric = metric == DistanceType.InnerProduct
+    worst = -jnp.inf if ip_metric else jnp.inf
+
+    qf = queries.astype(jnp.float32)
+    q_sq = jnp.sum(qf * qf, axis=1)
+
+    group_list, slot_pairs = grouped.build_groups(probes, n_lists, n_groups)
+
+    def distance_block(gl, slot):
+        qid = jnp.where(slot < P, slot // n_probes, 0)
+        qv = qf[qid]                                     # (B, G, d)
+        data = list_data[gl].astype(jnp.float32)         # (B, cap, d)
+        ids = list_indices[gl]
+        ip = jnp.einsum("bqd,bcd->bqc", qv, data,
+                        precision=get_matmul_precision())
+        if ip_metric:
+            d = ip
+        else:
+            d_sq = jnp.sum(data * data, axis=-1)         # (B, cap)
+            d = jnp.maximum(q_sq[qid][:, :, None]
+                            + d_sq[:, None, :] - 2.0 * ip, 0.0)
+        return jnp.where(ids[:, None, :] >= 0, d, worst), ids
+
+    outd, outi = grouped.scan_and_scatter(
+        group_list, slot_pairs, P, cap, k, not ip_metric, block,
+        select_k, distance_block)
+    return grouped.finalize_topk(
+        outd, outi, nq, k, not ip_metric,
+        metric in (DistanceType.L2SqrtExpanded,
+                   DistanceType.L2SqrtUnexpanded), select_k)
 
 
 @auto_convert_output
@@ -312,10 +433,40 @@ def search(res, params: SearchParams, index: Index, queries, k: int
         queries = ensure_array(queries, "queries")
         expects(queries.ndim == 2 and queries.shape[1] == index.dim,
                 "ivf_flat.search: query dim mismatch")
+        from raft_tpu.neighbors import grouped
+
         n_probes = min(params.n_probes, index.n_lists)
-        return _search_impl(index.centers, index.list_data,
-                            index.list_indices, queries, k, n_probes,
-                            index.metric)
+        if (isinstance(queries, jax.core.Tracer)
+                or isinstance(index.centers, jax.core.Tracer)):
+            # queries or the Index pytree traced by an outer jit/vmap:
+            # use the fully traceable probe-order scan
+            return _search_impl(index.centers, index.list_data,
+                                index.list_indices, queries, k, n_probes,
+                                index.metric)
+        probes = _select_clusters(index.centers, queries, n_probes,
+                                  index.metric)
+        gkey = (queries.shape[0], n_probes)
+        n_groups, pending = grouped.cached_groups(
+            index, gkey, probes, index.n_lists)
+        G = grouped.GROUP
+
+        def dispatch(ng):
+            cap = index.capacity
+            block = grouped.block_size(
+                ng,
+                G * cap * 8,                # fp32 distances + broadcast ids
+                (cap + G) * index.dim * 4)  # data slice + query gather
+            return _search_impl_grouped(index.centers, index.list_data,
+                                        index.list_indices, queries, probes,
+                                        k, index.metric, ng, block)
+
+        out = dispatch(n_groups)
+        needed = grouped.commit_groups(index, gkey, pending)
+        if needed:
+            # probe distribution shifted past the cached group count:
+            # re-dispatch at the true size so no pair is dropped
+            out = dispatch(needed)
+        return out
 
 
 # ---------------------------------------------------------------------------

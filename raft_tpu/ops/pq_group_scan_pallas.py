@@ -1,0 +1,123 @@
+"""Pallas TPU kernel: fused grouped PQ-reconstruction scan + local top-k.
+
+The ``compute_similarity_kernel`` analogue (reference:
+neighbors/detail/ivf_pq_search.cuh:611) for the grouped search layout
+(:mod:`raft_tpu.neighbors.grouped`): one program per pair-group computes
+the group's (GROUP, cap) quantized L2 distances on the MXU and extracts
+each row's top-kt **in VMEM**, so the distance matrix never reaches HBM.
+
+Structure per program ``g``:
+
+- the scalar-prefetched ``group_list`` drives the BlockSpec index maps —
+  the list's bf16 reconstructions, squared norms, and slot-validity ids
+  are DMA'd directly by list id (the TPU equivalent of the reference
+  assigning one CTA per (list, query-group));
+- the group's query-residual tile (precomputed outside: ``q_rot - center``
+  in fp32, cast bf16) hits the MXU against the list tile:
+  ``d = ||sub||^2 + ||recon||^2 - 2 sub.recon``;
+- top-kt per row by iterative max-extraction (kt passes of
+  max / where-iota argmin / mask over the VMEM-resident (GROUP, cap)
+  block) — the XLA path's separate sort pass and its HBM round-trip of
+  the distances are folded away.
+
+Returns per-pair values and *positions* (column within the list); callers
+map positions to candidate ids with a broadcasting ``take_along_axis``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_tpu.neighbors.grouped import GROUP
+
+
+def _kernel(gl_ref, sub_ref, subsq_ref, data_ref, rsq_ref, ids_ref,
+            vals_ref, pos_ref, vscratch, pscratch, *, kt):
+    sub = sub_ref[0]                                   # (G, rot) bf16
+    data = data_ref[0]                                 # (cap, rot) bf16
+    ip = jax.lax.dot_general(sub, data, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    # the 1-length middle axis keeps 2-D operands in valid TPU block
+    # shapes (see grouped_l2_scan's reshapes)
+    d = subsq_ref[0, 0][:, None] + rsq_ref[0, 0][None, :] - 2.0 * ip
+    d = jnp.maximum(d, 0.0)
+    invalid = (ids_ref[0, 0] < 0)[None, :]             # (1, cap)
+    neg = jnp.where(invalid, -jnp.inf, -d)             # select-min as max
+
+    cap = neg.shape[1]
+    col = jax.lax.broadcasted_iota(jnp.int32, neg.shape, 1)
+    for j in range(kt):
+        m = jnp.max(neg, axis=1)                       # (G,)
+        # where-iota argmax (ties -> lowest column, stable like sort)
+        p = jnp.min(jnp.where(neg == m[:, None], col, cap), axis=1)
+        p = jnp.minimum(p, cap - 1)                    # all -inf row guard
+        vscratch[:, j] = -m
+        pscratch[:, j] = p
+        neg = jnp.where(col == p[:, None], -jnp.inf, neg)
+    vals_ref[0] = vscratch[:, :]
+    pos_ref[0] = pscratch[:, :]
+
+
+@functools.partial(jax.jit, static_argnames=("kt", "interpret"))
+def grouped_l2_scan(group_list, sub, sub_sq, list_recon, rec_sq,
+                    list_indices, kt, interpret=False):
+    """Fused distance + local top-kt over all pair groups.
+
+    ``group_list`` (n_groups,) int32; ``sub`` (n_groups, GROUP, rot) bf16;
+    ``sub_sq`` (n_groups, GROUP) f32; ``list_recon`` (n_lists, cap, rot)
+    bf16; ``rec_sq`` (n_lists, cap) f32; ``list_indices`` (n_lists, cap)
+    int32.  Returns ``(vals (n_groups, GROUP, kt) f32, pos ... int32)``
+    sorted ascending (L2).  Invalid slots carry +inf.
+    """
+    n_groups = group_list.shape[0]
+    _, cap, rot = list_recon.shape
+
+    # 2-D operands get a singleton middle axis: TPU block shapes must have
+    # their last two dims tile-aligned or equal to the array dims, which
+    # (1, len) blocks of a 2-D array violate
+    sub_sq3 = sub_sq[:, None, :]
+    rec_sq3 = rec_sq[:, None, :]
+    ids3 = list_indices[:, None, :]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_groups,),
+        in_specs=[
+            pl.BlockSpec((1, GROUP, rot), lambda g, gl: (g, 0, 0)),
+            pl.BlockSpec((1, 1, GROUP), lambda g, gl: (g, 0, 0)),
+            pl.BlockSpec((1, cap, rot), lambda g, gl: (gl[g], 0, 0)),
+            pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
+            pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, GROUP, kt), lambda g, gl: (g, 0, 0)),
+            pl.BlockSpec((1, GROUP, kt), lambda g, gl: (g, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((GROUP, kt), jnp.float32),
+            pltpu.VMEM((GROUP, kt), jnp.int32),
+        ],
+    )
+    vals, pos = pl.pallas_call(
+        functools.partial(_kernel, kt=kt),
+        out_shape=[
+            jax.ShapeDtypeStruct((n_groups, GROUP, kt), jnp.float32),
+            jax.ShapeDtypeStruct((n_groups, GROUP, kt), jnp.int32),
+        ],
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(group_list, sub, sub_sq3, list_recon, rec_sq3, ids3)
+    return vals, pos
+
+
+def supported(metric_is_l2: bool, cap: int, rot: int, kt: int) -> bool:
+    """Shapes the kernel handles; callers fall back to the XLA scan
+    otherwise.  Lane dim must be a full 128 multiple and the sublane dim a
+    bf16 tile multiple; kt is bounded to keep the extraction loop sane."""
+    return (metric_is_l2 and rot % 128 == 0 and cap % 16 == 0
+            and GROUP % 16 == 0 and 0 < kt <= 64)

@@ -83,6 +83,65 @@ class TestIvfFlat:
         _, ti = naive_knn(db, q, 10)
         assert recall(np.asarray(i), ti) > 0.99
 
+    def test_extend_fast_path_appends_in_place(self, res, dataset):
+        """A small extend into lists with headroom must keep the capacity
+        (the O(n_new) scatter-append path) and stay exact."""
+        db, q = dataset
+        params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=5)
+        index = ivf_flat.build(res, params, db[:3000])
+        cap0 = index.capacity
+        # capacity is rounded up to _LIST_ALIGN, so a handful of rows fits
+        index = ivf_flat.extend(res, index, db[3000:3040],
+                                jnp.arange(3000, 3040, dtype=jnp.int32))
+        assert index.capacity == cap0        # fast path: no repack
+        assert index.size == 3040
+        ids = np.asarray(index.list_indices)
+        valid = ids[ids >= 0]
+        assert sorted(valid.tolist()) == list(range(3040))
+        _, i = ivf_flat.search(res, ivf_flat.SearchParams(n_probes=16),
+                               index, q, 10)
+        _, ti = naive_knn(db[:3040], q, 10)
+        assert recall(np.asarray(i), ti) > 0.99
+
+    def test_grouped_scan_matches_probe_order_scan(self, res, dataset):
+        """List-centric grouped scan vs probe-order scan: IVF-Flat distances
+        are exact fp32, so results must agree to fp tolerance."""
+        db, q = dataset
+        params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=10)
+        index = ivf_flat.build(res, params, db)
+        from raft_tpu.neighbors import grouped
+        probes = ivf_flat._select_clusters(index.centers, jnp.asarray(q),
+                                           8, index.metric)
+        n_groups = grouped.round_groups(
+            int(grouped.num_groups(probes, index.n_lists)))
+        d1, i1 = ivf_flat._search_impl(
+            index.centers, index.list_data, index.list_indices,
+            jnp.asarray(q), 10, 8, index.metric)
+        d2, i2 = ivf_flat._search_impl_grouped(
+            index.centers, index.list_data, index.list_indices,
+            jnp.asarray(q), probes, 10, index.metric, n_groups, 16)
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2),
+                                   rtol=1e-4, atol=1e-3)
+        overlap = np.mean([len(set(a) & set(b)) / 10
+                           for a, b in zip(np.asarray(i1), np.asarray(i2))])
+        assert overlap > 0.99
+
+    def test_search_inside_jit(self, res, dataset):
+        """search() must stay traceable under an outer jit (the grouped
+        dispatch host-syncs, so tracing falls back to the probe-order
+        scan) and agree with the eager result."""
+        import jax
+        db, q = dataset
+        params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=5)
+        index = ivf_flat.build(res, params, db)
+        sp = ivf_flat.SearchParams(n_probes=16)
+        d_e, i_e = ivf_flat.search(res, sp, index, q, 10)
+        d_j, i_j = jax.jit(
+            lambda qq: ivf_flat.search(res, sp, index, qq, 10))(
+                jnp.asarray(q))
+        np.testing.assert_allclose(np.asarray(d_e), np.asarray(d_j),
+                                   rtol=1e-4, atol=1e-3)
+
     def test_inner_product(self, res, dataset):
         db, q = dataset
         dbn = db / np.linalg.norm(db, axis=1, keepdims=True)

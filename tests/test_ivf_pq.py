@@ -125,6 +125,81 @@ class TestIvfPq:
         assert abs(recall(np.asarray(i), ti)
                    - recall(np.asarray(i2), ti)) < 0.15
 
+    def test_grouped_scan_matches_probe_order_scan(self, res, dataset):
+        """The list-centric grouped scan must produce the same results as
+        the probe-order scan (same quantized distances; differences are
+        bf16-accumulation-order level)."""
+        db, q = dataset
+        params = ivf_pq.IndexParams(n_lists=16, pq_dim=16, kmeans_n_iters=10)
+        index = ivf_pq.build(res, params, db)
+        from raft_tpu.neighbors import grouped
+        probes = ivf_pq._select_clusters(index.centers, index.rotation,
+                                         jnp.asarray(q), 8, index.metric)
+        n_groups = grouped.round_groups(
+            int(grouped.num_groups(probes, index.n_lists)))
+        d1, i1 = ivf_pq._search_impl_recon(
+            index.centers, index.list_recon, index.list_indices,
+            index.rotation, jnp.asarray(q), 10, 8, index.metric)
+        d2, i2 = ivf_pq._search_impl_recon_grouped(
+            index.centers, index.list_recon, index.list_recon_sq,
+            index.list_indices, index.rotation, jnp.asarray(q), probes,
+            10, index.metric, n_groups, 16)
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2),
+                                   rtol=1e-2, atol=1e-2)
+        overlap = np.mean([len(set(a) & set(b)) / 10
+                           for a, b in zip(np.asarray(i1), np.asarray(i2))])
+        assert overlap > 0.95
+
+    def test_pallas_group_scan_matches_xla_scan(self, res):
+        """The fused Pallas group-scan kernel (interpret mode on CPU) must
+        agree with the XLA grouped scan."""
+        from raft_tpu.neighbors import grouped
+        rng = np.random.default_rng(3)
+        db = rng.normal(size=(2000, 128)).astype(np.float32)
+        q = rng.normal(size=(32, 128)).astype(np.float32)
+        params = ivf_pq.IndexParams(n_lists=16, pq_dim=16, kmeans_n_iters=5)
+        index = ivf_pq.build(res, params, db)
+        assert index.rot_dim % 128 == 0 and index.capacity % 16 == 0
+        probes = ivf_pq._select_clusters(index.centers, index.rotation,
+                                         jnp.asarray(q), 8, index.metric)
+        n_groups = grouped.round_groups(
+            int(grouped.num_groups(probes, index.n_lists)))
+        args = (index.centers, index.list_recon, index.list_recon_sq,
+                index.list_indices, index.rotation, jnp.asarray(q), probes,
+                10, index.metric, n_groups, 16)
+        d1, i1 = ivf_pq._search_impl_recon_grouped(*args)
+        d2, i2 = ivf_pq._search_impl_recon_grouped(
+            *args, use_pallas=True, pallas_interpret=True)
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2),
+                                   rtol=1e-2, atol=1e-2)
+        overlap = np.mean([len(set(a) & set(b)) / 10
+                           for a, b in zip(np.asarray(i1), np.asarray(i2))])
+        assert overlap > 0.95
+
+    def test_extend_fast_path_updates_recon_cache(self, res, dataset):
+        """A small extend must take the O(n_new) append path (capacity
+        unchanged) and keep the bf16 reconstruction cache identical to a
+        full re-decode of the codes."""
+        db, q = dataset
+        params = ivf_pq.IndexParams(n_lists=16, pq_dim=16, kmeans_n_iters=10)
+        index = ivf_pq.build(res, params, db[:3000])
+        assert index.list_recon is not None
+        cap0 = index.capacity
+        index = ivf_pq.extend(res, index, db[3000:3040],
+                              jnp.arange(3000, 3040, dtype=jnp.int32))
+        assert index.capacity == cap0        # fast path: no repack
+        assert index.size == 3040
+        full = ivf_pq._decode_lists(index.centers, index.codebooks,
+                                    index.list_codes, index.codebook_kind)
+        valid = np.asarray(index.list_indices) >= 0
+        np.testing.assert_array_equal(
+            np.asarray(index.list_recon, np.float32)[valid],
+            np.asarray(full, np.float32)[valid])
+        _, i = ivf_pq.search(res, ivf_pq.SearchParams(n_probes=16),
+                             index, q, 10)
+        _, ti = naive_knn(db[:3040], q, 10)
+        assert recall(np.asarray(i), ti) > 0.6
+
     def test_rotation_orthonormal(self, res, dataset):
         db, _ = dataset
         params = ivf_pq.IndexParams(n_lists=16, pq_dim=5, kmeans_n_iters=3,
