@@ -1,0 +1,13 @@
+"""Legacy spatial API — thin forwards to :mod:`raft_tpu.neighbors`.
+
+Parity with the reference's ``raft::spatial::knn`` namespace
+(`/root/reference/cpp/include/raft/spatial/knn/` — knn.cuh:20-24 includes
+``neighbors/detail`` and forwards; ann.cuh, ball_cover.cuh,
+epsilon_neighborhood.cuh, ivf_flat.cuh, ivf_pq.cuh are all forwarding
+headers for the pre-``raft::neighbors`` spelling).  Kept so code written
+against the old namespace ports mechanically.
+"""
+
+from raft_tpu.spatial import knn  # noqa: F401
+
+__all__ = ["knn"]

@@ -1,0 +1,27 @@
+"""``raft_tpu.spatial.knn`` — the legacy namespace's entry points.
+
+Reference: cpp/include/raft/spatial/knn/knn.cuh (``brute_force_knn``,
+``knn_merge_parts``, ``select_k``), ball_cover.cuh, epsilon_neighborhood.cuh,
+ivf_flat.cuh / ivf_pq.cuh — all deprecated forwards to ``raft::neighbors`` /
+``raft::matrix``; this module is the same shim for raft_tpu.
+"""
+
+from raft_tpu.matrix.select_k import select_k  # noqa: F401
+from raft_tpu.neighbors import ball_cover, ivf_flat, ivf_pq  # noqa: F401
+from raft_tpu.neighbors.brute_force import (  # noqa: F401
+    knn as brute_force_knn,
+    knn_merge_parts,
+)
+from raft_tpu.neighbors.epsilon_neighborhood import (  # noqa: F401
+    eps_neighbors_l2sq,
+)
+
+__all__ = [
+    "select_k",
+    "ball_cover",
+    "ivf_flat",
+    "ivf_pq",
+    "brute_force_knn",
+    "knn_merge_parts",
+    "eps_neighbors_l2sq",
+]

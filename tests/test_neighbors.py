@@ -151,3 +151,21 @@ class TestEpsNeighborhood:
         d = ((x[:, None, :] - x[None, :, :]) ** 2).sum(-1)
         np.testing.assert_array_equal(np.asarray(adj), d < 1.5)
         np.testing.assert_array_equal(np.asarray(vd), (d < 1.5).sum(1))
+
+
+class TestSpatialLegacyNamespace:
+    """The deprecated ``raft::spatial::knn`` spelling forwards to neighbors
+    (reference: spatial/knn/knn.cuh:20-24); the shim must expose the same
+    callables."""
+
+    def test_forwards(self, res):
+        from raft_tpu import spatial
+        from raft_tpu.matrix.select_k import select_k
+        from raft_tpu.neighbors import brute_force
+        assert spatial.knn.brute_force_knn is brute_force.knn
+        assert spatial.knn.knn_merge_parts is brute_force.knn_merge_parts
+        assert spatial.knn.select_k is select_k
+        rng = np.random.default_rng(0)
+        db = rng.normal(size=(128, 8)).astype(np.float32)
+        d, i = spatial.knn.brute_force_knn(res, db, db[:4], 3)
+        assert np.asarray(i).shape == (4, 3)
