@@ -51,3 +51,12 @@ from raft_tpu.linalg.reduce import (  # noqa: F401
     reduce_cols_by_key,
     mean_squared_error,
 )
+
+# Deprecated forward kept for reference parity: raft/linalg/lanczos.cuh:22-35
+# forwards to sparse/solver/lanczos.cuh; the canonical home is
+# raft_tpu.sparse.solver.
+from raft_tpu.sparse.solver import (  # noqa: F401,E402
+    eigsh_largest,
+    eigsh_smallest,
+    lanczos_tridiag,
+)

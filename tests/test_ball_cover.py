@@ -36,6 +36,7 @@ def _random_latlon(rng, n):
 
 
 class TestHaversine:
+    @pytest.mark.slow
     def test_all_knn_query_10k(self, res):
         rng = np.random.default_rng(0)
         X = _random_latlon(rng, 10_000)

@@ -126,6 +126,39 @@ class TestIvfFlat:
                            for a, b in zip(np.asarray(i1), np.asarray(i2))])
         assert overlap > 0.99
 
+    def test_group_cache_overflow_redispatch(self, res, dataset):
+        """A later batch whose probe distribution needs more groups than
+        the cached count must still return exact results (the dispatch
+        re-runs at the true size instead of dropping pairs)."""
+        db, q = dataset
+        params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=5)
+        index = ivf_flat.build(res, params, db)
+        sp = ivf_flat.SearchParams(n_probes=4)
+        # batch A: natural queries seed the cache at a low group count
+        ivf_flat.search(res, sp, index, q, 10)
+        from raft_tpu.neighbors import grouped
+        cached = dict(index._group_cache)
+        # batch B: every query near one centroid -> probes pile onto few
+        # lists, inflating that list's group need past the cached value
+        hot = np.asarray(index.centers)[3]
+        qb = (hot[None, :] +
+              0.01 * np.random.default_rng(0).normal(
+                  size=(q.shape[0], db.shape[1]))).astype(np.float32)
+        d_b, i_b = ivf_flat.search(res, sp, index, qb, 10)
+        # exactness: must equal the traceable probe-order scan
+        d_ref, i_ref = ivf_flat._search_impl(
+            index.centers, index.list_data, index.list_indices,
+            jnp.asarray(qb), 10, 4, index.metric)
+        np.testing.assert_allclose(np.asarray(d_b), np.asarray(d_ref),
+                                   rtol=1e-4, atol=1e-3)
+        overlap = np.mean([len(set(a) & set(b)) / 10
+                           for a, b in zip(np.asarray(i_b),
+                                           np.asarray(i_ref))])
+        assert overlap > 0.99
+        # the cache only ever grows
+        for k_, v in cached.items():
+            assert index._group_cache[k_] >= v
+
     def test_search_inside_jit(self, res, dataset):
         """search() must stay traceable under an outer jit (the grouped
         dispatch host-syncs, so tracing falls back to the probe-order

@@ -5,6 +5,7 @@ Mirrors the reference's Hungarian-vs-known-optimum strategy
 match ``scipy.optimize.linear_sum_assignment`` costs on random matrices).
 """
 
+import jax
 import numpy as np
 import pytest
 from scipy.optimize import linear_sum_assignment
@@ -52,6 +53,11 @@ class TestSolve:
             float(sol.obj_primal), cost[ri, ci].sum(), rtol=1e-5)
 
     @pytest.mark.slow
+    @pytest.mark.skipif(
+        jax.default_backend() == "cpu",
+        reason="auction rounds are (n,n) top-2 passes — minutes on an "
+               "accelerator, hours on the CPU test backend at n=2048; "
+               "validated on a real chip (see PERFORMANCE.md)")
     def test_matches_scipy_2048(self, res):
         rng = np.random.default_rng(11)
         cost = rng.random((2048, 2048)).astype(np.float32)
