@@ -11,13 +11,19 @@ design is **Bertsekas' auction algorithm with epsilon-scaling**:
 - every unassigned row bids for its best column in parallel (one dense
   ``(n, n)`` value matrix + ``lax.top_k`` — MXU/VPU-friendly, no trees);
 - bids resolve with a single scatter-max per round;
-- the whole solve is a fixed ``lax.while_loop`` nest under ``jit`` (no
-  data-dependent Python control flow), batched via ``vmap`` to mirror the
-  reference's ``batchsize`` sub-problem axis.
+- the auction itself is a fixed ``lax.while_loop`` nest under ``jit``
+  (no data-dependent Python control flow), ``vmap``-ed over the
+  reference's ``batchsize`` sub-problem axis; quantization and the
+  dual/objective mapping run host-side, so ``solve`` is a host
+  orchestration function (NOT itself jit-traceable).
 
 Costs are quantized onto an integer grid scaled by ``(n + 1)`` so the final
 epsilon = 1 pass is provably optimal for the quantized problem (the classic
-``eps < 1/n`` termination condition); float64 holds the grid exactly.
+``eps < 1/n`` termination condition).  The grid lives in **int64** on
+device: quantization happens host-side in float64 (exact), and the auction
+itself is pure integer arithmetic — TPUs have no native f64 (a f64 device
+program crashes the runtime), but emulated S64 runs fine, so the same
+solver is exact on CPU and TPU.
 """
 
 from __future__ import annotations
@@ -27,26 +33,27 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.mdarray import ensure_array
 from ..core.error import expects
 
-_EPS_FACTOR = 7.0  # epsilon divisor per scaling phase (Bertsekas suggests 4-10)
+_EPS_FACTOR = 7  # epsilon divisor per scaling phase (Bertsekas suggests 4-10)
 
 
-def _quant_for(n: int) -> float:
+def _quant_for(n: int) -> int:
     """Integer grid resolution for an n x n problem.
 
     Benefits live on multiples of (n+1) up to QUANT*(n+1); encoded bids
     carry the bidder id in the low bits (enc = bid*n + rank) and bids can
-    exceed the max benefit by up to eps0 = QUANT*(n+1)/2, so exact float64
-    integer arithmetic needs 1.5 * QUANT * (n+1) * n < 2^53.  QUANT adapts
-    downward for large n (capped at 2^30); quantization error is
-    <= n / (2*QUANT) of the cost range (~1e-6 at n=2048).
+    exceed the max benefit by up to eps0 = QUANT*(n+1)/2, so exact int64
+    arithmetic needs 1.5 * QUANT * (n+1) * n < 2^62.  QUANT adapts
+    downward for (absurdly) large n (capped at 2^30); quantization error
+    is <= n / (2*QUANT) of the cost range (~1e-6 at n=2048).
     """
     import math
-    lim = 2.0 ** 52 / (float(n) * (n + 1))
-    return min(2.0 ** 30, 2.0 ** math.floor(math.log2(lim)))
+    lim = 2.0 ** 61 / (float(n) * (n + 1))
+    return int(min(2.0 ** 30, 2.0 ** math.floor(math.log2(lim))))
 
 
 class LapSolution(NamedTuple):
@@ -76,7 +83,7 @@ def _num_phases(eps0: float) -> int:
 def _auction_phase(benefit, prices, eps, n):
     """One epsilon phase: auction rounds until every row is assigned.
 
-    benefit: (n, n) integer-valued float64, prices: (n,) float64.
+    benefit: (n, n) int64 (multiples of n+1), prices: (n,) int64.
     Returns (assignment (n,), owner (n,), prices (n,)).
     """
     neg = jnp.int32(-1)
@@ -93,7 +100,7 @@ def _auction_phase(benefit, prices, eps, n):
     def body(state):
         assign, owner, p, it = state
         unassigned = assign == neg                       # (n,) rows
-        values = benefit - p[None, :]                    # (n, n)
+        values = benefit - p[None, :]                    # (n, n) int64
         if n == 1:
             j1 = jnp.zeros((1,), jnp.int32)
             w2 = values[:, 0]  # no competitor: bid raises own price by eps
@@ -106,11 +113,11 @@ def _auction_phase(benefit, prices, eps, n):
             - w2 + eps
         # resolve: per-object max over bidders; bidder id in low bits so the
         # decode is exact and ties break toward the lowest row id.
-        rank = jnp.arange(n, dtype=jnp.float64)
-        enc = jnp.where(unassigned, bid * n + (n - 1 - rank), -1.0)
-        win_enc = jnp.full((n,), -1.0).at[j1].max(enc, mode="drop")
-        won = win_enc >= 0.0                              # (n,) objects
-        bid_val = jnp.floor(win_enc / n)
+        rank = jnp.arange(n, dtype=jnp.int64)
+        enc = jnp.where(unassigned, bid * n + (n - 1 - rank), jnp.int64(-1))
+        win_enc = jnp.full((n,), -1, jnp.int64).at[j1].max(enc, mode="drop")
+        won = win_enc >= 0                                # (n,) objects
+        bid_val = win_enc // n
         winner = (n - 1 - (win_enc - bid_val * n)).astype(jnp.int32)
         # previous owners of re-auctioned objects become unassigned
         prev = jnp.where(won & (owner >= 0), owner, n)
@@ -126,47 +133,24 @@ def _auction_phase(benefit, prices, eps, n):
 
 
 @functools.partial(jax.jit, static_argnames=("n",))
-def _solve_one(cost, n):
-    """Solve one n x n min-cost assignment. cost: (n, n) float64."""
-    cmax = jnp.max(cost)
-    cmin = jnp.min(cost)
-    rng = jnp.maximum(cmax - cmin, 1e-30)
-    quant = _quant_for(n)
-    scale = quant / rng
-    # integer benefit grid, scaled by (n+1) so final eps=1 is < "1/n"
-    benefit = jnp.round((cmax - cost) * scale) * (n + 1)
+def _solve_grid(benefit, schedule, n):
+    """Run the epsilon-scaling auction on an int64 benefit grid.
 
-    # epsilon schedule as scan inputs: one traced while_loop for all phases
-    # (a Python unroll compiles P copies of the loop — 10x slower compiles).
-    # Every eps is kept INTEGRAL: benefits/prices/bids then stay on the
-    # integer grid, so the bid-winner encoding bid*n + rank decodes exactly
-    # (a fractional eps corrupts the low bits — the winner decode breaks and
-    # phases stop converging).
-    schedule = []
-    eps = quant * (n + 1) // 2
-    for _ in range(_num_phases(eps)):
-        schedule.append(eps)
-        eps = max(1.0, eps // _EPS_FACTOR)
+    benefit: (n, n) int64; schedule: (phases,) int64 descending epsilons.
+    Returns (assign (n,) i32, owner (n,) i32, prices (n,) i64,
+    profit (n,) i64) — profit is the row dual on the integer grid.
+    """
 
     def phase_step(carry, eps):
         _, _, prices = carry
         return _auction_phase(benefit, prices, eps, n), None
 
     init = (jnp.full((n,), -1, jnp.int32), jnp.full((n,), -1, jnp.int32),
-            jnp.zeros((n,), jnp.float64))
-    (assign, owner, prices), _ = jax.lax.scan(
-        phase_step, init, jnp.asarray(schedule, jnp.float64))
-
-    # duals back in cost units: pi_i = max_j benefit[i,j] - p_j (row profit)
+            jnp.zeros((n,), jnp.int64))
+    (assign, owner, prices), _ = jax.lax.scan(phase_step, init, schedule)
+    # row profit: pi_i = max_j benefit[i,j] - p_j (dual on the grid)
     profit = jnp.max(benefit - prices[None, :], axis=1)
-    denom = scale * (n + 1)
-    row_duals = cmax - profit / denom
-    col_duals = -prices / denom
-    obj_primal = jnp.sum(jnp.take_along_axis(
-        cost, assign[:, None], axis=1)[:, 0])
-    obj_dual = jnp.sum(row_duals) + jnp.sum(col_duals)
-    return LapSolution(assign, owner, row_duals, col_duals,
-                       obj_primal, obj_dual)
+    return assign, owner, prices, profit
 
 
 def solve(res, cost, *, maximize: bool = False) -> LapSolution:
@@ -175,22 +159,66 @@ def solve(res, cost, *, maximize: bool = False) -> LapSolution:
     Functional analogue of ``LinearAssignmentProblem::solve``
     (linear_assignment.cuh:118).  ``cost`` is ``(n, n)`` or
     ``(batch, n, n)`` — the batch axis mirrors the reference's
-    ``batchsize_`` sub-problem axis, vmapped instead of strided.
+    ``batchsize_`` sub-problem axis, ``vmap``-ed through one device
+    dispatch.  Quantization runs host-side in float64; the device part is
+    pure int64, so the solver is exact on backends without native f64
+    (TPU).  Host orchestration — not jit-traceable itself.
     """
     del res  # stateless; kept for the f(resources, ...) calling convention
-    cost = ensure_array(cost, "cost")
-    expects(cost.ndim in (2, 3), "cost must be (n, n) or (batch, n, n)")
-    n = cost.shape[-1]
-    expects(cost.shape[-2] == n, "cost matrix must be square")
-    # the integer bid grid needs the float64 mantissa; scope x64 to this solve
-    with jax.enable_x64():
-        cost = cost.astype(jnp.float64)
-        if maximize:
-            cost = -cost
-        if cost.ndim == 2:
-            sol = _solve_one(cost, n)
-        else:
-            sol = jax.vmap(lambda c: _solve_one(c, n))(cost)
+    cost_np = np.asarray(ensure_array(cost, "cost"), dtype=np.float64)
+    expects(cost_np.ndim in (2, 3), "cost must be (n, n) or (batch, n, n)")
+    n = cost_np.shape[-1]
+    expects(cost_np.shape[-2] == n, "cost matrix must be square")
+    if maximize:
+        cost_np = -cost_np
+
+    batched = cost_np.ndim == 3
+    probs = cost_np if batched else cost_np[None]
+    # host-side exact quantization, vectorized over the batch: per-problem
+    # grids (quant and the epsilon schedule depend only on n).  numpy
+    # float64 round -> int64 is exact for |values| < 2^53.
+    cmax = probs.max(axis=(1, 2))                       # (B,)
+    rng = np.maximum(cmax - probs.min(axis=(1, 2)), 1e-30)
+    quant = _quant_for(n)
+    scale = quant / rng                                 # (B,)
+    benefit = (np.round((cmax[:, None, None] - probs)
+                        * scale[:, None, None]) * (n + 1)).astype(np.int64)
+
+    # epsilon schedule as scan inputs: one traced while_loop for all
+    # phases (a Python unroll compiles P copies of the loop — 10x slower
+    # compiles).  Every eps is an exact integer: benefits/prices/bids stay
+    # on the integer grid, so the bid-winner encoding bid*n + rank decodes
+    # exactly.
+    schedule = []
+    eps = quant * (n + 1) // 2
+    for _ in range(_num_phases(eps)):
+        schedule.append(eps)
+        eps = max(1, eps // _EPS_FACTOR)
+
+    with jax.enable_x64():   # int64 device arrays (no f64 ever on device)
+        sched = jnp.asarray(schedule, jnp.int64)
+        assign, owner, prices, profit = jax.vmap(
+            lambda b: _solve_grid(b, sched, n))(jnp.asarray(benefit))
+
+    assign_np = np.asarray(assign)
+    denom = (scale * (n + 1))[:, None]                  # (B, 1)
+    row_duals = cmax[:, None] - np.asarray(profit, np.float64) / denom
+    col_duals = -np.asarray(prices, np.float64) / denom
+    obj_primal = np.take_along_axis(
+        probs, assign_np[:, :, None].astype(np.int64), axis=2
+    )[:, :, 0].sum(axis=1)
+    obj_dual = row_duals.sum(axis=1) + col_duals.sum(axis=1)
+
+    row_duals = jnp.asarray(row_duals, jnp.float32)
+    col_duals = jnp.asarray(col_duals, jnp.float32)
+    obj_primal = jnp.asarray(obj_primal, jnp.float32)
+    obj_dual = jnp.asarray(obj_dual, jnp.float32)
+    if not batched:
+        assign, owner = assign[0], owner[0]
+        row_duals, col_duals = row_duals[0], col_duals[0]
+        obj_primal, obj_dual = obj_primal[0], obj_dual[0]
+    sol = LapSolution(assign, owner, row_duals, col_duals,
+                      obj_primal, obj_dual)
     if maximize:
         sol = sol._replace(row_duals=-sol.row_duals,
                            col_duals=-sol.col_duals,

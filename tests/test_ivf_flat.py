@@ -136,7 +136,6 @@ class TestIvfFlat:
         sp = ivf_flat.SearchParams(n_probes=4)
         # batch A: natural queries seed the cache at a low group count
         ivf_flat.search(res, sp, index, q, 10)
-        from raft_tpu.neighbors import grouped
         cached = dict(index._group_cache)
         # batch B: every query near one centroid -> probes pile onto few
         # lists, inflating that list's group need past the cached value
