@@ -9,19 +9,26 @@ each row's top-kt **in VMEM**, so the distance matrix never reaches HBM.
 Structure per program ``g``:
 
 - the scalar-prefetched ``group_list`` drives the BlockSpec index maps —
-  the list's bf16 reconstructions, squared norms, and slot-validity ids
-  are DMA'd directly by list id (the TPU equivalent of the reference
+  the list's bf16 reconstructions, squared norms, and candidate ids are
+  DMA'd directly by list id (the TPU equivalent of the reference
   assigning one CTA per (list, query-group));
-- the group's query-residual tile (precomputed outside: ``q_rot - center``
-  in fp32, cast bf16) hits the MXU against the list tile:
-  ``d = ||sub||^2 + ||recon||^2 - 2 sub.recon``;
-- top-kt per row by iterative max-extraction (kt passes of
-  max / where-iota argmin / mask over the VMEM-resident (GROUP, cap)
-  block) — the XLA path's separate sort pass and its HBM round-trip of
-  the distances are folded away.
+- the group's rotated queries are gathered from the VMEM-resident
+  ``qrot`` table (it is only nq x rot ~ a few MB) by a **one-hot MXU
+  matmul** — Mosaic has no native row-gather, and the XLA-side gather
+  this replaces measured ~120 ms/batch at bench shapes versus a few ms
+  of MXU time for the one-hot contraction;
+- residuals against the list center, the distance GEMM
+  ``d = ||sub||^2 + ||recon||^2 - 2 sub.recon``, and kt passes of
+  max / where-iota argmin / mask extract the top-kt per row — all in
+  VMEM;
+- selected positions map to **global candidate ids** by a second one-hot
+  contraction against the list's id row (ids < 2^24 are exact in f32),
+  so the XLA side needs no post-hoc id gather.
 
-Returns per-pair values and *positions* (column within the list); callers
-map positions to candidate ids with a broadcasting ``take_along_axis``.
+Outputs are per-pair (values, global ids); callers scatter them into the
+(P, kt) buffers by pair slot.  Rows with fewer than kt finite candidates
+emit +inf values; callers map those to the -1 id sentinel (valid L2
+distances are finite).
 """
 
 from __future__ import annotations
@@ -36,60 +43,86 @@ from jax.experimental.pallas import tpu as pltpu
 from raft_tpu.neighbors.grouped import GROUP
 
 
-def _kernel(gl_ref, sub_ref, subsq_ref, data_ref, rsq_ref, ids_ref,
-            vals_ref, pos_ref, vscratch, pscratch, *, kt):
-    sub = sub_ref[0]                                   # (G, rot) bf16
+def _kernel(gl_ref, slot_ref, qrot_ref, cf_ref, data_ref, rsq_ref, ids_ref,
+            vals_ref, ids_out_ref, vscratch, pscratch, *, kt, n_probes, P):
+    nq_pad = qrot_ref.shape[0]
+    slot = slot_ref[0, 0]                              # (G,) int32 pair ids
+    qid = jnp.where(slot < P, slot // n_probes, nq_pad - 1)
+
+    # ---- query gather: one-hot (G, nq_pad) @ qrot (nq_pad, rot) on MXU.
+    # f32 one-hot x f32 table is EXACT (one product per output) — a bf16
+    # table would round |q| before the center subtraction, which can
+    # exceed the residual magnitude on well-clustered data ----
+    cols = jax.lax.broadcasted_iota(jnp.int32, (GROUP, nq_pad), 1)
+    onehot = (cols == qid[:, None]).astype(jnp.float32)
+    qv = jax.lax.dot_general(onehot, qrot_ref[:],
+                             (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)  # (G, rot)
+
+    sub = qv - cf_ref[0, 0][None, :]                   # (G, rot) f32
+    sub_sq = jnp.sum(sub * sub, axis=1)                # (G,)
     data = data_ref[0]                                 # (cap, rot) bf16
-    ip = jax.lax.dot_general(sub, data, (((1,), (1,)), ((), ())),
+    ip = jax.lax.dot_general(sub.astype(jnp.bfloat16), data,
+                             (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-    # the 1-length middle axis keeps 2-D operands in valid TPU block
-    # shapes (see grouped_l2_scan's reshapes)
-    d = subsq_ref[0, 0][:, None] + rsq_ref[0, 0][None, :] - 2.0 * ip
+    d = sub_sq[:, None] + rsq_ref[0, 0][None, :] - 2.0 * ip
     d = jnp.maximum(d, 0.0)
-    invalid = (ids_ref[0, 0] < 0)[None, :]             # (1, cap)
+    ids_row = ids_ref[0, 0]                            # (cap,) int32
+    invalid = (ids_row < 0)[None, :]
     neg = jnp.where(invalid, -jnp.inf, -d)             # select-min as max
 
     cap = neg.shape[1]
     col = jax.lax.broadcasted_iota(jnp.int32, neg.shape, 1)
+    ids_f = ids_row.astype(jnp.float32)                # exact below 2^24
     for j in range(kt):
         m = jnp.max(neg, axis=1)                       # (G,)
         # where-iota argmax (ties -> lowest column, stable like sort)
         p = jnp.min(jnp.where(neg == m[:, None], col, cap), axis=1)
         p = jnp.minimum(p, cap - 1)                    # all -inf row guard
         vscratch[:, j] = -m
-        pscratch[:, j] = p
-        neg = jnp.where(col == p[:, None], -jnp.inf, neg)
+        # position -> global id via a masked reduce against the id row
+        # (one (G, cap) pass per j; a single (G*kt, cap) one-hot matmul
+        # would cost ~5 MB of VMEM)
+        sel = col == p[:, None]
+        gid = jnp.max(jnp.where(sel, ids_f[None, :], -jnp.inf), axis=1)
+        pscratch[:, j] = gid.astype(jnp.int32)
+        neg = jnp.where(sel, -jnp.inf, neg)
+
     vals_ref[0] = vscratch[:, :]
-    pos_ref[0] = pscratch[:, :]
+    ids_out_ref[0] = pscratch[:, :]
 
 
-@functools.partial(jax.jit, static_argnames=("kt", "interpret"))
-def grouped_l2_scan(group_list, sub, sub_sq, list_recon, rec_sq,
-                    list_indices, kt, interpret=False):
-    """Fused distance + local top-kt over all pair groups.
+@functools.partial(jax.jit, static_argnames=("kt", "n_probes", "interpret"))
+def grouped_l2_scan(group_list, slot_pairs, qrot, centers_f32, list_recon,
+                    rec_sq, list_indices, kt, n_probes, interpret=False):
+    """Fused query-gather + distance + local top-kt over all pair groups.
 
-    ``group_list`` (n_groups,) int32; ``sub`` (n_groups, GROUP, rot) bf16;
-    ``sub_sq`` (n_groups, GROUP) f32; ``list_recon`` (n_lists, cap, rot)
-    bf16; ``rec_sq`` (n_lists, cap) f32; ``list_indices`` (n_lists, cap)
-    int32.  Returns ``(vals (n_groups, GROUP, kt) f32, pos ... int32)``
-    sorted ascending (L2).  Invalid slots carry +inf.
+    ``group_list`` (n_groups,) int32; ``slot_pairs`` (n_groups, GROUP)
+    int32 pair ids with P = nq * n_probes as the empty sentinel;
+    ``qrot`` (nq, rot) f32 rotated queries; ``centers_f32`` (n_lists, rot)
+    f32; ``list_recon`` (n_lists, cap, rot) bf16; ``rec_sq`` (n_lists,
+    cap) f32; ``list_indices`` (n_lists, cap) int32.  Returns
+    ``(vals (n_groups, GROUP, kt) f32, ids ... int32)`` sorted ascending
+    (L2); exhausted rows carry +inf values (callers map them to -1 ids).
     """
     n_groups = group_list.shape[0]
-    _, cap, rot = list_recon.shape
+    nq, rot = qrot.shape
+    _, cap, _ = list_recon.shape
+    P = nq * n_probes
 
-    # 2-D operands get a singleton middle axis: TPU block shapes must have
-    # their last two dims tile-aligned or equal to the array dims, which
-    # (1, len) blocks of a 2-D array violate
-    sub_sq3 = sub_sq[:, None, :]
-    rec_sq3 = rec_sq[:, None, :]
-    ids3 = list_indices[:, None, :]
+    # pad the query table to a lane-friendly height; the sentinel row
+    # (all zeros, index nq_pad-1) is what empty slots gather
+    nq_pad = -(-(nq + 1) // 128) * 128
+    qrot_pad = jnp.zeros((nq_pad, rot), jnp.float32)
+    qrot_pad = qrot_pad.at[:nq].set(qrot.astype(jnp.float32))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_groups,),
         in_specs=[
-            pl.BlockSpec((1, GROUP, rot), lambda g, gl: (g, 0, 0)),
             pl.BlockSpec((1, 1, GROUP), lambda g, gl: (g, 0, 0)),
+            pl.BlockSpec((nq_pad, rot), lambda g, gl: (0, 0)),
+            pl.BlockSpec((1, 1, rot), lambda g, gl: (gl[g], 0, 0)),
             pl.BlockSpec((1, cap, rot), lambda g, gl: (gl[g], 0, 0)),
             pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
             pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
@@ -103,21 +136,29 @@ def grouped_l2_scan(group_list, sub, sub_sq, list_recon, rec_sq,
             pltpu.VMEM((GROUP, kt), jnp.int32),
         ],
     )
-    vals, pos = pl.pallas_call(
-        functools.partial(_kernel, kt=kt),
+    vals, gids = pl.pallas_call(
+        functools.partial(_kernel, kt=kt, n_probes=n_probes, P=P),
         out_shape=[
             jax.ShapeDtypeStruct((n_groups, GROUP, kt), jnp.float32),
             jax.ShapeDtypeStruct((n_groups, GROUP, kt), jnp.int32),
         ],
         grid_spec=grid_spec,
         interpret=interpret,
-    )(group_list, sub, sub_sq3, list_recon, rec_sq3, ids3)
-    return vals, pos
+    )(group_list, slot_pairs[:, None, :], qrot_pad,
+      centers_f32[:, None, :], list_recon, rec_sq[:, None, :],
+      list_indices[:, None, :])
+    return vals, gids
 
 
-def supported(metric_is_l2: bool, cap: int, rot: int, kt: int) -> bool:
+def supported(metric_is_l2: bool, cap: int, rot: int, kt: int,
+              n_total: int, nq: int) -> bool:
     """Shapes the kernel handles; callers fall back to the XLA scan
-    otherwise.  Lane dim must be a full 128 multiple and the sublane dim a
-    bf16 tile multiple; kt is bounded to keep the extraction loop sane."""
+    otherwise.  Lane dims must be 128-aligned (rot) or tile-aligned
+    (cap); candidate ids must be f32-exact for the one-hot id
+    contraction; kt is bounded to keep the extraction loop sane; the
+    query table and its per-program one-hot both live whole in VMEM, so
+    the batch size is capped (the one-hot gather cost also grows with
+    nq — larger batches should be split by the caller anyway)."""
     return (metric_is_l2 and rot % 128 == 0 and cap % 16 == 0
-            and GROUP % 16 == 0 and 0 < kt <= 64)
+            and GROUP % 16 == 0 and 0 < kt <= 64 and n_total < (1 << 24)
+            and nq <= 6144 and nq * rot * 4 <= (3 << 20))

@@ -209,10 +209,13 @@ def solve(res, cost, *, maximize: bool = False) -> LapSolution:
     )[:, :, 0].sum(axis=1)
     obj_dual = row_duals.sum(axis=1) + col_duals.sum(axis=1)
 
-    row_duals = jnp.asarray(row_duals, jnp.float32)
-    col_duals = jnp.asarray(col_duals, jnp.float32)
-    obj_primal = jnp.asarray(obj_primal, jnp.float32)
-    obj_dual = jnp.asarray(obj_dual, jnp.float32)
+    # duals/objectives are exact in host float64 — return them as host
+    # arrays at that precision (the previous f64 API contract; a f64
+    # DEVICE array would be unrepresentable on TPU backends)
+    row_duals = np.asarray(row_duals, np.float64)
+    col_duals = np.asarray(col_duals, np.float64)
+    obj_primal = np.asarray(obj_primal, np.float64)
+    obj_dual = np.asarray(obj_dual, np.float64)
     if not batched:
         assign, owner = assign[0], owner[0]
         row_duals, col_duals = row_duals[0], col_duals[0]
