@@ -159,6 +159,32 @@ def finalize_topk(outd: jax.Array, outi: jax.Array, nq: int, k: int,
     return best_d, best_i
 
 
+def scatter_packed(vals, ids, slot_pairs, P, select_min):
+    """Scatter per-pair kernel results into (P, kt) buffers in ONE pass.
+
+    Two separate (values, ids) row scatters measured ~36 ms each at bench
+    shapes; bitcast-packing halves the per-row scatter bookkeeping.
+    Rows with +inf values (exhausted: fewer than kt finite candidates)
+    get the -1 id sentinel, matching the XLA scan path.
+    """
+    kt = vals.shape[-1]
+    worst = jnp.inf if select_min else -jnp.inf
+    ids = jnp.where(jnp.isinf(vals), -1, ids)
+    flat = slot_pairs.reshape(-1)
+    packed = jnp.concatenate(
+        [jax.lax.bitcast_convert_type(vals, jnp.int32).reshape(-1, kt),
+         ids.reshape(-1, kt)], axis=1)                 # (rows, 2*kt)
+    init = jnp.concatenate(
+        [jnp.broadcast_to(
+            jax.lax.bitcast_convert_type(jnp.float32(worst), jnp.int32),
+            (P, kt)),
+         jnp.full((P, kt), -1, jnp.int32)], axis=1)
+    outp = init.at[flat].set(packed, mode="drop")
+    outd = jax.lax.bitcast_convert_type(outp[:, :kt], jnp.float32)
+    outi = outp[:, kt:]
+    return outd, outi
+
+
 def block_size(n_groups: int, *per_group_bytes: int,
                budget: int = 96 << 20, quantum: int = 16) -> int:
     """Groups per scan step such that the listed per-group transients stay

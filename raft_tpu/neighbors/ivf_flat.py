@@ -77,6 +77,10 @@ class Index:
     list_sizes: jax.Array       # (n_lists,) int32
     metric: int = DistanceType.L2Expanded
     adaptive_centers: bool = False
+    # Derived search-time cache: per-row squared norms (n_lists, capacity)
+    # fp32, loop-invariant across searches (recomputing it per call costs
+    # a full pass over the raw vectors).  Lazily attached by search().
+    list_data_sq: Optional[jax.Array] = None
 
     @property
     def n_lists(self) -> int:
@@ -96,12 +100,13 @@ class Index:
 
     def tree_flatten(self):
         leaves = (self.centers, self.list_data, self.list_indices,
-                  self.list_sizes)
+                  self.list_sizes, self.list_data_sq)
         return leaves, (self.metric, self.adaptive_centers)
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
-        return cls(*leaves, metric=aux[0], adaptive_centers=aux[1])
+        return cls(*leaves[:4], metric=aux[0], adaptive_centers=aux[1],
+                   list_data_sq=leaves[4])
 
 
 def _round_up(x: int, align: int) -> int:
@@ -245,9 +250,16 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
         # one host sync over an (n_lists,) reduction decides the path — the
         # only data-dependent choice (capacity is a static shape)
         if int(jnp.max(needed)) <= index.capacity:
-            list_data, list_idx, sizes = _append_lists(
-                index.list_data, index.list_indices, index.list_sizes,
-                new_vectors, new_labels, new_indices)
+            bufs, rows = [index.list_data], [new_vectors]
+            if index.list_data_sq is not None:
+                bufs.append(index.list_data_sq)
+                rows.append(jnp.sum(
+                    new_vectors.astype(jnp.float32) ** 2, axis=-1))
+            new_bufs, list_idx, sizes = _append_lists_multi(
+                tuple(bufs), tuple(rows), index.list_indices,
+                index.list_sizes, new_labels, new_indices)
+            list_data = new_bufs[0]
+            data_sq = new_bufs[1] if len(new_bufs) > 1 else None
             centers = index.centers
             if index.adaptive_centers:
                 # incremental drift: centers approximate list means, so the
@@ -268,7 +280,8 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
             return Index(centers=centers, list_data=list_data,
                          list_indices=list_idx, list_sizes=sizes,
                          metric=index.metric,
-                         adaptive_centers=index.adaptive_centers)
+                         adaptive_centers=index.adaptive_centers,
+                         list_data_sq=data_sq)
 
         # slow path: existing rows, flattened back out of the padded storage
         old_valid = index.list_indices >= 0
@@ -373,14 +386,18 @@ def _select_clusters(centers, queries, n_probes, metric):
 
 
 @functools.partial(jax.jit, static_argnames=("k", "metric", "n_groups",
-                                             "block"))
+                                             "block", "use_pallas",
+                                             "pallas_interpret"))
 def _search_impl_grouped(centers, list_data, list_indices, queries, probes,
-                         k, metric, n_groups, block):
+                         k, metric, n_groups, block, list_data_sq=None,
+                         use_pallas=False, pallas_interpret=False):
     """List-centric scan over fixed-size pair groups: each group is GROUP
     (query, probe) pairs of one list, so list vectors are read ~once and
     the distance block is a full batched MXU GEMM.  See
     :mod:`raft_tpu.neighbors.grouped` for the design; distances here are
     exact fp32 (same restructure as ivf_pq._search_impl_recon_grouped).
+    On TPU the scan runs as the fused Pallas kernel
+    (:mod:`raft_tpu.ops.pq_group_scan_pallas`, flat variant).
     """
     from raft_tpu.neighbors import grouped
 
@@ -388,6 +405,7 @@ def _search_impl_grouped(centers, list_data, list_indices, queries, probes,
     P = nq * n_probes
     n_lists = centers.shape[0]
     cap = list_data.shape[1]
+    dim = list_data.shape[2]
     ip_metric = metric == DistanceType.InnerProduct
     worst = -jnp.inf if ip_metric else jnp.inf
 
@@ -395,6 +413,25 @@ def _search_impl_grouped(centers, list_data, list_indices, queries, probes,
     q_sq = jnp.sum(qf * qf, axis=1)
 
     group_list, slot_pairs = grouped.build_groups(probes, n_lists, n_groups)
+
+    kt = min(k, cap)
+    if use_pallas:
+        from raft_tpu.ops import pq_group_scan_pallas as pqp
+
+        if pqp.supported(not ip_metric, cap, dim, kt, n_lists * cap, nq,
+                         data_elem_bytes=4):
+            d_sq = (list_data_sq if list_data_sq is not None
+                    else jnp.sum(list_data.astype(jnp.float32) ** 2,
+                                 axis=-1))
+            vals, ti = pqp.grouped_flat_l2_scan(
+                group_list, slot_pairs, qf, list_data, d_sq,
+                list_indices, kt, n_probes, interpret=pallas_interpret)
+            outd, outi = grouped.scatter_packed(vals, ti, slot_pairs, P,
+                                                not ip_metric)
+            return grouped.finalize_topk(
+                outd, outi, nq, k, not ip_metric,
+                metric in (DistanceType.L2SqrtExpanded,
+                           DistanceType.L2SqrtUnexpanded), select_k)
 
     def distance_block(gl, slot):
         qid = jnp.where(slot < P, slot // n_probes, 0)
@@ -450,6 +487,12 @@ def search(res, params: SearchParams, index: Index, queries, k: int
             index, gkey, probes, index.n_lists)
         G = grouped.GROUP
 
+        use_pallas = jax.default_backend() == "tpu"
+        if use_pallas and index.list_data_sq is None:
+            # lazily attach the row-norm cache (stays on the index)
+            index.list_data_sq = jnp.sum(
+                index.list_data.astype(jnp.float32) ** 2, axis=-1)
+
         def dispatch(ng):
             cap = index.capacity
             block = grouped.block_size(
@@ -458,7 +501,9 @@ def search(res, params: SearchParams, index: Index, queries, k: int
                 (cap + G) * index.dim * 4)  # data slice + query gather
             return _search_impl_grouped(index.centers, index.list_data,
                                         index.list_indices, queries, probes,
-                                        k, index.metric, ng, block)
+                                        k, index.metric, ng, block,
+                                        list_data_sq=index.list_data_sq,
+                                        use_pallas=use_pallas)
 
         out = dispatch(n_groups)
         needed = grouped.commit_groups(index, gkey, pending)

@@ -666,27 +666,8 @@ def _search_impl_recon_grouped(centers, list_recon, list_recon_sq,
                 group_list, slot_pairs, qrot, cf, list_recon,
                 list_recon_sq, list_indices, kt, n_probes,
                 interpret=pallas_interpret)
-            # rows with fewer than kt finite candidates: the kernel's
-            # extraction re-selects an already-taken column at +inf — map
-            # those to the XLA path's -1 sentinel (valid L2 distances are
-            # finite, so +inf uniquely marks exhaustion)
-            ti = jnp.where(jnp.isinf(vals), -1, ti)
-            flat = slot_pairs.reshape(-1)
-            # ONE packed scatter: the two separate (values, ids) row
-            # scatters each measured ~36 ms/batch at bench shapes —
-            # bitcast-pack halves the per-row scatter bookkeeping
-            packed = jnp.concatenate(
-                [jax.lax.bitcast_convert_type(vals, jnp.int32)
-                    .reshape(-1, kt),
-                 ti.reshape(-1, kt)], axis=1)            # (rows, 2*kt)
-            init = jnp.concatenate(
-                [jnp.broadcast_to(
-                    jax.lax.bitcast_convert_type(
-                        jnp.float32(worst), jnp.int32), (P, kt)),
-                 jnp.full((P, kt), -1, jnp.int32)], axis=1)
-            outp = init.at[flat].set(packed, mode="drop")
-            outd = jax.lax.bitcast_convert_type(outp[:, :kt], jnp.float32)
-            outi = outp[:, kt:]
+            outd, outi = grouped.scatter_packed(vals, ti, slot_pairs, P,
+                                                not ip_metric)
             return grouped.finalize_topk(
                 outd, outi, nq, k, not ip_metric,
                 metric in (DistanceType.L2SqrtExpanded,

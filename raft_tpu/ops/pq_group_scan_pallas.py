@@ -43,22 +43,25 @@ from jax.experimental.pallas import tpu as pltpu
 from raft_tpu.neighbors.grouped import GROUP
 
 
-def _kernel(gl_ref, slot_ref, qrot_ref, cf_ref, data_ref, rsq_ref, ids_ref,
-            vals_ref, ids_out_ref, vscratch, pscratch, *, kt, n_probes, P):
-    nq_pad = qrot_ref.shape[0]
+def _gather_queries(slot_ref, q_ref, n_probes, P):
+    """One-hot MXU row gather of the group's queries from the
+    VMEM-resident table.  f32 one-hot x f32 table is EXACT (one product
+    per output) — a bf16 table would round |q| before any center
+    subtraction, which can exceed the residual magnitude on
+    well-clustered data.  Sentinel slots gather the zero row."""
+    nq_pad = q_ref.shape[0]
     slot = slot_ref[0, 0]                              # (G,) int32 pair ids
     qid = jnp.where(slot < P, slot // n_probes, nq_pad - 1)
-
-    # ---- query gather: one-hot (G, nq_pad) @ qrot (nq_pad, rot) on MXU.
-    # f32 one-hot x f32 table is EXACT (one product per output) — a bf16
-    # table would round |q| before the center subtraction, which can
-    # exceed the residual magnitude on well-clustered data ----
     cols = jax.lax.broadcasted_iota(jnp.int32, (GROUP, nq_pad), 1)
     onehot = (cols == qid[:, None]).astype(jnp.float32)
-    qv = jax.lax.dot_general(onehot, qrot_ref[:],
-                             (((1,), (0,)), ((), ())),
-                             preferred_element_type=jnp.float32)  # (G, rot)
+    return jax.lax.dot_general(onehot, q_ref[:],
+                               (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)  # (G, d)
 
+
+def _kernel(gl_ref, slot_ref, qrot_ref, cf_ref, data_ref, rsq_ref, ids_ref,
+            vals_ref, ids_out_ref, vscratch, pscratch, *, kt, n_probes, P):
+    qv = _gather_queries(slot_ref, qrot_ref, n_probes, P)
     sub = qv - cf_ref[0, 0][None, :]                   # (G, rot) f32
     sub_sq = jnp.sum(sub * sub, axis=1)                # (G,)
     data = data_ref[0]                                 # (cap, rot) bf16
@@ -68,6 +71,17 @@ def _kernel(gl_ref, slot_ref, qrot_ref, cf_ref, data_ref, rsq_ref, ids_ref,
     d = sub_sq[:, None] + rsq_ref[0, 0][None, :] - 2.0 * ip
     d = jnp.maximum(d, 0.0)
     ids_row = ids_ref[0, 0]                            # (cap,) int32
+    _extract_topk(d, ids_row, vals_ref, ids_out_ref, vscratch, pscratch,
+                  kt)
+
+
+def _extract_topk(d, ids_row, vals_ref, ids_out_ref, vscratch, pscratch,
+                  kt):
+    """Shared in-VMEM top-kt extraction + position -> global-id mapping.
+
+    kt passes of max / where-iota argmin / mask over the (G, cap) block;
+    the id map is a masked reduce against the list's id row per pass
+    (a single (G*kt, cap) one-hot matmul would cost ~5 MB of VMEM)."""
     invalid = (ids_row < 0)[None, :]
     neg = jnp.where(invalid, -jnp.inf, -d)             # select-min as max
 
@@ -80,9 +94,6 @@ def _kernel(gl_ref, slot_ref, qrot_ref, cf_ref, data_ref, rsq_ref, ids_ref,
         p = jnp.min(jnp.where(neg == m[:, None], col, cap), axis=1)
         p = jnp.minimum(p, cap - 1)                    # all -inf row guard
         vscratch[:, j] = -m
-        # position -> global id via a masked reduce against the id row
-        # (one (G, cap) pass per j; a single (G*kt, cap) one-hot matmul
-        # would cost ~5 MB of VMEM)
         sel = col == p[:, None]
         gid = jnp.max(jnp.where(sel, ids_f[None, :], -jnp.inf), axis=1)
         pscratch[:, j] = gid.astype(jnp.int32)
@@ -90,6 +101,23 @@ def _kernel(gl_ref, slot_ref, qrot_ref, cf_ref, data_ref, rsq_ref, ids_ref,
 
     vals_ref[0] = vscratch[:, :]
     ids_out_ref[0] = pscratch[:, :]
+
+
+def _kernel_flat(gl_ref, slot_ref, q_ref, data_ref, dsq_ref, ids_ref,
+                 vals_ref, ids_out_ref, vscratch, pscratch, *, kt,
+                 n_probes, P):
+    """IVF-Flat variant: exact fp32 distances over raw list vectors
+    (d = ||q||^2 + ||x||^2 - 2 q.x), same gather/extraction structure."""
+    qv = _gather_queries(slot_ref, q_ref, n_probes, P)
+    q_sq = jnp.sum(qv * qv, axis=1)                    # (G,)
+    data = data_ref[0]                                 # (cap, d) f32
+    ip = jax.lax.dot_general(qv, data, (((1,), (1,)), ((), ())),
+                             precision=jax.lax.Precision.HIGHEST,
+                             preferred_element_type=jnp.float32)
+    d = jnp.maximum(q_sq[:, None] + dsq_ref[0, 0][None, :] - 2.0 * ip, 0.0)
+    ids_row = ids_ref[0, 0]                            # (cap,) int32
+    _extract_topk(d, ids_row, vals_ref, ids_out_ref, vscratch, pscratch,
+                  kt)
 
 
 @functools.partial(jax.jit, static_argnames=("kt", "n_probes", "interpret"))
@@ -150,15 +178,69 @@ def grouped_l2_scan(group_list, slot_pairs, qrot, centers_f32, list_recon,
     return vals, gids
 
 
+@functools.partial(jax.jit, static_argnames=("kt", "n_probes", "interpret"))
+def grouped_flat_l2_scan(group_list, slot_pairs, queries_f32, list_data,
+                         d_sq, list_indices, kt, n_probes, interpret=False):
+    """IVF-Flat fused scan: exact fp32 distances over raw list vectors.
+    Same contract as :func:`grouped_l2_scan` with ``queries_f32``
+    (nq, dim) raw queries, ``list_data`` (n_lists, cap, dim) fp32 and
+    ``d_sq`` (n_lists, cap) fp32 row norms."""
+    n_groups = group_list.shape[0]
+    nq, dim = queries_f32.shape
+    _, cap, _ = list_data.shape
+    P = nq * n_probes
+
+    nq_pad = -(-(nq + 1) // 128) * 128
+    q_pad = jnp.zeros((nq_pad, dim), jnp.float32)
+    q_pad = q_pad.at[:nq].set(queries_f32.astype(jnp.float32))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_groups,),
+        in_specs=[
+            pl.BlockSpec((1, 1, GROUP), lambda g, gl: (g, 0, 0)),
+            pl.BlockSpec((nq_pad, dim), lambda g, gl: (0, 0)),
+            pl.BlockSpec((1, cap, dim), lambda g, gl: (gl[g], 0, 0)),
+            pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
+            pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, GROUP, kt), lambda g, gl: (g, 0, 0)),
+            pl.BlockSpec((1, GROUP, kt), lambda g, gl: (g, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((GROUP, kt), jnp.float32),
+            pltpu.VMEM((GROUP, kt), jnp.int32),
+        ],
+    )
+    vals, gids = pl.pallas_call(
+        functools.partial(_kernel_flat, kt=kt, n_probes=n_probes, P=P),
+        out_shape=[
+            jax.ShapeDtypeStruct((n_groups, GROUP, kt), jnp.float32),
+            jax.ShapeDtypeStruct((n_groups, GROUP, kt), jnp.int32),
+        ],
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(group_list, slot_pairs[:, None, :], q_pad,
+      list_data.astype(jnp.float32), d_sq[:, None, :],
+      list_indices[:, None, :])
+    return vals, gids
+
+
 def supported(metric_is_l2: bool, cap: int, rot: int, kt: int,
-              n_total: int, nq: int) -> bool:
+              n_total: int, nq: int, data_elem_bytes: int = 2) -> bool:
     """Shapes the kernel handles; callers fall back to the XLA scan
     otherwise.  Lane dims must be 128-aligned (rot) or tile-aligned
     (cap); candidate ids must be f32-exact for the one-hot id
     contraction; kt is bounded to keep the extraction loop sane; the
-    query table and its per-program one-hot both live whole in VMEM, so
-    the batch size is capped (the one-hot gather cost also grows with
-    nq — larger batches should be split by the caller anyway)."""
+    query table, its per-program one-hot, the per-list data block, and
+    the (GROUP, cap) distance block all live in VMEM, so their summed
+    footprint is bounded (the one-hot gather cost also grows with nq —
+    larger batches should be split by the caller anyway)."""
+    nq_pad = -(-(nq + 1) // 128) * 128
+    vmem = (2 * nq_pad * rot * 4              # query table + one-hot
+            + cap * rot * data_elem_bytes     # per-list data block
+            + 2 * GROUP * cap * 4)            # distances + extraction temps
     return (metric_is_l2 and rot % 128 == 0 and cap % 16 == 0
             and GROUP % 16 == 0 and 0 < kt <= 64 and n_total < (1 << 24)
-            and nq <= 6144 and nq * rot * 4 <= (3 << 20))
+            and nq <= 6144 and vmem <= (10 << 20))
