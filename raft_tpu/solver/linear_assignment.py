@@ -165,6 +165,12 @@ def solve(res, cost, *, maximize: bool = False) -> LapSolution:
     (TPU).  Host orchestration — not jit-traceable itself.
     """
     del res  # stateless; kept for the f(resources, ...) calling convention
+    if isinstance(cost, jax.core.Tracer):
+        raise TypeError(
+            "lap.solve is host-orchestrating (float64 quantization + "
+            "epsilon scheduling run on the host) and cannot be traced "
+            "under jit/vmap — call it outside the transform, or vmap "
+            "batched problems by passing a (batch, n, n) cost instead.")
     cost_np = np.asarray(ensure_array(cost, "cost"), dtype=np.float64)
     expects(cost_np.ndim in (2, 3), "cost must be (n, n) or (batch, n, n)")
     n = cost_np.shape[-1]
@@ -211,7 +217,11 @@ def solve(res, cost, *, maximize: bool = False) -> LapSolution:
 
     # duals/objectives are exact in host float64 — return them as host
     # arrays at that precision (the previous f64 API contract; a f64
-    # DEVICE array would be unrepresentable on TPU backends)
+    # DEVICE array would be unrepresentable on TPU backends).  assign and
+    # owner come back to the host too, so LapSolution is uniformly
+    # host-side numpy rather than a jax/numpy mix.
+    assign = np.asarray(assign, np.int32)
+    owner = np.asarray(owner, np.int32)
     row_duals = np.asarray(row_duals, np.float64)
     col_duals = np.asarray(col_duals, np.float64)
     obj_primal = np.asarray(obj_primal, np.float64)
