@@ -55,6 +55,25 @@ def round_groups(n: int) -> int:
     return -(-max(n, 1) // _GROUP_ROUND) * _GROUP_ROUND
 
 
+def ids_f32_exact(index_obj, list_indices: jax.Array) -> bool:
+    """True when every candidate id in ``list_indices`` is exactly
+    representable in float32 (|id| < 2^24) — the precondition for the
+    Pallas kernel's one-hot f32 id contraction.
+
+    ``extend(new_indices=...)`` accepts arbitrary user int32 ids, so a
+    row-count proxy (n_lists * capacity) is not a safe bound.  The check
+    reads the true max |id| once (one tiny host sync) and caches the
+    verdict on the index object; extend() returns a fresh Index, so the
+    cache never goes stale.
+    """
+    cached = getattr(index_obj, "_ids_f32_exact", None)
+    if cached is None:
+        max_abs = int(jnp.max(jnp.abs(list_indices)))
+        cached = max_abs < (1 << 24)
+        object.__setattr__(index_obj, "_ids_f32_exact", cached)
+    return cached
+
+
 def cached_groups(index_obj, key, probes: jax.Array, n_lists: int):
     """Group count for dispatch, avoiding a per-batch host sync.
 

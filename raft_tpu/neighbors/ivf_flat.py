@@ -418,7 +418,7 @@ def _search_impl_grouped(centers, list_data, list_indices, queries, probes,
     if use_pallas:
         from raft_tpu.ops import pq_group_scan_pallas as pqp
 
-        if pqp.supported(not ip_metric, cap, dim, kt, n_lists * cap, nq,
+        if pqp.supported(not ip_metric, cap, dim, kt, nq,
                          data_elem_bytes=4):
             d_sq = (list_data_sq if list_data_sq is not None
                     else jnp.sum(list_data.astype(jnp.float32) ** 2,
@@ -465,6 +465,14 @@ def search(res, params: SearchParams, index: Index, queries, k: int
     Returns ``(distances (q, k), indices (q, k) int32)``; unfilled slots
     (fewer than k valid candidates in the probed lists) carry id -1 and
     +inf / -inf distance, matching the reference's sentinel behavior.
+
+    .. note:: the first TPU search mutates ``index`` in place, lazily
+       attaching derived caches (``list_data_sq`` row norms, the group
+       count and id-exactness caches).  ``list_data_sq`` is a pytree
+       leaf, so the index's registered pytree structure changes from a
+       ``None`` leaf to an array leaf — code that captured the index in
+       a jitted closure before the first search will retrace once, and
+       tree-structure comparisons across that boundary will differ.
     """
     with named_range("ivf_flat::search"):
         queries = ensure_array(queries, "queries")
@@ -487,7 +495,11 @@ def search(res, params: SearchParams, index: Index, queries, k: int
             index, gkey, probes, index.n_lists)
         G = grouped.GROUP
 
-        use_pallas = jax.default_backend() == "tpu"
+        # the fused kernel's one-hot id contraction is f32 — require
+        # every actual candidate id (incl. user-supplied extend ids)
+        # to be f32-exact, not just the row count
+        use_pallas = (jax.default_backend() == "tpu"
+                      and grouped.ids_f32_exact(index, index.list_indices))
         if use_pallas and index.list_data_sq is None:
             # lazily attach the row-norm cache (stays on the index)
             index.list_data_sq = jnp.sum(

@@ -108,7 +108,9 @@ class Index:
 
     ``codebooks``: PER_SUBSPACE (pq_dim, book, pq_len);
                    PER_CLUSTER (n_lists, book, pq_len).
-    ``list_codes``: (n_lists, capacity, pq_dim) uint8 PQ codes;
+    ``list_codes``: (n_lists, capacity, W) uint8 **bit-packed** PQ codes,
+    W = ceil(pq_dim*pq_bits/8) (reference: ivf_pq_codepacking.cuh; at
+    pq_bits=8 this is one byte per sub-dim);
     ``rotation``: (dim, rot_dim) orthonormal (identity when not rotated).
     """
 
@@ -135,6 +137,10 @@ class Index:
     # over the recon cache out of every search call (it measurably fused
     # into the probe loop when computed in-call).
     list_recon_sq: Optional[jax.Array] = None
+    # explicit because list_codes is bit-packed (its trailing axis is the
+    # packed byte width, not pq_dim); 0 -> equal to the code width (the
+    # pq_bits=8 layout where packing is the identity)
+    pq_dim_: int = 0
 
     @property
     def n_lists(self) -> int:
@@ -150,6 +156,11 @@ class Index:
 
     @property
     def pq_dim(self) -> int:
+        return self.pq_dim_ or self.list_codes.shape[2]
+
+    @property
+    def code_width(self) -> int:
+        """Packed bytes per vector in ``list_codes``."""
         return self.list_codes.shape[2]
 
     @property
@@ -172,13 +183,14 @@ class Index:
         leaves = (self.centers, self.codebooks, self.list_codes,
                   self.list_indices, self.list_sizes, self.rotation,
                   self.list_recon, self.list_recon_sq)
-        return leaves, (self.metric, self.codebook_kind, self.pq_bits)
+        return leaves, (self.metric, self.codebook_kind, self.pq_bits,
+                        self.pq_dim_)
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
         return cls(*leaves[:6], list_recon=leaves[6],
                    list_recon_sq=leaves[7], metric=aux[0],
-                   codebook_kind=aux[1], pq_bits=aux[2])
+                   codebook_kind=aux[1], pq_bits=aux[2], pq_dim_=aux[3])
 
 
 # ---------------------------------------------------------------------------
@@ -203,6 +215,52 @@ def _subspace_split(x: jax.Array, pq_dim: int) -> jax.Array:
     """(n, rot_dim) -> (n, pq_dim, pq_len)."""
     n, rd = x.shape
     return x.reshape(n, pq_dim, rd // pq_dim)
+
+
+# ---------------------------------------------------------------------------
+# bit-packed code storage (reference: ivf_pq_codepacking.cuh — codes are
+# packed to the bit; at pq_bits=4 the index stores HALF the bytes of a
+# one-byte-per-subdim layout, which directly caps database size per chip)
+# ---------------------------------------------------------------------------
+
+def packed_code_width(pq_dim: int, pq_bits: int) -> int:
+    """Bytes per vector of bit-packed codes."""
+    return -(-pq_dim * pq_bits // 8)
+
+
+def _pack_codes(codes: jax.Array, pq_bits: int) -> jax.Array:
+    """(..., pq_dim) uint8 codes (< 2^pq_bits) -> (..., W) uint8 packed
+    LSB-first, W = ceil(pq_dim*pq_bits/8).  Identity at pq_bits=8."""
+    if pq_bits == 8:
+        return codes
+    *lead, pq_dim = codes.shape
+    total = pq_dim * pq_bits
+    W = packed_code_width(pq_dim, pq_bits)
+    c = codes.astype(jnp.int32)
+    bit = jnp.arange(pq_bits, dtype=jnp.int32)
+    bits = (c[..., None] >> bit) & 1                   # (..., pq_dim, bits)
+    bits = bits.reshape(*lead, total)
+    bits = jnp.pad(bits, [(0, 0)] * len(lead) + [(0, W * 8 - total)])
+    bits = bits.reshape(*lead, W, 8)
+    weights = jnp.int32(1) << jnp.arange(8, dtype=jnp.int32)
+    return jnp.sum(bits * weights, axis=-1).astype(jnp.uint8)
+
+
+def _unpack_codes(packed: jax.Array, pq_dim: int, pq_bits: int) -> jax.Array:
+    """Inverse of :func:`_pack_codes`: (..., W) uint8 -> (..., pq_dim)
+    uint8.  Each pq_bits-wide field spans at most two bytes; bits past
+    the last byte are masked off, so the clipped high-byte read is safe."""
+    if pq_bits == 8:
+        return packed
+    p = packed.astype(jnp.int32)
+    W = p.shape[-1]
+    bitpos = jnp.arange(pq_dim) * pq_bits
+    b0 = bitpos // 8
+    shift = bitpos % 8
+    lo = jnp.take(p, b0, axis=-1)                      # (..., pq_dim)
+    hi = jnp.take(p, jnp.minimum(b0 + 1, W - 1), axis=-1)
+    mask = (1 << pq_bits) - 1
+    return (((lo | (hi << 8)) >> shift) & mask).astype(jnp.uint8)
 
 
 # codebook k-means needs ~book_size * a-few-hundred rows; more adds wall
@@ -329,13 +387,15 @@ def build(res, params: IndexParams, dataset) -> Index:
 
         index = Index(
             centers=centers, codebooks=codebooks,
-            list_codes=jnp.zeros((params.n_lists, _LIST_ALIGN, pq_dim),
-                                 jnp.uint8),
+            list_codes=jnp.zeros(
+                (params.n_lists, _LIST_ALIGN,
+                 packed_code_width(pq_dim, params.pq_bits)), jnp.uint8),
             list_indices=jnp.full((params.n_lists, _LIST_ALIGN), -1,
                                   jnp.int32),
             list_sizes=jnp.zeros(params.n_lists, jnp.int32),
             rotation=rotation, metric=params.metric,
-            codebook_kind=params.codebook_kind, pq_bits=params.pq_bits)
+            codebook_kind=params.codebook_kind, pq_bits=params.pq_bits,
+            pq_dim_=pq_dim)
         if params.add_data_on_build:
             index = extend(res, index, dataset,
                            jnp.arange(n, dtype=jnp.int32))
@@ -396,7 +456,9 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
         bal = KMeansBalancedParams()
         labels = kmeans_balanced.predict(res, bal, rot, index.centers)
         resid = _subspace_split(rot - index.centers[labels], index.pq_dim)
-        codes = _encode(index.codebooks, resid, index.codebook_kind, labels)
+        codes_u = _encode(index.codebooks, resid, index.codebook_kind,
+                          labels)
+        codes = _pack_codes(codes_u, index.pq_bits)
 
         new_counts = jax.ops.segment_sum(
             jnp.ones(n_new, jnp.int32), labels,
@@ -409,7 +471,7 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
             if index.list_recon is not None:
                 # the new rows' decoded residuals (+ norms) append into the
                 # caches at the same slots, in the same scatter pass
-                recon_rows = _decode_rows(index.codebooks, codes, labels,
+                recon_rows = _decode_rows(index.codebooks, codes_u, labels,
                                           index.codebook_kind)
                 bufs.append(index.list_recon)
                 rows.append(recon_rows)
@@ -425,7 +487,7 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
                 list_codes=new_bufs[0], list_indices=list_idx,
                 list_sizes=sizes, rotation=index.rotation,
                 metric=index.metric, codebook_kind=index.codebook_kind,
-                pq_bits=index.pq_bits)
+                pq_bits=index.pq_bits, pq_dim_=index.pq_dim)
             if index.list_recon is not None:
                 out.list_recon = new_bufs[1]
                 out.list_recon_sq = (new_bufs[2] if len(new_bufs) > 2
@@ -436,7 +498,7 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
         old_valid = (index.list_indices >= 0).ravel()
         old_labels = jnp.repeat(jnp.arange(index.n_lists, dtype=jnp.int32),
                                 index.capacity)[old_valid]
-        old_codes = index.list_codes.reshape(-1, index.pq_dim)[old_valid]
+        old_codes = index.list_codes.reshape(-1, index.code_width)[old_valid]
         old_ids = index.list_indices.ravel()[old_valid]
 
         all_codes = jnp.concatenate([old_codes, codes])
@@ -453,7 +515,7 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
             list_codes=list_codes, list_indices=list_idx,
             list_sizes=sizes, rotation=index.rotation,
             metric=index.metric, codebook_kind=index.codebook_kind,
-            pq_bits=index.pq_bits)
+            pq_bits=index.pq_bits, pq_dim_=index.pq_dim)
         # the cache is attached only when the source index carries one (or
         # at build time per IndexParams.cache_reconstructions) — a lean
         # index never materializes (n, rot_dim) reconstructions
@@ -466,8 +528,10 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
 # reconstruction cache (TPU-native replacement for the smem LUT scan)
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("codebook_kind",))
-def _decode_lists(centers, codebooks, list_codes, codebook_kind):
+@functools.partial(jax.jit, static_argnames=("codebook_kind", "pq_dim",
+                                             "pq_bits"))
+def _decode_lists(centers, codebooks, list_codes, codebook_kind, pq_dim,
+                  pq_bits):
     """Decode every list's PQ codes to bf16 RESIDUAL reconstructions
     (n_lists, capacity, rot_dim) = concat_j codebook_j[code_j].
 
@@ -479,9 +543,9 @@ def _decode_lists(centers, codebooks, list_codes, codebook_kind):
     (ivf_pq_search.cuh:611).
     """
     del centers  # residual space: centers fold in at search time, in fp32
-    L, cap, pq_dim = list_codes.shape
+    L, cap, _ = list_codes.shape
     pq_len = codebooks.shape[-1]
-    codes = list_codes.astype(jnp.int32)
+    codes = _unpack_codes(list_codes, pq_dim, pq_bits).astype(jnp.int32)
 
     # One subspace at a time via scan + dynamic_update_slice: a single
     # (L, cap, pq_dim, pq_len) gather output gets its pq_len axis padded to
@@ -531,7 +595,8 @@ def _recon_sq(list_recon):
 def _with_recon(res, index: Index) -> Index:
     """Attach the derived reconstruction cache (+ squared norms)."""
     index.list_recon = _decode_lists(index.centers, index.codebooks,
-                                     index.list_codes, index.codebook_kind)
+                                     index.list_codes, index.codebook_kind,
+                                     index.pq_dim, index.pq_bits)
     index.list_recon_sq = _recon_sq(index.list_recon)
     return index
 
@@ -657,8 +722,7 @@ def _search_impl_recon_grouped(centers, list_recon, list_recon_sq,
     if use_pallas:
         from raft_tpu.ops import pq_group_scan_pallas as pqp
 
-        if pqp.supported(not ip_metric, cap, rot, kt,
-                         list_recon.shape[0] * cap, nq):
+        if pqp.supported(not ip_metric, cap, rot, kt, nq):
             # fused query-gather + MXU-distance + in-VMEM top-kt + id
             # mapping: neither the distance matrix nor the gathered query
             # residuals ever reach HBM (see the kernel module docstring)
@@ -708,13 +772,16 @@ def _search_impl_recon_grouped(centers, list_recon, list_recon_sq,
 # ---------------------------------------------------------------------------
 
 @functools.partial(jax.jit, static_argnames=(
-    "k", "n_probes", "metric", "codebook_kind", "lut_dtype"))
+    "k", "n_probes", "metric", "codebook_kind", "lut_dtype", "pq_bits"))
 def _search_impl(centers, codebooks, list_codes, list_indices, rotation,
-                 queries, k, n_probes, metric, codebook_kind, lut_dtype):
+                 queries, k, n_probes, metric, codebook_kind, lut_dtype,
+                 pq_bits=8):
     nq = queries.shape[0]
     qrot = queries.astype(jnp.float32) @ rotation       # (q, rot_dim)
     cf = centers.astype(jnp.float32)
-    pq_dim = list_codes.shape[2]
+    # pq_dim from rotation/codebook shapes: list_codes' trailing axis is
+    # the packed byte width
+    pq_dim = rotation.shape[1] // codebooks.shape[-1]
     ip_metric = metric == DistanceType.InnerProduct
 
     # ---- select_clusters (ivf_pq_search.cuh:133): coarse top-n_probes ----
@@ -752,7 +819,8 @@ def _search_impl(centers, codebooks, list_codes, list_indices, rotation,
             bsq = cb_sq[lists][:, None, :]
         lut = (ip if ip_metric else bsq - 2.0 * ip).astype(lut_dtype)
 
-        codes = list_codes[lists]                       # (q, cap, j) uint8
+        codes = _unpack_codes(list_codes[lists], pq_dim,
+                              pq_bits)                  # (q, cap, j) uint8
         ids = list_indices[lists]                       # (q, cap)
         # gather LUT entries by code: (q, cap, j) — the compute_similarity
         # kernel's smem-LUT lookup (ivf_pq_search.cuh:611)
@@ -788,7 +856,14 @@ def _search_impl(centers, codebooks, list_codes, list_indices, rotation,
 @auto_convert_output
 def search(res, params: SearchParams, index: Index, queries, k: int
            ) -> Tuple[jax.Array, jax.Array]:
-    """Search (reference: ivf_pq.cuh:342).  Returns (distances, indices)."""
+    """Search (reference: ivf_pq.cuh:342).  Returns (distances, indices).
+
+    .. note:: the first search may mutate ``index`` in place, lazily
+       attaching derived caches (``list_recon``/``list_recon_sq``, the
+       group count and id-exactness caches); ``list_recon_sq`` is a
+       pytree leaf, so the registered pytree structure can change after
+       the first search (one retrace for jitted closures over the index).
+    """
     with named_range("ivf_pq::search"):
         queries = ensure_array(queries, "queries")
         expects(queries.ndim == 2 and queries.shape[1] == index.dim,
@@ -831,7 +906,12 @@ def search(res, params: SearchParams, index: Index, queries, k: int
             n_groups, pending = grouped.cached_groups(
                 index, gkey, probes, index.n_lists)
             G, rot = grouped.GROUP, index.rot_dim
-            use_pallas = jax.default_backend() == "tpu"
+            # the fused kernel's one-hot id contraction is f32 — require
+            # every actual candidate id (incl. user-supplied extend ids)
+            # to be f32-exact, not just the row count
+            use_pallas = (jax.default_backend() == "tpu"
+                          and grouped.ids_f32_exact(index,
+                                                    index.list_indices))
 
             def dispatch(ng):
                 cap = index.capacity
@@ -855,14 +935,16 @@ def search(res, params: SearchParams, index: Index, queries, k: int
         return _search_impl(index.centers, index.codebooks, index.list_codes,
                             index.list_indices, index.rotation, queries, k,
                             n_probes, index.metric, index.codebook_kind,
-                            jnp.dtype(params.lut_dtype).name)
+                            jnp.dtype(params.lut_dtype).name,
+                            pq_bits=index.pq_bits)
 
 
 # ---------------------------------------------------------------------------
 # serialization (reference: ivf_pq_serialize.cuh:38 kSerializationVersion)
 # ---------------------------------------------------------------------------
 
-_SERIALIZATION_VERSION = 1
+# v2: list_codes are bit-packed; pq_dim is stored explicitly
+_SERIALIZATION_VERSION = 2
 
 
 def serialize(res, stream: BinaryIO, index: Index) -> None:
@@ -870,6 +952,7 @@ def serialize(res, stream: BinaryIO, index: Index) -> None:
     ser.serialize_scalar(res, stream, np.int32(index.metric))
     ser.serialize_scalar(res, stream, np.int32(index.codebook_kind))
     ser.serialize_scalar(res, stream, np.int32(index.pq_bits))
+    ser.serialize_scalar(res, stream, np.int32(index.pq_dim))
     for arr in (index.centers, index.codebooks, index.list_codes,
                 index.list_indices, index.list_sizes, index.rotation):
         ser.serialize_mdspan(res, stream, arr)
@@ -885,10 +968,11 @@ def deserialize(res, stream: BinaryIO, *,
     metric = int(ser.deserialize_scalar(res, stream))
     kind = int(ser.deserialize_scalar(res, stream))
     pq_bits = int(ser.deserialize_scalar(res, stream))
+    pq_dim = int(ser.deserialize_scalar(res, stream))
     arrays = [jnp.asarray(ser.deserialize_mdspan(res, stream))
               for _ in range(6)]
     index = Index(*arrays, metric=metric, codebook_kind=kind,
-                  pq_bits=pq_bits)
+                  pq_bits=pq_bits, pq_dim_=pq_dim)
     # the reconstruction cache is derived state: re-decode from codes —
     # unless the caller opted out (indexes too large for the cache, the
     # same regime as IndexParams.cache_reconstructions=False)

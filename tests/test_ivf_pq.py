@@ -190,7 +190,8 @@ class TestIvfPq:
         assert index.capacity == cap0        # fast path: no repack
         assert index.size == 3040
         full = ivf_pq._decode_lists(index.centers, index.codebooks,
-                                    index.list_codes, index.codebook_kind)
+                                    index.list_codes, index.codebook_kind,
+                                    index.pq_dim, index.pq_bits)
         valid = np.asarray(index.list_indices) >= 0
         np.testing.assert_array_equal(
             np.asarray(index.list_recon, np.float32)[valid],
@@ -257,7 +258,28 @@ class TestIvfPq:
                                     kmeans_n_iters=10)
         index = ivf_pq.build(res, params, db)
         assert index.pq_book_size == 16
+        # bit-packed codes (ivf_pq_codepacking.cuh parity): pq_bits=4
+        # stores HALF the bytes of the one-byte-per-subdim layout
+        assert index.code_width == 16
+        assert index.pq_dim == 32
         d, i = ivf_pq.search(res, ivf_pq.SearchParams(n_probes=16),
                              index, q, 10)
         _, ti = naive_knn(db, q, 10)
         assert recall(np.asarray(i), ti) > 0.5
+        # both search formulations agree on the packed codes
+        _, i_lut = ivf_pq.search(res, ivf_pq.SearchParams(
+            n_probes=16, use_reconstruction=False), index, q, 10)
+        overlap = np.mean([len(set(a) & set(b)) / len(a)
+                           for a, b in zip(np.asarray(i),
+                                           np.asarray(i_lut))])
+        assert overlap >= 0.9
+
+    @pytest.mark.parametrize("pq_bits", [4, 5, 6, 7, 8])
+    def test_code_packing_roundtrip(self, pq_bits):
+        rng = np.random.default_rng(pq_bits)
+        codes = rng.integers(0, 1 << pq_bits,
+                             size=(37, 24)).astype(np.uint8)
+        packed = ivf_pq._pack_codes(jnp.asarray(codes), pq_bits)
+        assert packed.shape == (37, ivf_pq.packed_code_width(24, pq_bits))
+        out = ivf_pq._unpack_codes(packed, 24, pq_bits)
+        np.testing.assert_array_equal(np.asarray(out), codes)
