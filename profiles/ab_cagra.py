@@ -1,0 +1,88 @@
+"""A/B harness for the CAGRA search paths (round 4).
+
+Builds a SIFT-like index at --n scale on the live chip, then sweeps
+operating points over the packed-neighborhood walk (walk_pdim>0) and the
+direct exact walk (walk_pdim=0), reporting QPS + recall@10 vs
+brute-force ground truth.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")   # run from the repo root: python profiles/ab_cagra.py
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=100_000)
+    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--nq", type=int, default=5_000)
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--degree", type=int, default=64)
+    args = ap.parse_args()
+
+    import jax
+    jax.config.update("jax_compilation_cache_dir", "/tmp/raft_tpu_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+    from raft_tpu import DeviceResources
+    from raft_tpu.neighbors import brute_force, cagra
+
+    rng = np.random.default_rng(0)
+    latent = 16
+    Z = rng.normal(size=(args.n + args.nq, latent)).astype(np.float32)
+    A = rng.normal(size=(latent, args.dim)).astype(np.float32) / np.sqrt(latent)
+    X = (Z @ A).astype(np.float32)
+    X += 0.05 * rng.normal(size=X.shape).astype(np.float32)
+    import jax.numpy as jnp
+    X = jnp.asarray(X)
+    db, q = X[:args.n], X[args.n:]
+
+    res = DeviceResources(seed=0)
+    _, gt = brute_force.knn(res, db, q, args.k)
+    gt = np.asarray(gt)
+
+    t0 = time.perf_counter()
+    index = cagra.build(res, cagra.IndexParams(graph_degree=args.degree), db)
+    index.graph.block_until_ready()
+    print(json.dumps({"build_s": round(time.perf_counter() - t0, 1),
+                      "n": args.n}), flush=True)
+
+    def run(sp, runs=3):
+        d, i = cagra.search(res, sp, index, q, args.k)
+        rec = sum(len(set(a) & set(b))
+                  for a, b in zip(np.asarray(i), gt)) / gt.size
+        t0 = time.perf_counter()
+        for _ in range(runs):
+            d, i = cagra.search(res, sp, index, q, args.k)
+        np.asarray(i)
+        qps = args.nq / ((time.perf_counter() - t0) / runs)
+        return rec, qps
+
+    points = [
+        dict(itopk_size=32, search_width=1),
+        dict(itopk_size=32, search_width=2),
+        dict(itopk_size=64, search_width=1),
+        dict(itopk_size=64, search_width=2),
+        dict(itopk_size=64, search_width=4),
+        dict(itopk_size=96, search_width=2),
+        dict(itopk_size=128, search_width=4),
+    ]
+    for walk in (16, 0):
+        for pt in points:
+            sp = cagra.SearchParams(walk_pdim=walk, **pt)
+            rec, qps = run(sp)
+            print(json.dumps({"walk_pdim": walk, **pt,
+                              "recall": round(rec, 4),
+                              "qps": round(qps, 1)}), flush=True)
+            if walk == 0:
+                break   # direct path: one reference point only (slow)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,125 @@
+"""Micro-bench: random row gather from a (1M, 128) table on TPU.
+
+CAGRA's greedy walk gathers (q * search_width * degree) scattered dataset
+rows per iteration; this measures the candidate implementations so the
+search-loop design is driven by data (round 4):
+
+  a) XLA jnp.take (the round-3 search path), f32 and bf16
+  b) Pallas kernel: per-block SMEM ids drive per-row double-buffered
+     HBM->VMEM DMAs (embedding-lookup pattern)
+
+Timing reduces the gathered block to one scalar and reads it back
+(block_until_ready has been observed returning early over the remote
+tunnel — see PERFORMANCE.md).
+"""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N, D = 1_000_000, 128
+M = 5_000 * 64          # rows gathered per search iteration (q * w * degree)
+
+
+def timeit(fn, *args, iters=20):
+    np.asarray(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    np.asarray(out)
+    return (time.perf_counter() - t0) / iters
+
+
+@jax.jit
+def xla_take(table, ids):
+    return jnp.sum(jnp.take(table, ids, axis=0).astype(jnp.float32))
+
+
+# ------------------------------------------------------- Pallas DMA gather
+def _gather_kernel(ids_ref, table_ref, out_ref, scratch, sems, *, rows):
+    def issue(i, slot):
+        row = ids_ref[i]
+        return pltpu.make_async_copy(
+            table_ref.at[pl.ds(row, 1)], scratch.at[pl.ds(slot, 1)],
+            sems.at[slot])
+
+    # double-buffered row DMAs: issue row i+1 while waiting on row i
+    issue(0, 0).start()
+
+    def body(i, _):
+        slot = jax.lax.rem(i, 2)
+        nxt = 1 - slot
+
+        @pl.when(i + 1 < rows)
+        def _():
+            issue(i + 1, nxt).start()
+
+        issue(i, slot).wait()
+        out_ref[pl.ds(i, 1)] = scratch[pl.ds(slot, 1)]
+        return 0
+
+    jax.lax.fori_loop(0, rows, body, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("rows",))
+def pallas_gather(table, ids, rows=512):
+    m = ids.shape[0]
+    grid = m // rows
+    return pl.pallas_call(
+        functools.partial(_gather_kernel, rows=rows),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((rows,), lambda b: (b,), memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((rows, table.shape[1]), lambda b: (b, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, table.shape[1]), table.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        out_shape=jax.ShapeDtypeStruct((m, table.shape[1]), table.dtype),
+    )(ids, table)
+
+
+@functools.partial(jax.jit, static_argnames=("rows",))
+def pallas_gather_sum(table, ids, rows=512):
+    return jnp.sum(pallas_gather(table, ids, rows).astype(jnp.float32))
+
+
+def main():
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(rng.normal(size=(N, D)).astype(np.float32))
+    table_bf16 = table.astype(jnp.bfloat16)
+    ids = jnp.asarray(rng.integers(0, N, size=M).astype(np.int32))
+
+    bytes_f32 = M * D * 4
+    bytes_bf16 = M * D * 2
+
+    t = timeit(xla_take, table, ids)
+    print(f"xla_take f32 : {t*1e3:7.2f} ms  {bytes_f32/t/1e9:7.1f} GB/s")
+    t = timeit(xla_take, table_bf16, ids)
+    print(f"xla_take bf16: {t*1e3:7.2f} ms  {bytes_bf16/t/1e9:7.1f} GB/s")
+    for rows in (1024, 2048):
+        try:
+            t = timeit(pallas_gather_sum, table, ids, rows)
+            print(f"pallas f32 rows={rows:5d}: {t*1e3:7.2f} ms  "
+                  f"{bytes_f32/t/1e9:7.1f} GB/s")
+            t = timeit(pallas_gather_sum, table_bf16, ids, rows)
+            print(f"pallas bf16 rows={rows:5d}: {t*1e3:7.2f} ms  "
+                  f"{bytes_bf16/t/1e9:7.1f} GB/s")
+        except Exception as e:  # noqa: BLE001 - report and continue
+            print(f"pallas rows={rows} failed: {type(e).__name__}: "
+                  f"{str(e)[:200]}")
+    # correctness spot check
+    a = float(xla_take(table, ids[:4096]))
+    b = float(pallas_gather_sum(table, ids[:4096], 1024))
+    print("match:", np.isclose(a, b, rtol=1e-6))
+
+
+if __name__ == "__main__":
+    main()

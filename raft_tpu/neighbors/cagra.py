@@ -23,11 +23,26 @@ TPU design (SURVEY.md §7 flags this as the XLA-hostile one):
   leftover slots take the next-best pruned-out forward edges;
 - **search** replaces the data-dependent walk + hashmap with a
   fixed-iteration ``lax.while_loop`` over a static (q, itopk) candidate
-  buffer: each step expands the best unvisited candidates' adjacency rows
-  (one gather + one MXU distance block), suppresses duplicates by masked
-  membership test against the buffer (the visited-hashmap analogue), and
-  re-selects top-itopk.  Termination: all buffered candidates visited, or
-  max_iterations.
+  buffer: each step expands the best unvisited candidates' adjacency rows,
+  suppresses duplicates by masked membership test against the buffer (the
+  visited-hashmap analogue), and re-selects top-itopk.  Termination: all
+  buffered candidates visited, or max_iterations.
+
+Round-4 search redesign (measured, profiles/gather_bench.py): scattered
+row gathers on TPU are **per-row latency-bound** (~18 ns/row whether the
+row is 128 B or 1 KB; bf16 rows are *slower* than f32), so the round-3
+loop — one dataset-row gather per candidate, 64+ rows per expanded node
+— was gather-bound at ~5 ms/iteration.  The walk now fetches ONE fat row
+per expanded node from a packed **neighborhood table**: all ``degree``
+neighbors' PCA-projected vectors (bf16) + full-precision norms and ids
+(f32/int32 bitcast into bf16 lanes) in a single (degree, pdim+4) row.
+Distances along the walk are approximate (exact norms, PCA cross term);
+the final buffer is re-ranked with exact distances in one dense pass.
+Entry points come from a dense (q, S) matmul against a fixed random
+entry set — no scattered seed gather at all.  The reference's hashmap +
+bitonic-buffer kernels (detail/cagra/search_single_cta.cuh) solve a
+SIMT problem; on TPU the costs invert: membership masks and top-k are
+cheap vector ops, scattered fetches are the scarce resource.
 """
 
 from __future__ import annotations
@@ -69,13 +84,33 @@ class IndexParams:
 @dataclasses.dataclass
 class SearchParams:
     """Reference: cagra_types.hpp:55 ``search_params`` (itopk_size,
-    search_width, max_iterations)."""
+    search_width, max_iterations).
+
+    TPU additions (see module docstring, round-4 search redesign):
+
+    - ``walk_pdim``: PCA dimension of the packed neighborhood table the
+      greedy walk reads (0 disables it — the walk then gathers full
+      dataset rows per candidate, exact but gather-bound);
+    - ``entry_points``: size of the fixed random entry set scored
+      densely to seed the buffer (the ``num_random_samplings``
+      analogue);
+    - ``rerank_topk``: how many of the final buffer entries get exact
+      re-ranked distances (0 -> auto: ``max(32, 2k)``).
+    """
 
     max_iterations: int = 0       # 0 -> auto
     itopk_size: int = 64
     search_width: int = 1
     num_random_samplings: int = 1
     rand_xor_mask: int = 0x128394
+    # None -> auto: the smallest PCA dim capturing >= _WALK_ENERGY of the
+    # data's second-moment spectrum (lossless-in-practice on manifold
+    # data, and automatically large — or a full fallback to the exact
+    # walk — on flat-spectrum data where a small projection would
+    # collapse recall).  0 -> exact walk; >0 -> forced projection dim.
+    walk_pdim: Optional[int] = None
+    entry_points: int = 4096
+    rerank_topk: int = 0
 
 
 @jax.tree_util.register_pytree_node_class
@@ -269,7 +304,332 @@ def build(res, params: IndexParams, dataset) -> Index:
 
 
 # ---------------------------------------------------------------------------
-# search
+# search — packed-neighborhood walk (round-4 design, see module docstring)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class _WalkCache:
+    """Derived search-time state (lazily attached to the Index).
+
+    ``table`` (n, degree, pdim+4) bf16 — per node, each neighbor's
+    PCA-projected vector (pdim bf16 lanes), full-precision squared norm
+    (f32 bitcast into 2 bf16 lanes) and id (int32 bitcast into 2 bf16
+    lanes): the whole neighborhood in ONE scattered row fetch.
+    ``proj`` (dim, pdim) f32; ``entry_*`` the fixed random entry set
+    scored densely at search time.
+    """
+
+    table: jax.Array
+    proj: jax.Array
+    entry_proj: jax.Array      # (S, pdim) bf16
+    entry_sq: jax.Array        # (S,) f32
+    entry_ids: jax.Array       # (S,) int32
+
+
+@jax.jit
+def _second_moment(dataset):
+    xf = dataset.astype(jnp.float32)
+    n = xf.shape[0]
+    m = min(n, 32768)
+    # strided, not leading, sample: on-disk datasets are often grouped
+    # by cluster and the first rows would bias the subspace estimate
+    sub = xf[::max(n // m, 1)][:m]
+    m = sub.shape[0]
+    return jax.lax.dot_general(sub, sub, (((0,), (0,)), ((), ())),
+                               precision=get_matmul_precision(),
+                               preferred_element_type=jnp.float32) / m
+
+
+# the auto walk projection must preserve NN ordering at this top-k
+# overlap on a calibration sample (spectral ENERGY is the wrong
+# criterion: on clustered data the variance concentrates in the few
+# center directions while the ordering among a node's neighbors lives
+# in the isotropic residual dims — measured recall collapse, r4)
+_WALK_FIDELITY = 0.9
+_WALK_CALIB_ROWS = 1024
+_WALK_CALIB_K = 10
+
+
+@functools.partial(jax.jit, static_argnames=("pdim", "k", "ip_metric"))
+def _calib_overlap(sample, vecs, pdim, k, ip_metric=False):
+    """Top-k overlap (self excluded) between exact and pdim-projected
+    distances on the calibration sample — scored under the index's own
+    metric (an IP walk ranks purely by the projected cross term; gating
+    it on L2 overlap would let the exact-norm term mask cross-term
+    error)."""
+    m, dim = sample.shape
+    ip = jax.lax.dot_general(sample, sample, (((1,), (1,)), ((), ())),
+                             precision=get_matmul_precision(),
+                             preferred_element_type=jnp.float32)
+    proj = vecs[:, dim - pdim:]
+    sp = (sample @ proj).astype(jnp.bfloat16)
+    ipa = jax.lax.dot_general(sp, sp, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    if ip_metric:
+        d_exact, d_apx = -ip, -ipa
+    else:
+        x_sq = jnp.sum(sample * sample, axis=1)
+        d_exact = x_sq[:, None] + x_sq[None, :] - 2.0 * ip
+        d_apx = x_sq[:, None] + x_sq[None, :] - 2.0 * ipa
+    eye = jnp.eye(m, dtype=jnp.bool_)
+    d_exact = jnp.where(eye, jnp.inf, d_exact)
+    d_apx = jnp.where(eye, jnp.inf, d_apx)
+    _, ie = jax.lax.top_k(-d_exact, k)
+    _, ia = jax.lax.top_k(-d_apx, k)
+    hits = jnp.any(ie[:, :, None] == ia[:, None, :], axis=-1)
+    return jnp.mean(hits.astype(jnp.float32))
+
+
+def _auto_pdim(index: Index) -> int:
+    """Smallest multiple-of-8 PCA dim whose projected distances keep
+    >= _WALK_FIDELITY top-k overlap with exact distances on a sample
+    (cached on the index; a few tiny host syncs, once per index)."""
+    cached = getattr(index, "_walk_auto_pdim", None)
+    if cached is None:
+        dim = index.dim
+        n = index.size
+        m = min(n, _WALK_CALIB_ROWS)
+        # strided sample (see _second_moment: leading rows bias
+        # cluster-grouped datasets)
+        sample = index.dataset[::max(n // m, 1)][:m].astype(jnp.float32)
+        ip_metric = index.metric == DistanceType.InnerProduct
+        _, vecs = jnp.linalg.eigh(_second_moment(index.dataset))
+        p = 8
+        cached = 0
+        while p < dim:
+            ov = float(_calib_overlap(sample, vecs, p, _WALK_CALIB_K,
+                                      ip_metric))
+            if ov >= _WALK_FIDELITY:
+                cached = p
+                break
+            p *= 2
+        if cached == 0:
+            # full-dim projection = rotation only, but the packed table
+            # is bf16 — if even that loses the ordering (tight clusters
+            # with |x| >> NN gaps), 0 routes to the exact direct walk
+            ov = float(_calib_overlap(sample, vecs, dim, _WALK_CALIB_K,
+                                      ip_metric))
+            cached = dim if ov >= _WALK_FIDELITY else 0
+        object.__setattr__(index, "_walk_auto_pdim", cached)
+    return cached
+
+
+@functools.partial(jax.jit, static_argnames=("pdim",))
+def _build_walk_table(dataset, graph, pdim):
+    n, dim = dataset.shape
+    xf = dataset.astype(jnp.float32)
+    if pdim < dim:
+        # uncentered PCA (top singular subspace of the second moment):
+        # the walk approximates the CROSS TERM <q, x> by <q P, x P>, so
+        # the right subspace is the one capturing raw inner products,
+        # not the mean-centered covariance's
+        _, vecs = jnp.linalg.eigh(_second_moment(dataset))  # ascending
+        proj = vecs[:, dim - pdim:]                # (dim, pdim)
+    else:
+        proj = jnp.eye(dim, dtype=jnp.float32)
+    xp = (xf @ proj).astype(jnp.bfloat16)          # (n, pdim)
+    x_sq = jnp.sum(xf * xf, axis=1)                # (n,) f32
+
+    nb = graph.astype(jnp.int32)                   # (n, deg), all >= 0
+    nb_p = xp[nb]                                  # (n, deg, pdim) bf16
+    sq2 = jax.lax.bitcast_convert_type(x_sq[nb], jnp.bfloat16)
+    id2 = jax.lax.bitcast_convert_type(nb, jnp.bfloat16)
+    table = jnp.concatenate([nb_p, sq2, id2], axis=2)
+    return table, proj
+
+
+@functools.partial(jax.jit, static_argnames=("n_entries",))
+def _build_entry_set(dataset, proj, key, n_entries):
+    n = dataset.shape[0]
+    entry_ids = jax.random.choice(key, n, (n_entries,),
+                                  replace=False).astype(jnp.int32)
+    rows = dataset[entry_ids].astype(jnp.float32)
+    return ((rows @ proj).astype(jnp.bfloat16),
+            jnp.sum(rows * rows, axis=1), entry_ids)
+
+
+def _walk_cache(res, index: Index, pdim: int, n_entries: int) -> _WalkCache:
+    """Get-or-build the packed neighborhood table (mutates the index —
+    the cache stays attached, same lazy pattern as ivf_flat's
+    ``list_data_sq``).  The big table is cached PER pdim; the small
+    entry set per (pdim, n_entries) — a second entry size must not
+    duplicate the multi-GB table."""
+    pdim = min(pdim, index.dim)
+    n_entries = min(n_entries, index.size)
+    tables = getattr(index, "_walk_tables", None)
+    if tables is None:
+        tables = {}
+        object.__setattr__(index, "_walk_tables", tables)
+        object.__setattr__(index, "_walk_entries", {})
+    if pdim not in tables:
+        tables[pdim] = _build_walk_table(index.dataset, index.graph, pdim)
+    table, proj = tables[pdim]
+    entries = index._walk_entries
+    ekey = (pdim, n_entries)
+    if ekey not in entries:
+        entries[ekey] = _build_entry_set(index.dataset, proj,
+                                         res.next_key(), n_entries)
+    eproj, esq, eids = entries[ekey]
+    return _WalkCache(table, proj, eproj, esq, eids)
+
+
+def _merge_candidates(buf_d, buf_i, visited, cand_d, cand_i, itopk,
+                      ip_metric, worst):
+    """Dedupe candidates against the buffer and themselves (membership
+    masks — the visited-hashmap analogue; O(wd·(itopk+wd)) cheap vector
+    compares instead of the round-3 double stable argsort), then ONE
+    top-k over the concatenation."""
+    nq, wd = cand_i.shape
+    dup_buf = jnp.any(cand_i[:, :, None] == buf_i[:, None, :], axis=-1)
+    earlier = jnp.tril(jnp.ones((wd, wd), jnp.bool_), k=-1)
+    dup_self = jnp.any((cand_i[:, :, None] == cand_i[:, None, :])
+                       & earlier[None], axis=-1)
+    keep = (cand_i >= 0) & ~dup_buf & ~dup_self
+    cand_d = jnp.where(keep, cand_d, worst)
+    cand_i = jnp.where(keep, cand_i, -1)
+
+    cat_d = jnp.concatenate([buf_d, cand_d], axis=1)
+    cat_i = jnp.concatenate([buf_i, cand_i], axis=1)
+    cat_v = jnp.concatenate(
+        [visited, jnp.zeros_like(keep)], axis=1)
+    if ip_metric:
+        new_d, pos = jax.lax.top_k(cat_d, itopk)
+    else:
+        new_d, pos = jax.lax.top_k(-cat_d, itopk)
+        new_d = -new_d
+    new_i = jnp.take_along_axis(cat_i, pos, axis=1)
+    new_v = jnp.take_along_axis(cat_v, pos, axis=1)
+    return new_d, new_i, new_v
+
+
+def _select_parents(buf_d, buf_i, visited, search_width, ip_metric, worst):
+    """Best ``search_width`` unvisited buffer entries; marks them
+    visited.  Returns (sel_ids, parent_ok, visited)."""
+    nq = buf_d.shape[0]
+    masked = jnp.where(visited | (buf_i < 0), worst, buf_d)
+    if ip_metric:
+        sel_d, sel = jax.lax.top_k(masked, search_width)
+    else:
+        sel_d, sel = jax.lax.top_k(-masked, search_width)
+        sel_d = -sel_d
+    parent_ok = jnp.logical_not(jnp.isinf(sel_d))
+    sel_ids = jnp.take_along_axis(buf_i, sel, axis=1)
+    visited = visited.at[jnp.arange(nq)[:, None], sel].set(True)
+    return sel_ids, parent_ok, visited
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "k", "itopk", "search_width", "max_iterations", "metric", "rerank"))
+def _search_impl_walk(dataset, table, entry_proj, entry_sq, entry_ids,
+                      proj, queries, k, itopk, search_width,
+                      max_iterations, metric, rerank):
+    """Greedy walk over the packed neighborhood table.
+
+    Walk distances are approximate (exact ||x||², PCA-projected bf16
+    cross term); the final ``rerank`` buffer entries are re-scored
+    exactly.  One scattered fat-row fetch per expanded node per
+    iteration — the gather-latency analysis that motivates this is in
+    the module docstring.
+    """
+    nq, dim = queries.shape
+    n = dataset.shape[0]
+    deg = table.shape[1]
+    pdim = table.shape[2] - 4
+    wd = search_width * deg
+    ip_metric = metric == DistanceType.InnerProduct
+    worst = -jnp.inf if ip_metric else jnp.inf
+
+    qf = queries.astype(jnp.float32)
+    q_sq = jnp.sum(qf * qf, axis=1)
+    qp = (qf @ proj).astype(jnp.bfloat16)            # (q, pdim)
+
+    # ---- dense entry scoring (no scattered seed gather) ------------------
+    ip_e = jax.lax.dot_general(qp, entry_proj, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+    if ip_metric:
+        d_e = ip_e
+    else:
+        d_e = q_sq[:, None] + entry_sq[None, :] - 2.0 * ip_e
+    S = d_e.shape[1]
+    ids_e = jnp.broadcast_to(entry_ids[None, :], (nq, S))
+    if S < itopk:
+        pad = itopk - S
+        d_e = jnp.concatenate(
+            [d_e, jnp.full((nq, pad), worst, jnp.float32)], axis=1)
+        ids_e = jnp.concatenate(
+            [ids_e, jnp.full((nq, pad), -1, jnp.int32)], axis=1)
+    if ip_metric:
+        buf_d, pos = jax.lax.top_k(d_e, itopk)
+    else:
+        buf_d, pos = jax.lax.top_k(-d_e, itopk)
+        buf_d = -buf_d
+    buf_i = jnp.take_along_axis(ids_e, pos, axis=1)
+    buf_i = jnp.where(jnp.isinf(buf_d), -1, buf_i)
+    visited = jnp.zeros((nq, itopk), jnp.bool_)
+
+    def cond(state):
+        _, _, visited, it = state
+        return jnp.logical_and(it < max_iterations,
+                               jnp.logical_not(jnp.all(visited)))
+
+    def body(state):
+        buf_d, buf_i, visited, it = state
+        sel_ids, parent_ok, visited = _select_parents(
+            buf_d, buf_i, visited, search_width, ip_metric, worst)
+
+        # ONE fat row per parent: the whole neighborhood (projected
+        # vectors + norms + ids) in a single scattered fetch
+        rows = table[jnp.where(parent_ok, sel_ids, 0)]  # (q, w, deg, u)
+        nb_p = rows[..., :pdim]
+        nb_sq = jax.lax.bitcast_convert_type(
+            rows[..., pdim:pdim + 2], jnp.float32)      # (q, w, deg)
+        nb_id = jax.lax.bitcast_convert_type(
+            rows[..., pdim + 2:pdim + 4], jnp.int32)
+        nb_id = jnp.where(parent_ok[:, :, None], nb_id, -1)
+
+        ipx = jnp.einsum("qp,qwdp->qwd", qp, nb_p,
+                         preferred_element_type=jnp.float32)
+        if ip_metric:
+            d_c = ipx
+        else:
+            d_c = q_sq[:, None, None] + nb_sq - 2.0 * ipx
+
+        buf_d, buf_i, visited = _merge_candidates(
+            buf_d, buf_i, visited, d_c.reshape(nq, wd),
+            nb_id.reshape(nq, wd), itopk, ip_metric, worst)
+        return buf_d, buf_i, visited, it + 1
+
+    buf_d, buf_i, visited, _ = jax.lax.while_loop(
+        cond, body, (buf_d, buf_i, visited, jnp.int32(0)))
+
+    # ---- exact re-rank of the best `rerank` buffer entries ---------------
+    if ip_metric:
+        _, pos = jax.lax.top_k(buf_d, rerank)
+    else:
+        _, pos = jax.lax.top_k(-buf_d, rerank)
+    r_ids = jnp.take_along_axis(buf_i, pos, axis=1)      # (q, R)
+    vecs = dataset[jnp.clip(r_ids, 0, n - 1)].astype(jnp.float32)
+    if ip_metric:
+        d_e = jnp.einsum("qd,qrd->qr", qf, vecs,
+                         preferred_element_type=jnp.float32)
+    else:
+        diff = qf[:, None, :] - vecs
+        d_e = jnp.sum(diff * diff, axis=-1)
+    d_e = jnp.where(r_ids >= 0, d_e, worst)
+
+    if ip_metric:
+        out_d, pos = jax.lax.top_k(d_e, k)
+    else:
+        out_d, pos = jax.lax.top_k(-d_e, k)
+        out_d = -out_d
+    out_i = jnp.take_along_axis(r_ids, pos, axis=1)
+    if metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded):
+        out_d = jnp.sqrt(jnp.maximum(out_d, 0.0))
+    return out_d, out_i
+
+
+# ---------------------------------------------------------------------------
+# search — direct exact walk (fallback: tracers, walk_pdim=0, huge tables)
 # ---------------------------------------------------------------------------
 
 @functools.partial(jax.jit, static_argnames=(
@@ -323,54 +683,19 @@ def _search_impl(dataset, graph, queries, seed_ids, k, itopk, search_width,
 
     def body(state):
         buf_d, buf_i, visited, it = state
-        # pick the search_width best unvisited candidates
-        masked = jnp.where(visited | (buf_i < 0), worst, buf_d)
-        if ip_metric:
-            _, sel = jax.lax.top_k(masked, search_width)
-        else:
-            _, sel = jax.lax.top_k(-masked, search_width)
-        sel_ids = jnp.take_along_axis(buf_i, sel, axis=1)  # (q, w)
-        visited = visited.at[jnp.arange(nq)[:, None], sel].set(True)
+        sel_ids, parent_ok, visited = _select_parents(
+            buf_d, buf_i, visited, search_width, ip_metric, worst)
 
         # expand adjacency of selected nodes
-        nbrs = graph[jnp.where(sel_ids >= 0, sel_ids, 0)]  # (q, w, degree)
+        nbrs = graph[jnp.where(parent_ok, sel_ids, 0)]     # (q, w, degree)
         nbrs = nbrs.reshape(nq, search_width * degree)
-        nbrs = jnp.where(jnp.repeat(sel_ids >= 0, degree, axis=1), nbrs, -1)
+        nbrs = jnp.where(jnp.repeat(parent_ok, degree, axis=1), nbrs, -1)
         nd = dists_to(jnp.where(nbrs >= 0, nbrs, 0))
         nd = jnp.where(nbrs < 0, worst, nd)
 
-        cat_d = jnp.concatenate([buf_d, nd], axis=1)
-        cat_i = jnp.concatenate([buf_i, nbrs], axis=1)
-        cat_v = jnp.concatenate(
-            [visited, jnp.zeros_like(nd, jnp.bool_)], axis=1)
-
-        # duplicate suppression (the hashmap visited-set analogue): the same
-        # node may appear in the buffer AND in several expansions; keep one
-        # copy per id — sort by distance (stable), then by id (stable): the
-        # first slot of each id-group is its best copy, and for equal
-        # distances the buffer copy (with its visited flag) wins.
-        sort_d = -cat_d if ip_metric else cat_d
-        ord_d = jnp.argsort(sort_d, axis=1, stable=True)
-        i1 = jnp.take_along_axis(cat_i, ord_d, axis=1)
-        d1 = jnp.take_along_axis(cat_d, ord_d, axis=1)
-        v1 = jnp.take_along_axis(cat_v, ord_d, axis=1)
-        ord_i = jnp.argsort(i1, axis=1, stable=True)
-        i2 = jnp.take_along_axis(i1, ord_i, axis=1)
-        d2 = jnp.take_along_axis(d1, ord_i, axis=1)
-        v2 = jnp.take_along_axis(v1, ord_i, axis=1)
-        dup = jnp.concatenate(
-            [jnp.zeros((nq, 1), jnp.bool_), i2[:, 1:] == i2[:, :-1]], axis=1)
-        d2 = jnp.where(dup, worst, d2)
-        i2 = jnp.where(dup, -1, i2)
-
-        if ip_metric:
-            new_d, pos = jax.lax.top_k(d2, itopk)
-        else:
-            new_d, pos = jax.lax.top_k(-d2, itopk)
-            new_d = -new_d
-        new_i = jnp.take_along_axis(i2, pos, axis=1)
-        new_v = jnp.take_along_axis(v2, pos, axis=1)
-        return new_d, new_i, new_v, it + 1
+        buf_d, buf_i, visited = _merge_candidates(
+            buf_d, buf_i, visited, nd, nbrs, itopk, ip_metric, worst)
+        return buf_d, buf_i, visited, it + 1
 
     buf_d, buf_i, visited, _ = jax.lax.while_loop(
         cond, body, (buf_d, buf_i, visited, jnp.int32(0)))
@@ -383,17 +708,49 @@ def _search_impl(dataset, graph, queries, seed_ids, k, itopk, search_width,
     return out_d, out_i
 
 
+# tables beyond this working-set size fall back to the direct exact walk
+_WALK_TABLE_MAX_BYTES = 6 << 30
+
+
 @auto_convert_output
 def search(res, params: SearchParams, index: Index, queries, k: int
            ) -> Tuple[jax.Array, jax.Array]:
-    """Greedy graph-walk search (reference: cagra.cuh:205)."""
+    """Greedy graph-walk search (reference: cagra.cuh:205).
+
+    .. note:: the first search builds and attaches the packed
+       neighborhood table (:class:`_WalkCache`) to the index in place —
+       a non-pytree attribute, so jitted closures over the index do not
+       retrace; pass ``walk_pdim=0`` to skip it.
+    """
     with named_range("cagra::search"):
         queries = ensure_array(queries, "queries")
         expects(queries.ndim == 2 and queries.shape[1] == index.dim,
                 "cagra.search: query dim mismatch")
         itopk = max(params.itopk_size, k)
-        # probe 4×itopk random nodes (min 128) and keep the best itopk —
-        # the reference's random-sampling buffer init scaled the same way
+        max_iter = params.max_iterations or (
+            10 + itopk // max(params.search_width, 1))
+
+        traced = (isinstance(queries, jax.core.Tracer)
+                  or isinstance(index.dataset, jax.core.Tracer))
+        pdim = 0
+        if params.walk_pdim != 0 and not traced:
+            pdim = min(params.walk_pdim or _auto_pdim(index), index.dim)
+        table_bytes = index.size * index.graph_degree * (pdim + 4) * 2
+        if pdim > 0 and table_bytes <= _WALK_TABLE_MAX_BYTES:
+            cache = _walk_cache(res, index, pdim,
+                                max(params.entry_points, itopk))
+            rerank = min(itopk,
+                         params.rerank_topk or max(32, 2 * k))
+            rerank = max(rerank, k)
+            return _search_impl_walk(
+                index.dataset, cache.table, cache.entry_proj,
+                cache.entry_sq, cache.entry_ids, cache.proj, queries, k,
+                itopk, params.search_width, max_iter, index.metric,
+                rerank)
+
+        # direct exact walk: probe 4×itopk random nodes (min 128) and
+        # keep the best itopk — the reference's random-sampling buffer
+        # init scaled the same way
         n_seeds = max(itopk,
                       min(index.size,
                           max(params.num_random_samplings * 4 * itopk, 128)))
@@ -401,8 +758,6 @@ def search(res, params: SearchParams, index: Index, queries, k: int
         seed_ids = jax.random.randint(
             key, (queries.shape[0], n_seeds), 0, index.size,
             dtype=jnp.int32)
-        max_iter = params.max_iterations or (
-            10 + itopk // max(params.search_width, 1))
         return _search_impl(index.dataset, index.graph, queries, seed_ids,
                             k, itopk, params.search_width, max_iter,
                             index.metric)

@@ -85,6 +85,34 @@ class TestCagra:
         # same index contents -> same search behavior modulo random seeds
         assert d1.shape == d2.shape
 
+    def test_default_params_on_flat_spectrum_data(self, res):
+        """Regression (r4 review): isotropic gaussian data has no small
+        PCA subspace — the auto walk_pdim must widen (or fall back to
+        the exact walk) instead of silently collapsing recall."""
+        rng = np.random.default_rng(0)
+        db = rng.normal(size=(3000, 64)).astype(np.float32)
+        q = rng.normal(size=(50, 64)).astype(np.float32)
+        params = cagra.IndexParams(intermediate_graph_degree=64,
+                                   graph_degree=32)
+        index = cagra.build(res, params, db)
+        assert cagra._auto_pdim(index) >= 48   # flat spectrum -> wide
+        d, i = cagra.search(res, cagra.SearchParams(), index, q, 10)
+        _, ti = naive_knn(db, q, 10)
+        assert recall(np.asarray(i), ti) > 0.85
+
+    def test_walk_table_cached_per_pdim(self, res, dataset, index):
+        """Two entry-set sizes must share ONE neighborhood table
+        (r4 review: the multi-GB table was keyed on (pdim, entries))."""
+        db, q = dataset
+        cagra.search(res, cagra.SearchParams(entry_points=256), index,
+                     q, 5)
+        n_tables = len(index._walk_tables)
+        n_entries = len(index._walk_entries)
+        cagra.search(res, cagra.SearchParams(entry_points=512), index,
+                     q, 5)
+        assert len(index._walk_tables) == n_tables     # table reused
+        assert len(index._walk_entries) == n_entries + 1
+
     def test_prune_reverse_edges(self, res, dataset):
         db, _ = dataset
         knn = cagra.build_knn_graph(res, db, 16)
