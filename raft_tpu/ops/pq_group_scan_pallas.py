@@ -42,6 +42,19 @@ from jax.experimental.pallas import tpu as pltpu
 
 from raft_tpu.neighbors.grouped import GROUP
 
+# extraction switches from unrolled static-lane passes to a fori_loop
+# with transposed scratch above this kt (see _extract_topk)
+_KT_UNROLL = 64
+_KT_MAX = 128
+
+
+def _scratch_shapes(kt):
+    if kt <= _KT_UNROLL:
+        shape = (GROUP, kt)
+    else:
+        shape = (-(-kt // 8) * 8, GROUP)
+    return [pltpu.VMEM(shape, jnp.float32), pltpu.VMEM(shape, jnp.int32)]
+
 
 def _gather_queries(slot_ref, q_ref, n_probes, P):
     """One-hot MXU row gather of the group's queries from the
@@ -81,26 +94,48 @@ def _extract_topk(d, ids_row, vals_ref, ids_out_ref, vscratch, pscratch,
 
     kt passes of max / where-iota argmin / mask over the (G, cap) block;
     the id map is a masked reduce against the list's id row per pass
-    (a single (G*kt, cap) one-hot matmul would cost ~5 MB of VMEM)."""
+    (a single (G*kt, cap) one-hot matmul would cost ~5 MB of VMEM).
+
+    kt <= _KT_UNROLL: unrolled passes writing static scratch lanes (the
+    proven hot path).  Larger kt (radix-select regime, k to 128+ —
+    reference select_radix.cuh): a ``fori_loop`` with dynamic SUBLANE
+    stores into (kt, G)-transposed scratch — dynamic stores on the lane
+    dim are Mosaic-hostile, on the sublane dim they are cheap — then one
+    in-VMEM transpose on the way out."""
     invalid = (ids_row < 0)[None, :]
     neg = jnp.where(invalid, -jnp.inf, -d)             # select-min as max
 
     cap = neg.shape[1]
     col = jax.lax.broadcasted_iota(jnp.int32, neg.shape, 1)
     ids_f = ids_row.astype(jnp.float32)                # exact below 2^24
-    for j in range(kt):
+
+    def step(neg):
         m = jnp.max(neg, axis=1)                       # (G,)
         # where-iota argmax (ties -> lowest column, stable like sort)
         p = jnp.min(jnp.where(neg == m[:, None], col, cap), axis=1)
         p = jnp.minimum(p, cap - 1)                    # all -inf row guard
-        vscratch[:, j] = -m
         sel = col == p[:, None]
         gid = jnp.max(jnp.where(sel, ids_f[None, :], -jnp.inf), axis=1)
-        pscratch[:, j] = gid.astype(jnp.int32)
-        neg = jnp.where(sel, -jnp.inf, neg)
+        return m, sel, gid
 
-    vals_ref[0] = vscratch[:, :]
-    ids_out_ref[0] = pscratch[:, :]
+    if kt <= _KT_UNROLL:
+        for j in range(kt):
+            m, sel, gid = step(neg)
+            vscratch[:, j] = -m
+            pscratch[:, j] = gid.astype(jnp.int32)
+            neg = jnp.where(sel, -jnp.inf, neg)
+        vals_ref[0] = vscratch[:, :]
+        ids_out_ref[0] = pscratch[:, :]
+    else:
+        def body(j, neg):
+            m, sel, gid = step(neg)
+            vscratch[pl.ds(j, 1), :] = (-m)[None, :]
+            pscratch[pl.ds(j, 1), :] = gid.astype(jnp.int32)[None, :]
+            return jnp.where(sel, -jnp.inf, neg)
+
+        jax.lax.fori_loop(0, kt, body, neg, unroll=False)
+        vals_ref[0] = vscratch[:kt, :].T
+        ids_out_ref[0] = pscratch[:kt, :].T
 
 
 def _kernel_flat(gl_ref, slot_ref, q_ref, data_ref, dsq_ref, ids_ref,
@@ -159,10 +194,7 @@ def grouped_l2_scan(group_list, slot_pairs, qrot, centers_f32, list_recon,
             pl.BlockSpec((1, GROUP, kt), lambda g, gl: (g, 0, 0)),
             pl.BlockSpec((1, GROUP, kt), lambda g, gl: (g, 0, 0)),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((GROUP, kt), jnp.float32),
-            pltpu.VMEM((GROUP, kt), jnp.int32),
-        ],
+        scratch_shapes=_scratch_shapes(kt),
     )
     vals, gids = pl.pallas_call(
         functools.partial(_kernel, kt=kt, n_probes=n_probes, P=P),
@@ -208,10 +240,7 @@ def grouped_flat_l2_scan(group_list, slot_pairs, queries_f32, list_data,
             pl.BlockSpec((1, GROUP, kt), lambda g, gl: (g, 0, 0)),
             pl.BlockSpec((1, GROUP, kt), lambda g, gl: (g, 0, 0)),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((GROUP, kt), jnp.float32),
-            pltpu.VMEM((GROUP, kt), jnp.int32),
-        ],
+        scratch_shapes=_scratch_shapes(kt),
     )
     vals, gids = pl.pallas_call(
         functools.partial(_kernel_flat, kt=kt, n_probes=n_probes, P=P),
@@ -228,19 +257,24 @@ def grouped_flat_l2_scan(group_list, slot_pairs, queries_f32, list_data,
 
 
 def supported(metric_is_l2: bool, cap: int, rot: int, kt: int,
-              n_total: int, nq: int, data_elem_bytes: int = 2) -> bool:
+              nq: int, data_elem_bytes: int = 2) -> bool:
     """Shapes the kernel handles; callers fall back to the XLA scan
     otherwise.  Lane dims must be 128-aligned (rot) or tile-aligned
-    (cap); candidate ids must be f32-exact for the one-hot id
-    contraction; kt is bounded to keep the extraction loop sane; the
+    (cap); kt is bounded to keep the extraction loop sane; the
     query table, its per-program one-hot, the per-list data block, and
     the (GROUP, cap) distance block all live in VMEM, so their summed
     footprint is bounded (the one-hot gather cost also grows with nq —
-    larger batches should be split by the caller anyway)."""
+    larger batches should be split by the caller anyway).
+
+    Candidate-id f32-exactness (|id| < 2^24, required by the one-hot id
+    contraction) is data-dependent and checked by the caller on the
+    index's actual ids (:func:`raft_tpu.neighbors.grouped.ids_f32_exact`)
+    — user-supplied ids from ``extend(new_indices=...)`` can exceed any
+    row-count proxy."""
     nq_pad = -(-(nq + 1) // 128) * 128
     vmem = (2 * nq_pad * rot * 4              # query table + one-hot
             + cap * rot * data_elem_bytes     # per-list data block
             + 2 * GROUP * cap * 4)            # distances + extraction temps
     return (metric_is_l2 and rot % 128 == 0 and cap % 16 == 0
-            and GROUP % 16 == 0 and 0 < kt <= 64 and n_total < (1 << 24)
+            and GROUP % 16 == 0 and 0 < kt <= _KT_MAX
             and nq <= 6144 and vmem <= (10 << 20))

@@ -151,6 +151,31 @@ class TestIvfFlat:
                            for a, b in zip(np.asarray(i1), np.asarray(i2))])
         assert overlap > 0.99
 
+    def test_pallas_scan_large_k(self, res):
+        """k=100 exercises the fori_loop extraction variant (kt > 64 —
+        the radix-select regime, reference select_radix.cuh); must match
+        the XLA grouped scan."""
+        from raft_tpu.neighbors import grouped
+        rng = np.random.default_rng(5)
+        db = rng.normal(size=(4000, 128)).astype(np.float32)
+        q = rng.normal(size=(16, 128)).astype(np.float32)
+        params = ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=5)
+        index = ivf_flat.build(res, params, db)
+        probes = ivf_flat._select_clusters(index.centers, jnp.asarray(q),
+                                           4, index.metric)
+        n_groups = grouped.round_groups(
+            int(grouped.num_groups(probes, index.n_lists)))
+        args = (index.centers, index.list_data, index.list_indices,
+                jnp.asarray(q), probes, 100, index.metric, n_groups, 16)
+        d1, i1 = ivf_flat._search_impl_grouped(*args)
+        d2, i2 = ivf_flat._search_impl_grouped(
+            *args, use_pallas=True, pallas_interpret=True)
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2),
+                                   rtol=1e-4, atol=1e-3)
+        overlap = np.mean([len(set(a) & set(b)) / 100
+                           for a, b in zip(np.asarray(i1), np.asarray(i2))])
+        assert overlap > 0.99
+
     def test_group_cache_overflow_redispatch(self, res, dataset):
         """A later batch whose probe distribution needs more groups than
         the cached count must still return exact results (the dispatch
