@@ -199,12 +199,18 @@ def init_random(res, X: jax.Array, n_clusters: int,
 # ---------------------------------------------------------------------------
 
 @functools.partial(jax.jit, static_argnames=("n_clusters", "max_iter",
-                                             "metric"))
-def _lloyd(X, centroids0, sample_weight, tol, n_clusters, max_iter, metric):
+                                             "metric", "use_fused"))
+def _lloyd(X, centroids0, sample_weight, tol, n_clusters, max_iter, metric,
+           use_fused=False):
     """Jitted Lloyd loop (reference: detail/kmeans.cuh:359 kmeans_fit_main).
 
     Converges on centroid shift: sum ||c_new - c_old||^2 < tol (the reference
     checks sqrdNorm of the centroid delta against tol each iteration).
+
+    ``use_fused`` (TPU, L2 metrics): one Pallas pass per iteration fuses
+    assignment and the weighted per-cluster sums — labels and distances
+    never leave VMEM (:mod:`raft_tpu.ops.kmeans_update_pallas`; the
+    round-3 loop was segment-sum/epilogue-bound, PERFORMANCE.md).
     """
 
     def cond(carry):
@@ -213,10 +219,18 @@ def _lloyd(X, centroids0, sample_weight, tol, n_clusters, max_iter, metric):
 
     def body(carry):
         centroids, it, _ = carry
-        labels, _ = min_cluster_and_distance(X, centroids, metric=metric)
-        new_c, _ = update_centroids(X, labels, n_clusters,
-                                    sample_weight=sample_weight,
-                                    old_centroids=centroids)
+        if use_fused:
+            from raft_tpu.ops.kmeans_update_pallas import fused_assign_update
+
+            sums, counts = fused_assign_update(X, sample_weight, centroids)
+            means = sums / jnp.maximum(counts, 1.0)[:, None]
+            new_c = jnp.where((counts > 0)[:, None], means,
+                              centroids.astype(jnp.float32)).astype(X.dtype)
+        else:
+            labels, _ = min_cluster_and_distance(X, centroids, metric=metric)
+            new_c, _ = update_centroids(X, labels, n_clusters,
+                                        sample_weight=sample_weight,
+                                        old_centroids=centroids)
         shift = jnp.sum((new_c.astype(jnp.float32)
                          - centroids.astype(jnp.float32)) ** 2)
         return new_c, it + 1, shift
@@ -251,6 +265,19 @@ def fit(
         w = (jnp.ones(X.shape[0], jnp.float32) if sample_weight is None
              else jnp.asarray(sample_weight, jnp.float32))
 
+        from raft_tpu.ops import kmeans_update_pallas as kup
+
+        l2_metrics = (DistanceType.L2Expanded, DistanceType.L2Unexpanded,
+                      DistanceType.L2SqrtExpanded,
+                      DistanceType.L2SqrtUnexpanded)
+        # sqrt variants share the fused path: sqrt is monotone, so the
+        # in-kernel argmin is identical; inertia is computed after the
+        # loop with the caller's metric either way
+        use_fused = (jax.default_backend() == "tpu"
+                     and params.metric in l2_metrics
+                     and kup.supported(X.shape[0], X.shape[1],
+                                       params.n_clusters, True))
+
         best = None
         # Array init is deterministic — restarts would be bit-identical.
         n_init = 1 if params.init == InitMethod.Array else max(1, params.n_init)
@@ -266,7 +293,7 @@ def fit(
                 c0 = init_plus_plus(res, X, params.n_clusters, key=key)
             c, inertia, n_iter, _ = _lloyd(
                 X, c0, w, jnp.float32(params.tol), params.n_clusters,
-                params.max_iter, params.metric)
+                params.max_iter, params.metric, use_fused=use_fused)
             if best is None or float(inertia) < float(best[1]):
                 best = (c, inertia, n_iter)
         return best

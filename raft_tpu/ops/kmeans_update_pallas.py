@@ -1,0 +1,135 @@
+"""Pallas TPU kernel: fused k-means assignment + centroid-update pass.
+
+The ``fusedL2NN`` + ``update_centroids`` analogue (reference:
+distance/fused_l2_nn.cuh:100 feeding cluster/detail/kmeans.cuh:432): one
+pass over the data per Lloyd iteration that
+  1. computes the (tile, K) distance block on the MXU
+     (``argmin ||x-c||^2 = argmin (||c||^2 - 2 x.c)`` — the per-row
+     ``||x||^2`` term cannot change the argmin and is never computed),
+  2. takes the per-row argmin (VPU reduce),
+  3. expands the labels to a one-hot block and accumulates the
+     **weighted per-cluster sums as a second MXU matmul**
+     (``onehot^T @ (w * x)``) into a VMEM-resident (K, dim) accumulator,
+     plus per-cluster counts as a VPU column reduce.
+
+The round-3 XLA Lloyd loop was epilogue-bound: ``segment_sum`` lowers to
+a serialized HBM scatter-add and the labels round-trip through HBM.
+Here neither labels nor distances ever leave VMEM; the epilogue rides
+the MXU next to the distance matmul (PERFORMANCE.md round-4 notes).
+
+Padding contract (callers: :func:`fused_assign_update`):
+- rows are padded to the tile size with **zero weights** — padded rows
+  contribute nothing to sums/counts;
+- K is padded to a lane multiple with ``c_sq = +inf`` sentinels — the
+  argmin never selects a padded cluster;
+- dim is padded with zero columns on both x and centroids — distances
+  and sums are unchanged; callers slice the result.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(x_ref, w_ref, c_ref, csq_ref, sums_ref, counts_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        sums_ref[...] = jnp.zeros_like(sums_ref)
+        counts_ref[...] = jnp.zeros_like(counts_ref)
+
+    x = x_ref[...]                                   # (T, dim) bf16
+    ip = jax.lax.dot_general(x, c_ref[...], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    d = csq_ref[...] - 2.0 * ip                      # (T, K) f32
+    labels = jnp.argmin(d, axis=1)                   # (T,)
+
+    k_pad = d.shape[1]
+    cols = jax.lax.broadcasted_iota(jnp.int32, d.shape, 1)
+    onehot = (cols == labels[:, None]).astype(jnp.float32)
+    w = w_ref[...].reshape(-1)                       # (T,) f32
+    onehot_w = onehot * w[:, None]
+
+    # weighted sums: (K, dim) += onehot_w^T @ x  (MXU, f32 accumulate)
+    sums_ref[...] += jax.lax.dot_general(
+        onehot_w.astype(jnp.bfloat16), x, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    counts_ref[...] += jnp.sum(onehot_w, axis=0, keepdims=True)
+
+
+def _round_up(v, m):
+    return -(-v // m) * m
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def fused_assign_update(x, weights, centroids, tile=1024, interpret=False):
+    """One fused assignment+update pass.
+
+    ``x`` (n, dim); ``weights`` (n,) f32; ``centroids`` (k, dim).
+    Returns ``(sums (k, dim) f32, counts (k,) f32)`` — the weighted
+    per-cluster sums and total weights; callers derive the means and
+    keep old centroids for empty clusters (update_centroids contract,
+    reference detail/kmeans.cuh:285).
+
+    bf16 MXU passes with f32 accumulation: the one-hot factor is exact
+    in bf16; x is rounded once (~1e-3 relative) — within Lloyd's
+    self-correcting tolerance (see test_kmeans_fused_matches_xla).
+    """
+    n, dim = x.shape
+    k = centroids.shape[0]
+    n_pad = _round_up(n, tile)
+    k_pad = _round_up(k, 128)
+    d_pad = _round_up(dim, 128)
+
+    cf = centroids.astype(jnp.float32)
+    c_sq = jnp.sum(cf * cf, axis=1)
+    csq_p = jnp.full((1, k_pad), jnp.inf, jnp.float32).at[0, :k].set(c_sq)
+    c_p = jnp.zeros((k_pad, d_pad), jnp.bfloat16)
+    c_p = c_p.at[:k, :dim].set(cf.astype(jnp.bfloat16))
+    x_p = jnp.zeros((n_pad, d_pad), jnp.bfloat16)
+    x_p = x_p.at[:n, :dim].set(x.astype(jnp.bfloat16))
+    w_p = jnp.zeros((n_pad, 1), jnp.float32)
+    w_p = w_p.at[:n, 0].set(weights.astype(jnp.float32))
+
+    sums, counts = pl.pallas_call(
+        _kernel,
+        grid=(n_pad // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, d_pad), lambda i: (i, 0)),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+            pl.BlockSpec((k_pad, d_pad), lambda i: (0, 0)),
+            pl.BlockSpec((1, k_pad), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((k_pad, d_pad), lambda i: (0, 0)),
+            pl.BlockSpec((1, k_pad), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((k_pad, d_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, k_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x_p, w_p, c_p, csq_p)
+    return sums[:k, :dim], counts[0, :k]
+
+
+def supported(n: int, dim: int, k: int, metric_is_l2: bool,
+              tile: int = 1024) -> bool:
+    """Shapes the kernel handles; callers fall back to the XLA path
+    otherwise.  VMEM: x tile + distance block + one-hot + accumulator +
+    centroids must fit."""
+    k_pad = _round_up(k, 128)
+    d_pad = _round_up(dim, 128)
+    vmem = (tile * d_pad * 2            # x tile bf16
+            + 2 * tile * k_pad * 4      # distances + one-hot
+            + k_pad * d_pad * 2         # centroids bf16
+            + k_pad * d_pad * 4         # sums accumulator
+            + 2 * k_pad * 4)
+    return (metric_is_l2 and n >= tile and vmem <= (12 << 20)
+            and k_pad * d_pad * 4 <= (4 << 20))

@@ -43,6 +43,90 @@ class TestFusedL2NNPallas:
                                    rtol=1e-4, atol=1e-4)
         np.testing.assert_array_equal(np.asarray(i_x), np.asarray(i_p))
 
+    def test_kmeans_fused_assign_update_matches_reference(self):
+        """Fused assignment+update pass (interpret mode) vs the plain
+        argmin + segment-sum formulation, including row/cluster/dim
+        padding and zero-weight rows."""
+        import jax.numpy as jnp
+
+        from raft_tpu.ops.kmeans_update_pallas import fused_assign_update
+
+        rng = np.random.default_rng(7)
+        n, dim, k = 300, 50, 37
+        x = rng.normal(size=(n, dim)).astype(np.float32)
+        w = rng.random(n).astype(np.float32)
+        w[::11] = 0.0
+        c = rng.normal(size=(k, dim)).astype(np.float32)
+
+        sums, counts = fused_assign_update(
+            jnp.asarray(x), jnp.asarray(w), jnp.asarray(c), tile=128,
+            interpret=True)
+
+        d = ((x[:, None, :] - c[None, :, :]) ** 2).sum(-1)
+        labels = d.argmin(1)
+        ref_sums = np.zeros((k, dim), np.float32)
+        ref_counts = np.zeros(k, np.float32)
+        np.add.at(ref_sums, labels, x * w[:, None])
+        np.add.at(ref_counts, labels, w)
+        # bf16 MXU passes: ~1e-3 relative on sums
+        np.testing.assert_allclose(np.asarray(sums), ref_sums,
+                                   rtol=2e-2, atol=2e-2)
+        np.testing.assert_allclose(np.asarray(counts), ref_counts,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_kmeans_fused_lloyd_matches_xla_lloyd(self):
+        """Fused Lloyd vs the XLA path: bit-equal first step on
+        bf16-representable inputs, and equal clustering quality
+        (inertia) after a full run — trajectories may legitimately
+        diverge on boundary points once centroids stop being
+        bf16-representable (means), so element-wise centroid equality
+        at iteration 20 is NOT the contract."""
+        import jax.numpy as jnp
+
+        from raft_tpu.cluster.kmeans import _lloyd
+        from raft_tpu.ops.kmeans_update_pallas import fused_assign_update
+
+        rng = np.random.default_rng(3)
+        n, dim, k = 512, 32, 8
+        centers = rng.normal(size=(k, dim)).astype(np.float32) * 8
+        x = (centers[rng.integers(0, k, n)]
+             + rng.normal(size=(n, dim)).astype(np.float32))
+        # bf16-representable inputs: the kernel's bf16 rounding of x and
+        # c0 is then the identity, so step 1 must agree exactly
+        x = np.asarray(jnp.asarray(x).astype(jnp.bfloat16).astype(
+            jnp.float32))
+        c0 = x[:k].copy()
+        w = np.ones(n, np.float32)
+
+        args = (jnp.asarray(x), jnp.asarray(c0), jnp.asarray(w),
+                jnp.float32(1e-6), k, 20, 1)        # L2Expanded
+        c_ref, _, _, _ = _lloyd(*args, use_fused=False)
+
+        c_cur = jnp.asarray(c0)
+        for it in range(20):
+            sums, counts = fused_assign_update(
+                jnp.asarray(x), jnp.asarray(w), c_cur, tile=128,
+                interpret=True)
+            means = sums / jnp.maximum(counts, 1.0)[:, None]
+            c_cur = jnp.where((counts > 0)[:, None], means, c_cur)
+            if it == 0:
+                from raft_tpu.cluster.kmeans import (
+                    min_cluster_and_distance, update_centroids)
+                lab, _ = min_cluster_and_distance(jnp.asarray(x),
+                                                  jnp.asarray(c0), metric=1)
+                c1, _ = update_centroids(jnp.asarray(x), lab, k,
+                                         sample_weight=jnp.asarray(w),
+                                         old_centroids=jnp.asarray(c0))
+                np.testing.assert_allclose(np.asarray(c_cur),
+                                           np.asarray(c1),
+                                           rtol=1e-5, atol=1e-5)
+
+        # clustering quality must match: same inertia within bf16 noise
+        d_ref = ((x[:, None, :] - np.asarray(c_ref)[None]) ** 2).sum(-1)
+        d_fus = ((x[:, None, :] - np.asarray(c_cur)[None]) ** 2).sum(-1)
+        np.testing.assert_allclose(d_fus.min(1).sum(), d_ref.min(1).sum(),
+                                   rtol=1e-2)
+
     def test_precision_policy_not_stale(self):
         """Regression: the precision policy keys the jit cache — a call
         under a changed matmul_precision() must not reuse a stale trace."""
