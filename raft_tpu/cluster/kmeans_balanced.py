@@ -25,6 +25,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from raft_tpu.cluster.kmeans_types import KMeansBalancedParams
 from raft_tpu.core.error import expects
@@ -111,6 +112,92 @@ def _balanced_loop(X, centroids0, key, n_clusters, n_iters, metric):
     return centroids, labels
 
 
+# Above this cluster count fit() switches to the two-level mesocluster
+# build (reference: detail/kmeans_balanced.cuh build_hierarchical — the
+# mesocluster split/balance loop that makes n_lists=16384+ tractable).
+_MESO_THRESHOLD = 8192
+
+
+@functools.partial(jax.jit, static_argnames=("n_meso", "per"))
+def _meso_partition_sample(meso_labels, key, n_meso, per):
+    """Fixed-size member samples per mesocluster WITHOUT an
+    (n_meso, n) membership matrix: one argsort groups rows into
+    contiguous label segments; each mesocluster takes ``per`` rows from
+    its segment, cycling when it has fewer members.  Returns
+    (n_meso, per) row indices."""
+    n = meso_labels.shape[0]
+    order = jnp.argsort(meso_labels)
+    sorted_lab = meso_labels[order]
+    starts = jnp.searchsorted(sorted_lab, jnp.arange(n_meso))
+    ends = jnp.searchsorted(sorted_lab, jnp.arange(n_meso),
+                            side="right")
+    counts = jnp.maximum(ends - starts, 1)
+    # random offsets decorrelate which members are sampled run-to-run
+    off = jax.random.randint(key, (n_meso,), 0, n)
+    j = (jnp.arange(per)[None, :] + off[:, None]) % counts[:, None]
+    return order[jnp.clip(starts[:, None] + j, 0, n - 1)]
+
+
+def _fit_hierarchical(xf, n_clusters, key, n_iters, metric):
+    """Two-level balanced build (the build_hierarchical analogue).
+
+    1. ~sqrt(K) mesoclusters via the standard balanced loop (full data
+       — the (n, n_meso) assignment is cheap);
+    2. per-mesocluster fine clusters trained on fixed-size member
+       samples, ``vmap``-ed across mesoclusters (static shapes: ragged
+       member lists are sampled-with-cycling, not materialized);
+    3. a short full-K balanced refinement from the stacked fine
+       centers (the reference's fine-tuning passes), which also
+       re-seeds any cluster left under-populated by the hierarchy.
+
+    Per-iteration assignment cost falls from O(n*K) to
+    O(n*sqrt(K)) + O(per*K) — the difference between minutes and
+    seconds at K=16384, n=1M.
+    """
+    n, dim = xf.shape
+    n_meso = max(2, min(int(round(float(np.sqrt(n_clusters)))),
+                        n_clusters // 2))
+    k_base = n_clusters // n_meso
+    rem = n_clusters % n_meso
+    k_max = k_base + (1 if rem else 0)
+
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    stride = max(n // n_meso, 1)
+    c0 = xf[::stride][:n_meso]
+    if c0.shape[0] < n_meso:
+        c0 = jnp.pad(c0, ((0, n_meso - c0.shape[0]), (0, 0)), mode="edge")
+    meso_centers, meso_labels = _balanced_loop(xf, c0, k1, n_meso,
+                                               n_iters, metric)
+
+    per = min(n, max(2048, 32 * k_max))
+    idx = _meso_partition_sample(meso_labels, k2, n_meso, per)
+    subsets = xf[idx]                                # (n_meso, per, dim)
+
+    sub_stride = max(per // k_max, 1)
+
+    def one(sub, k):
+        c0f = sub[::sub_stride][:k_max]
+        c0f = jnp.pad(c0f, ((0, k_max - c0f.shape[0]), (0, 0)),
+                      mode="edge")
+        centers, _ = _balanced_loop(sub, c0f, k, k_max, n_iters, metric)
+        return centers
+
+    fine = jax.vmap(one)(subsets, jax.random.split(k3, n_meso))
+
+    # keep exactly n_clusters centers: meso m contributes
+    # k_base (+1 for the first `rem`) of its k_max trained centers
+    quota = k_base + (jnp.arange(n_meso) < rem).astype(jnp.int32)
+    valid = jnp.arange(k_max)[None, :] < quota[:, None]
+    flat = fine.reshape(-1, dim)
+    order = jnp.argsort(~valid.ravel(), stable=True)
+    centers0 = flat[order[:n_clusters]]
+
+    refine_iters = max(2, n_iters // 5)
+    centers, _ = _balanced_loop(xf, centers0, k4, n_clusters,
+                                refine_iters, metric)
+    return centers
+
+
 def fit(
     res,
     params: KMeansBalancedParams,
@@ -118,10 +205,14 @@ def fit(
     n_clusters: int,
     *,
     key: Optional[jax.Array] = None,
+    hierarchical: Optional[bool] = None,
 ) -> jax.Array:
     """Train balanced centroids; returns (n_clusters, dim) float32.
 
-    Reference: cluster/kmeans_balanced.cuh:75.
+    Reference: cluster/kmeans_balanced.cuh:75.  ``hierarchical`` forces
+    (True) or disables (False) the two-level mesocluster build; None
+    auto-selects it for n_clusters >= _MESO_THRESHOLD (the reference's
+    build_hierarchical path, detail/kmeans_balanced.cuh).
     """
     with named_range("kmeans_balanced::fit"):
         X = ensure_array(X, "X")
@@ -133,6 +224,11 @@ def fit(
                 "(as the reference does)")
         if key is None:
             key = res.next_key()
+        if hierarchical is None:
+            hierarchical = n_clusters >= _MESO_THRESHOLD
+        if hierarchical and n_clusters >= 4:
+            return _fit_hierarchical(X.astype(jnp.float32), n_clusters,
+                                     key, params.n_iters, params.metric)
         # evenly-strided init over the (caller-shuffled) trainset — the
         # reference seeds from strided trainset rows.
         stride = max(n // n_clusters, 1)

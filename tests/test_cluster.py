@@ -148,3 +148,38 @@ class TestKMeansBalanced:
         np.testing.assert_array_equal(
             np.asarray(sizes),
             np.bincount(np.asarray(labels), minlength=8))
+
+    def test_hierarchical_matches_single_level_quality(self, res):
+        """Two-level mesocluster build (the build_hierarchical analogue):
+        exact center count, bounded skew, and clustering cost comparable
+        to the single-level loop."""
+        X, _ = _blobs(res, n=6000, d=16, k=32, std=0.6)
+        params = KMeansBalancedParams(n_iters=10)
+        c_h = kmeans_balanced.fit(res, params, X, 128, hierarchical=True)
+        assert c_h.shape == (128, 16)
+        lab = np.asarray(kmeans_balanced.predict(res, params, X, c_h))
+        sizes = np.bincount(lab, minlength=128)
+        assert (sizes > 0).sum() >= 100          # few empty lists
+        assert sizes.max() <= X.shape[0] // 8    # no megacluster
+
+        c_s = kmeans_balanced.fit(res, params, X, 128, hierarchical=False)
+        lab_s = np.asarray(kmeans_balanced.predict(res, params, X, c_s))
+
+        def cost(c, lab_):
+            return float(((np.asarray(X)
+                           - np.asarray(c)[lab_]) ** 2).sum())
+
+        assert cost(c_h, lab) <= 1.5 * cost(c_s, lab_s)
+
+    def test_meso_partition_sample_covers_members(self, res):
+        """Sampled indices must belong to the right mesocluster segment
+        (cycling when a mesocluster has fewer than `per` members)."""
+        import jax
+
+        labels = jnp.asarray(np.repeat([0, 1, 2, 3], [5, 100, 30, 2]))
+        idx = kmeans_balanced._meso_partition_sample(
+            labels, jax.random.key(0), 4, 16)
+        got = np.asarray(labels)[np.asarray(idx)]
+        np.testing.assert_array_equal(got,
+                                      np.repeat([0, 1, 2, 3], 16
+                                                ).reshape(4, 16))
