@@ -1,0 +1,104 @@
+"""Multi-process comms worker — the raft-dask LocalCUDACluster-test
+analogue (reference: python/raft-dask/raft_dask/test/test_comms.py:45,
+conftest.py).
+
+Launched by test_multiprocess.py as N OS processes, each owning 2
+virtual CPU devices.  Exercises the REAL multi-controller bootstrap:
+``jax.distributed.initialize`` (the NCCL-uniqueId-rendezvous analogue),
+a global mesh spanning both processes, CommsSession + collectives over
+it, and one MNMG k-means fit.  Prints MULTIPROC_OK on success.
+"""
+
+import os
+import sys
+
+proc_id = int(sys.argv[1])
+n_procs = int(sys.argv[2])
+port = sys.argv[3]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=n_procs, process_id=proc_id)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_tpu.comms.comms import op_t  # noqa: E402
+from raft_tpu.comms.session import CommsSession  # noqa: E402
+
+P = jax.sharding.PartitionSpec
+
+assert jax.process_count() == n_procs, jax.process_count()
+devs = jax.devices()
+n_dev = len(devs)
+assert n_dev == 2 * n_procs, n_dev
+
+session = CommsSession(devices=devs).init()
+handle = session.worker_handle()
+comms = session.comms()
+mesh = session.mesh
+assert handle.comms_initialized()
+assert comms.get_size() == n_dev
+
+
+def replicated(fn):
+    """jit(shard_map) with replicated output — every process can read
+    its local copy (multi-controller: np.asarray on a sharded global
+    array is not allowed)."""
+    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=(),
+                                 out_specs=P(), check_vma=False))
+
+
+# ---- collective self-tests over the cross-process mesh -------------------
+out = replicated(
+    lambda: comms.allreduce(jnp.ones((), jnp.float32), op_t.SUM)[None])()
+assert float(np.asarray(out.addressable_data(0)).ravel()[0]) == n_dev, out
+
+out = replicated(
+    lambda: comms.allgather(
+        jax.lax.axis_index(session.axis_name).astype(jnp.float32)[None]))()
+got = np.asarray(out.addressable_data(0))
+np.testing.assert_array_equal(got.ravel(),
+                              np.arange(n_dev, dtype=np.float32))
+
+# ---- one MNMG k-means fit over the global mesh ---------------------------
+from raft_tpu.cluster.kmeans_types import InitMethod, KMeansParams  # noqa: E402
+from raft_tpu.distributed import kmeans as dist_kmeans  # noqa: E402
+
+rng = np.random.default_rng(0)
+k = 4
+centers_true = rng.normal(size=(k, 8)).astype(np.float32) * 6
+labels_true = rng.integers(0, k, 256)
+X_np = (centers_true[labels_true]
+        + rng.normal(size=(256, 8)).astype(np.float32))
+
+sharding = jax.sharding.NamedSharding(mesh, P(session.axis_name, None))
+X = jax.make_array_from_callback((256, 8), sharding,
+                                 lambda idx: X_np[idx])
+# seed one point per true cluster (Array init; a degenerate seed can
+# stall Lloyd in a local optimum, which is not what this test checks)
+first = [int(np.argmax(labels_true == c)) for c in range(k)]
+c0 = jnp.asarray(X_np[first])
+
+params = KMeansParams(n_clusters=k, max_iter=10, tol=1e-4,
+                      init=InitMethod.Array)
+centroids, inertia, n_iter = dist_kmeans.fit(handle, params, X,
+                                             centroids=c0)
+c = np.asarray(centroids.addressable_data(0)
+               if hasattr(centroids, "addressable_data") else centroids)
+assert c.shape == (k, 8)
+assert np.isfinite(c).all()
+# every true center recovered to within the blob spread
+d = ((c[:, None, :] - centers_true[None]) ** 2).sum(-1)
+assert (d.min(0) < 4.0).all(), d.min(0)
+
+session.destroy()
+print(f"MULTIPROC_OK rank={proc_id} ndev={n_dev}", flush=True)

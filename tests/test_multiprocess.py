@@ -1,0 +1,51 @@
+"""Multi-process ``jax.distributed`` bootstrap test.
+
+Reference: python/raft-dask/raft_dask/test/test_comms.py:45 proves the
+NCCL rendezvous with a LocalCUDACluster; here two OS processes (2
+virtual CPU devices each) rendezvous via ``jax.distributed.initialize``
+and run collectives + one MNMG k-means over the 4-device global mesh
+(tests/distributed_worker.py).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(__file__), "distributed_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_bootstrap_and_mnmg_kmeans():
+    port = _free_port()
+    env = dict(os.environ)
+    # the workers set their own JAX env; drop any inherited backend pins
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(rank), "2", str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        for rank in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (
+            f"worker {rank} failed (rc={p.returncode}):\n{out[-4000:]}")
+        assert "MULTIPROC_OK" in out, out[-4000:]
