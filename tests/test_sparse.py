@@ -8,6 +8,7 @@ import numpy as np
 import pytest
 
 from raft_tpu import sparse
+from raft_tpu.distance.types import DistanceType
 
 RNG = np.random.default_rng(0)
 
@@ -131,6 +132,41 @@ class TestOps:
         expect = np.asarray(pairwise_distance(dx, dy))
         np.testing.assert_allclose(np.asarray(out), expect,
                                    rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("metric", [
+        DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
+        DistanceType.InnerProduct, DistanceType.CosineExpanded,
+        DistanceType.CorrelationExpanded, DistanceType.L1,
+        DistanceType.Linf])
+    def test_sparse_metrics_match_dense(self, metric):
+        from raft_tpu.distance import pairwise_distance
+        dx = random_sparse(33, 40, seed=8)
+        dy = random_sparse(17, 40, seed=9)
+        out = sparse.pairwise_distance_sparse(
+            sparse.dense_to_csr(jnp.asarray(dx)),
+            sparse.dense_to_csr(jnp.asarray(dy)), metric)
+        expect = np.asarray(pairwise_distance(dx, dy, metric))
+        np.testing.assert_allclose(np.asarray(out), expect,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_sparse_expanded_column_blocking(self):
+        """The column-blocked accumulation (db < dim) must equal the
+        single-block result — the wide-feature regime the module
+        docstring targets."""
+        from raft_tpu.sparse.distance import _expanded_impl, _row_stats
+        from raft_tpu.distance import pairwise_distance
+        dx = random_sparse(12, 700, seed=10)
+        dy = random_sparse(9, 700, seed=11)
+        cx = sparse.dense_to_csr(jnp.asarray(dx))
+        cy = sparse.dense_to_csr(jnp.asarray(dy))
+        out = _expanded_impl(
+            cx.row_ids(), cx.indices, cx.data, cy.row_ids(), cy.indices,
+            cy.data, _row_stats(cx), _row_stats(cy), 12, 9, 700,
+            DistanceType.L2Expanded, tile=16, db=128)
+        expect = np.asarray(pairwise_distance(dx, dy,
+                                              DistanceType.L2Expanded))
+        np.testing.assert_allclose(np.asarray(out), expect,
+                                   rtol=1e-4, atol=1e-4)
 
 
 class TestLinalg:
