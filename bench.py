@@ -126,7 +126,9 @@ def bench_kmeans(res, X) -> dict:
     params = KMeansParams(n_clusters=KMEANS_K, max_iter=KMEANS_ITERS,
                           tol=0.0, n_init=1, init=InitMethod.Random)
     c, _, _ = kmeans.fit(res, params, X)       # warmup/compile
-    c.block_until_ready()
+    np.asarray(c)   # forced readback: block_until_ready can return early
+                    # over the remote tunnel, bleeding the warmup's
+                    # remote compile + execution into the timed region
     t0 = time.perf_counter()
     c, inertia, n_iter = kmeans.fit(res, params, X)
     np.asarray(c)       # host readback (see bench_ivf_pq note)
@@ -151,7 +153,10 @@ def bench_kmeans(res, X) -> dict:
 
 def _make_dataset(ds):
     rng = np.random.default_rng(0)
-    n, dim = ds["n_db"], ds["dim"]
+    # deep-scale confs bound the database (the reference's subset_size
+    # option for the billion-scale sets, cuda_ann_benchmarks.md)
+    n = ds.get("subset_size") or ds["n_db"]
+    dim = ds["dim"]
     latent = ds.get("latent_dim", 16)
     Z = rng.normal(size=(n + ds["n_queries"], latent)).astype(np.float32)
     A = rng.normal(size=(latent, dim)).astype(np.float32) / np.sqrt(latent)
@@ -188,7 +193,26 @@ def run_conf(conf_path: str) -> None:
     for entry in conf["index"]:
         algo, bp = entry["algo"], entry["build_param"]
         t0 = time.perf_counter()
-        if algo == "bfknn":
+        if bp.get("multigpu"):
+            # the reference conf's multigpu option
+            # (cuda_ann_benchmarks.md:163) — sharded build + search over
+            # every visible device via distributed.ann
+            from raft_tpu.comms.session import CommsSession
+            from raft_tpu.distributed import ann as dist_ann
+
+            expects_pq = algo == "ivf_pq"
+            if not expects_pq:
+                raise ValueError("multigpu conf supports ivf_pq")
+            session = CommsSession().init()
+            handle = session.worker_handle()
+            n_dev = len(session.mesh.devices.ravel())
+            n_fit = (db.shape[0] // n_dev) * n_dev
+            index = dist_ann.build(
+                handle, ivf_pq.IndexParams(n_lists=bp["nlist"],
+                                           pq_dim=bp.get("pq_dim", 0),
+                                           metric=metric), db[:n_fit])
+            mg_handle = handle
+        elif algo == "bfknn":
             index = None
         elif algo == "ivf_flat":
             index = ivf_flat.build(
@@ -212,6 +236,10 @@ def run_conf(conf_path: str) -> None:
 
         for sp in entry["search_params"]:
             def query(q):
+                if bp.get("multigpu"):
+                    from raft_tpu.distributed import ann as dist_ann
+                    p = ivf_pq.SearchParams(n_probes=sp["nprobe"])
+                    return dist_ann.search(mg_handle, p, index, q, k)[1]
                 if algo == "bfknn":
                     return brute_force.knn(res, db, q, k, metric=metric)[1]
                 if algo == "ivf_flat":
@@ -226,11 +254,13 @@ def run_conf(conf_path: str) -> None:
                         i = refine_fn(res, db, q, i, k, metric=metric)[1]
                     return i
                 return cagra.search(
-                    res, cagra.SearchParams(itopk_size=sp["itopk"]),
+                    res, cagra.SearchParams(
+                        itopk_size=sp["itopk"],
+                        search_width=sp.get("search_width", 1)),
                     index, q, k)[1]
 
             found = [query(q) for q in q_batches]   # warmup/compile
-            found[-1].block_until_ready()
+            np.asarray(found[-1])   # forced readback (see bench_kmeans)
             recall = _recall(np.concatenate([np.asarray(f)
                                              for f in found]), gt_i)
             t0 = time.perf_counter()
@@ -239,11 +269,23 @@ def run_conf(conf_path: str) -> None:
                     i = query(q)
             np.asarray(i)       # host readback (see bench_ivf_pq note)
             per_run = (time.perf_counter() - t0) / runs
+            # latency mode (eval.pl -l): per-batch wall clock with a
+            # host sync per batch, reported as percentiles
+            lats = []
+            for _ in range(max(runs, 3)):
+                for q in q_batches:
+                    t1 = time.perf_counter()
+                    np.asarray(query(q))
+                    lats.append((time.perf_counter() - t1) * 1000)
+            lats = np.asarray(lats)
             results.append({
                 "name": entry["name"], "search_param": sp,
                 "recall": round(recall, 4),
                 "qps": round(queries.shape[0] / per_run, 1),
                 "latency_ms": round(per_run / len(q_batches) * 1000, 2),
+                "latency_p50_ms": round(float(np.percentile(lats, 50)), 2),
+                "latency_p95_ms": round(float(np.percentile(lats, 95)), 2),
+                "latency_p99_ms": round(float(np.percentile(lats, 99)), 2),
                 "build_s": round(build_s, 1)})
             print(json.dumps(results[-1]), flush=True)
 
