@@ -194,6 +194,64 @@ def build_knn_graph(
 
 
 @functools.partial(jax.jit, static_argnames=("block",))
+def _detour_chunk(knn_graph, blocks, block=256):
+    """Detour-order a chunk of node blocks (see :func:`_detour_order`).
+
+    Membership (is neighbor r in neighbor rp's adjacency?) is a
+    **sorted-merge**: concat [adjacency row | keys] per (node, rp),
+    one multi-operand ``lax.sort`` by (value, source-tag), run-aware
+    member flags via two ``cummax`` scans (robust to duplicate ids on
+    either side), and a second small sort carrying the flags back into
+    key order.  The earlier ``searchsorted`` formulation lowered to
+    serial per-key gathers — measured **50x slower** on TPU than this
+    all-sort form (profiles round 4: 50.0 s vs 0.97 s per 32k rows).
+    """
+    n, deg = knn_graph.shape
+    rank = jnp.arange(deg)
+
+    def one_block(kb):                               # (B, deg)
+        B = kb.shape[0]
+        non = knn_graph[jnp.clip(kb, 0, n - 1)]      # (B, rp=deg, deg)
+        keys = jnp.broadcast_to(kb[:, None, :], (B, deg, deg))
+        vals = jnp.concatenate([non, keys], axis=-1)           # (B,deg,2deg)
+        tags = jnp.concatenate(
+            [jnp.zeros((B, deg, deg), jnp.int32),
+             jnp.ones((B, deg, deg), jnp.int32)], -1)
+        ridx = jnp.concatenate(
+            [jnp.zeros((B, deg, deg), jnp.int32),
+             jnp.broadcast_to(rank[None, None, :], (B, deg, deg))], -1)
+        sv, st, sr = jax.lax.sort((vals, tags, ridx), dimension=-1,
+                                  num_keys=2)
+        # run-aware membership: a key is a member iff its equal-value
+        # run contains an adjacency (tag==0) element
+        iota = jnp.arange(2 * deg, dtype=jnp.int32)
+        is_start = jnp.concatenate(
+            [jnp.ones_like(sv[..., :1], jnp.bool_),
+             sv[..., 1:] != sv[..., :-1]], -1)
+        run_start = jax.lax.cummax(jnp.where(is_start, iota, 0), axis=2)
+        last_sn = jax.lax.cummax(jnp.where(st == 0, iota, -1), axis=2)
+        is_member_key = (st == 1) & (last_sn >= run_start)
+        # flags back into key order r (non-keys past the end via sentinel)
+        sr2 = jnp.where(st == 1, sr, deg)
+        _, member_r = jax.lax.sort((sr2, is_member_key.astype(jnp.int32)),
+                                   dimension=-1, num_keys=1)
+        member = member_r[..., :deg].astype(jnp.bool_)         # (B, rp, r)
+
+        stronger = rank[:, None] < rank[None, :]     # first hop rp < r
+        detours = jnp.sum(member & stronger[None], axis=1)   # (B, deg)
+        score = detours * deg + rank[None, :]
+        order = jnp.argsort(score, axis=1)
+        return jnp.take_along_axis(kb, order, axis=1)
+
+    return jax.lax.map(one_block, blocks)
+
+
+# node rows per _detour_chunk dispatch: ONE lax.map over all of 1M nodes
+# is a single multi-minute XLA execution, which the remote-tunnel
+# watchdog kills ("TPU worker process crashed") — bound each dispatch
+_DETOUR_ROWS_PER_DISPATCH = 32768
+
+
 def _detour_order(knn_graph, block=256):
     """Rank-based detour ordering (graph_core.cuh:415 ``prune``).
 
@@ -206,33 +264,23 @@ def _detour_order(knn_graph, block=256):
     neighbor lists (B, deg, deg) are sorted once and each membership
     resolves via ``searchsorted`` — O(B·deg²) memory, no
     (n, deg, deg, deg) intermediate (that is ~2×10¹⁵ elements at the
-    reference's 1M×128 defaults).
+    reference's 1M×128 defaults).  The blocks are dispatched in
+    fixed-size host chunks (two compiled shapes max) so no single
+    device execution runs long enough to trip execution watchdogs.
     """
     n, deg = knn_graph.shape
-    rank = jnp.arange(deg)
     n_pad = ((n + block - 1) // block) * block
     knn_p = jnp.pad(knn_graph, ((0, n_pad - n), (0, 0)))
     blocks = knn_p.reshape(n_pad // block, block, deg)
 
-    def one_block(kb):                               # (B, deg)
-        non = knn_graph[jnp.clip(kb, 0, n - 1)]      # (B, deg, deg)
-        snon = jnp.sort(non, axis=-1)
-
-        def row_member(sn, keys):
-            # sn (deg, deg) row-sorted; keys (deg,) -> member (deg_rp, deg_r)
-            idx = jax.vmap(lambda s: jnp.searchsorted(s, keys))(sn)
-            vals = jnp.take_along_axis(sn, jnp.clip(idx, 0, deg - 1), axis=1)
-            return vals == keys[None, :]
-
-        member = jax.vmap(row_member)(snon, kb)      # (B, rp, r)
-        stronger = rank[:, None] < rank[None, :]     # first hop rp < r
-        detours = jnp.sum(member & stronger[None], axis=1)   # (B, deg)
-        score = detours * deg + rank[None, :]
-        order = jnp.argsort(score, axis=1)
-        return jnp.take_along_axis(kb, order, axis=1)
-
-    out = jax.lax.map(one_block, blocks)
-    return out.reshape(n_pad, deg)[:n]
+    cpb = max(_DETOUR_ROWS_PER_DISPATCH // block, 1)
+    nb = blocks.shape[0]
+    nb_pad = ((nb + cpb - 1) // cpb) * cpb
+    blocks = jnp.pad(blocks, ((0, nb_pad - nb), (0, 0), (0, 0)))
+    out = [_detour_chunk(knn_graph, blocks[s:s + cpb], block=block)
+           for s in range(0, nb_pad, cpb)]
+    out = jnp.concatenate(out, axis=0) if len(out) > 1 else out[0]
+    return out.reshape(nb_pad * block, deg)[:n]
 
 
 @functools.partial(jax.jit, static_argnames=("n", "rev_cap"))

@@ -123,6 +123,38 @@ class TestCagra:
 
 
 @pytest.mark.slow
+class TestMillionScale:
+    @pytest.mark.skipif(
+        __import__("jax").default_backend() == "cpu",
+        reason="1M build is an accelerator workload (hours on the CPU "
+               "test backend); validated on a v5e chip each round "
+               "(PERFORMANCE.md round-4 CAGRA section)")
+    def test_recall_at_1m(self, res):
+        """CAGRA at the reference's headline scale (1M x 128, the
+        sift-128-euclidean.json regime): packed-neighborhood walk must
+        clear recall 0.95 @ k=10."""
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(0)
+        n, nq, dim, latent = 1_000_000, 2_000, 128, 16
+        Z = rng.normal(size=(n + nq, latent)).astype(np.float32)
+        A = (rng.normal(size=(latent, dim)).astype(np.float32)
+             / np.sqrt(latent))
+        X = (Z @ A + 0.05 * rng.normal(size=(n + nq, dim))).astype(
+            np.float32)
+        X = jnp.asarray(X)
+        db, q = X[:n], X[n:]
+        from raft_tpu.neighbors import brute_force
+        _, gt = brute_force.knn(res, db, q, 10)
+        index = cagra.build(res, cagra.IndexParams(graph_degree=32), db)
+        _, i = cagra.search(res, cagra.SearchParams(itopk_size=64,
+                                                    search_width=2),
+                            index, q, 10)
+        rec = recall(np.asarray(i), np.asarray(gt))
+        assert rec >= 0.95
+
+
+@pytest.mark.slow
 class TestManifoldScale:
     def test_recall_on_low_intrinsic_dim_data(self, res):
         """SIFT-like data: low intrinsic dimensionality embedded in high-d.
