@@ -288,9 +288,14 @@ def _reverse_edges(fwd, n, rev_cap):
     """Device-side reverse-edge lists (graph_core.cuh rev_graph).
 
     For each directed edge (i→j), j collects i into up to ``rev_cap``
-    reverse slots, strongest (lowest-rank) edges first: sort all edges by
-    (dst, rank) via two stable argsorts, compute each edge's position
-    within its dst group, and scatter the first ``rev_cap`` per group.
+    reverse slots, strongest (lowest-rank) edges first: ONE stable
+    argsort of the rank-major edge list by dst yields (dst asc, rank
+    asc) order; each node's slots then read **by gather** at
+    ``group_start + slot`` (group starts via vectorized binary search).
+    Scatter-free on purpose: a 32M-singleton scatter measured seconds-
+    to-minutes on TPU (round-4 profiling) and made the fused prune
+    dispatch long enough to trip the remote execution watchdog, while
+    sort + searchsorted + gather are each sub-4s at 1M x 32.
     """
     half = fwd.shape[1]
     # rank-major edge order is a transpose, not a sort; the single stable
@@ -301,18 +306,13 @@ def _reverse_edges(fwd, n, rev_cap):
     dsts = dst[o]
     srcs = src[o]
     e = dsts.shape[0]
-    # position within each dst group: running max of group-start indices
-    first = jnp.concatenate(
-        [jnp.ones(1, jnp.bool_), dsts[1:] != dsts[:-1]])
-    starts = jax.lax.associative_scan(
-        jnp.maximum, jnp.where(first, jnp.arange(e), 0))
-    pos = jnp.arange(e) - starts
-    keep = (pos < rev_cap) & (dsts >= 0) & (dsts < n)
-    row = jnp.where(keep, dsts, n)                   # n = dummy row
-    col = jnp.clip(pos, 0, rev_cap - 1)
-    rev = jnp.full((n + 1, rev_cap), -1, jnp.int32)
-    rev = rev.at[row, col].set(jnp.where(keep, srcs, -1))
-    return rev[:n]
+    nodes = jnp.arange(n, dtype=dsts.dtype)
+    starts = jnp.searchsorted(dsts, nodes)                   # (n,)
+    counts = jnp.searchsorted(dsts, nodes, side="right") - starts
+    idx = starts[:, None] + jnp.arange(rev_cap)[None, :]     # (n, rev_cap)
+    rev = srcs[jnp.clip(idx, 0, e - 1)]
+    valid = jnp.arange(rev_cap)[None, :] < counts[:, None]
+    return jnp.where(valid, rev, -1)
 
 
 def prune(res, knn_graph, graph_degree: int) -> jax.Array:
@@ -359,10 +359,20 @@ def build(res, params: IndexParams, dataset) -> Index:
 class _WalkCache:
     """Derived search-time state (lazily attached to the Index).
 
-    ``table`` (n, degree, pdim+4) bf16 — per node, each neighbor's
-    PCA-projected vector (pdim bf16 lanes), full-precision squared norm
-    (f32 bitcast into 2 bf16 lanes) and id (int32 bitcast into 2 bf16
-    lanes): the whole neighborhood in ONE scattered row fetch.
+    ``table`` (n, W) **int16**, W = pad(degree*(pdim+4), 128) — per
+    node, each neighbor's PCA-projected vector (pdim bf16 values),
+    full-precision squared norm (f32) and id (int32), ALL bitcast into
+    int16 lanes: the whole neighborhood in ONE scattered row fetch.
+
+    The container dtype must be an INTEGER type: bf16 lanes measurably
+    corrupt the packed ids/norms — XLA relayout copies at large n flush
+    bf16-denormal bit patterns (an int32 id like 1000 bitcasts to a
+    denormal low lane), which silently zeroed neighbor ids at 1M and
+    collapsed walk recall to 0.02 while every small-scale test passed
+    (round-4 debugging).  Integer copies are bit-exact.  The flat
+    lane-aligned width also avoids the 2x tiling padding XLA gave the
+    (n, degree, pdim+4) 3-D layout.
+
     ``proj`` (dim, pdim) f32; ``entry_*`` the fixed random entry set
     scored densely at search time.
     """
@@ -389,41 +399,42 @@ def _second_moment(dataset):
 
 
 # the auto walk projection must preserve NN ordering at this top-k
-# overlap on a calibration sample (spectral ENERGY is the wrong
-# criterion: on clustered data the variance concentrates in the few
-# center directions while the ordering among a node's neighbors lives
-# in the isotropic residual dims — measured recall collapse, r4)
+# overlap, measured for sample queries against a LARGE candidate pool
+# (spectral ENERGY is the wrong criterion — on clustered data the
+# ordering among a node's neighbors lives in the residual dims; and a
+# small within-sample test is wrong too: NN gaps shrink with n, so a
+# projection that orders a sparse 1k sample perfectly can scramble the
+# true neighbors at 1M density — measured recall collapse both ways, r4)
 _WALK_FIDELITY = 0.9
-_WALK_CALIB_ROWS = 1024
+_WALK_CALIB_QUERIES = 256
+_WALK_CALIB_POOL = 131072
 _WALK_CALIB_K = 10
 
 
 @functools.partial(jax.jit, static_argnames=("pdim", "k", "ip_metric"))
-def _calib_overlap(sample, vecs, pdim, k, ip_metric=False):
-    """Top-k overlap (self excluded) between exact and pdim-projected
-    distances on the calibration sample — scored under the index's own
-    metric (an IP walk ranks purely by the projected cross term; gating
-    it on L2 overlap would let the exact-norm term mask cross-term
-    error)."""
-    m, dim = sample.shape
-    ip = jax.lax.dot_general(sample, sample, (((1,), (1,)), ((), ())),
+def _calib_overlap(queries, pool, vecs, pdim, k, ip_metric=False):
+    """Top-k overlap between exact and pdim-projected distances for
+    calibration queries against a candidate pool — scored under the
+    index's own metric (an IP walk ranks purely by the projected cross
+    term; gating it on L2 overlap would let the exact-norm term mask
+    cross-term error)."""
+    dim = pool.shape[1]
+    ip = jax.lax.dot_general(queries, pool, (((1,), (1,)), ((), ())),
                              precision=get_matmul_precision(),
                              preferred_element_type=jnp.float32)
     proj = vecs[:, dim - pdim:]
-    sp = (sample @ proj).astype(jnp.bfloat16)
-    ipa = jax.lax.dot_general(sp, sp, (((1,), (1,)), ((), ())),
+    qp = (queries @ proj).astype(jnp.bfloat16)
+    pp = (pool @ proj).astype(jnp.bfloat16)
+    ipa = jax.lax.dot_general(qp, pp, (((1,), (1,)), ((), ())),
                               preferred_element_type=jnp.float32)
     if ip_metric:
         d_exact, d_apx = -ip, -ipa
     else:
-        x_sq = jnp.sum(sample * sample, axis=1)
-        d_exact = x_sq[:, None] + x_sq[None, :] - 2.0 * ip
-        d_apx = x_sq[:, None] + x_sq[None, :] - 2.0 * ipa
-    eye = jnp.eye(m, dtype=jnp.bool_)
-    d_exact = jnp.where(eye, jnp.inf, d_exact)
-    d_apx = jnp.where(eye, jnp.inf, d_apx)
-    _, ie = jax.lax.top_k(-d_exact, k)
-    _, ia = jax.lax.top_k(-d_apx, k)
+        p_sq = jnp.sum(pool * pool, axis=1)
+        d_exact = p_sq[None, :] - 2.0 * ip
+        d_apx = p_sq[None, :] - 2.0 * ipa
+    _, ie = jax.lax.top_k(-d_exact, k + 1)   # +1: query may be in pool
+    _, ia = jax.lax.top_k(-d_apx, k + 1)
     hits = jnp.any(ie[:, :, None] == ia[:, None, :], axis=-1)
     return jnp.mean(hits.astype(jnp.float32))
 
@@ -436,17 +447,20 @@ def _auto_pdim(index: Index) -> int:
     if cached is None:
         dim = index.dim
         n = index.size
-        m = min(n, _WALK_CALIB_ROWS)
-        # strided sample (see _second_moment: leading rows bias
-        # cluster-grouped datasets)
-        sample = index.dataset[::max(n // m, 1)][:m].astype(jnp.float32)
+        # strided samples (see _second_moment: leading rows bias
+        # cluster-grouped datasets); the pool must be large so its NN
+        # gaps approach index-scale density
+        mq = min(n, _WALK_CALIB_QUERIES)
+        mp = min(n, _WALK_CALIB_POOL)
+        queries = index.dataset[::max(n // mq, 1)][:mq].astype(jnp.float32)
+        pool = index.dataset[::max(n // mp, 1)][:mp].astype(jnp.float32)
         ip_metric = index.metric == DistanceType.InnerProduct
         _, vecs = jnp.linalg.eigh(_second_moment(index.dataset))
         p = 8
         cached = 0
         while p < dim:
-            ov = float(_calib_overlap(sample, vecs, p, _WALK_CALIB_K,
-                                      ip_metric))
+            ov = float(_calib_overlap(queries, pool, vecs, p,
+                                      _WALK_CALIB_K, ip_metric))
             if ov >= _WALK_FIDELITY:
                 cached = p
                 break
@@ -455,8 +469,8 @@ def _auto_pdim(index: Index) -> int:
             # full-dim projection = rotation only, but the packed table
             # is bf16 — if even that loses the ordering (tight clusters
             # with |x| >> NN gaps), 0 routes to the exact direct walk
-            ov = float(_calib_overlap(sample, vecs, dim, _WALK_CALIB_K,
-                                      ip_metric))
+            ov = float(_calib_overlap(queries, pool, vecs, dim,
+                                      _WALK_CALIB_K, ip_metric))
             cached = dim if ov >= _WALK_FIDELITY else 0
         object.__setattr__(index, "_walk_auto_pdim", cached)
     return cached
@@ -479,10 +493,15 @@ def _build_walk_table(dataset, graph, pdim):
     x_sq = jnp.sum(xf * xf, axis=1)                # (n,) f32
 
     nb = graph.astype(jnp.int32)                   # (n, deg), all >= 0
-    nb_p = xp[nb]                                  # (n, deg, pdim) bf16
-    sq2 = jax.lax.bitcast_convert_type(x_sq[nb], jnp.bfloat16)
-    id2 = jax.lax.bitcast_convert_type(nb, jnp.bfloat16)
+    deg = nb.shape[1]
+    nb_p = jax.lax.bitcast_convert_type(xp[nb], jnp.int16)
+    sq2 = jax.lax.bitcast_convert_type(x_sq[nb], jnp.int16)   # (n,deg,2)
+    id2 = jax.lax.bitcast_convert_type(nb, jnp.int16)         # (n,deg,2)
+    unit = pdim + 4
     table = jnp.concatenate([nb_p, sq2, id2], axis=2)
+    table = table.reshape(n, deg * unit)
+    w_pad = -(-(deg * unit) // 128) * 128
+    table = jnp.pad(table, ((0, 0), (0, w_pad - deg * unit)))
     return table, proj
 
 
@@ -567,10 +586,11 @@ def _select_parents(buf_d, buf_i, visited, search_width, ip_metric, worst):
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "k", "itopk", "search_width", "max_iterations", "metric", "rerank"))
+    "k", "itopk", "search_width", "max_iterations", "metric", "rerank",
+    "deg"))
 def _search_impl_walk(dataset, table, entry_proj, entry_sq, entry_ids,
                       proj, queries, k, itopk, search_width,
-                      max_iterations, metric, rerank):
+                      max_iterations, metric, rerank, deg):
     """Greedy walk over the packed neighborhood table.
 
     Walk distances are approximate (exact ||x||², PCA-projected bf16
@@ -581,8 +601,8 @@ def _search_impl_walk(dataset, table, entry_proj, entry_sq, entry_ids,
     """
     nq, dim = queries.shape
     n = dataset.shape[0]
-    deg = table.shape[1]
-    pdim = table.shape[2] - 4
+    pdim = proj.shape[1]
+    unit = pdim + 4
     wd = search_width * deg
     ip_metric = metric == DistanceType.InnerProduct
     worst = -jnp.inf if ip_metric else jnp.inf
@@ -627,8 +647,10 @@ def _search_impl_walk(dataset, table, entry_proj, entry_sq, entry_ids,
 
         # ONE fat row per parent: the whole neighborhood (projected
         # vectors + norms + ids) in a single scattered fetch
-        rows = table[jnp.where(parent_ok, sel_ids, 0)]  # (q, w, deg, u)
-        nb_p = rows[..., :pdim]
+        rows = table[jnp.where(parent_ok, sel_ids, 0)]  # (q, w, W) int16
+        rows = rows[..., :deg * unit].reshape(nq, search_width, deg, unit)
+        nb_p = jax.lax.bitcast_convert_type(rows[..., :pdim],
+                                            jnp.bfloat16)
         nb_sq = jax.lax.bitcast_convert_type(
             rows[..., pdim:pdim + 2], jnp.float32)      # (q, w, deg)
         nb_id = jax.lax.bitcast_convert_type(
@@ -794,7 +816,7 @@ def search(res, params: SearchParams, index: Index, queries, k: int
                 index.dataset, cache.table, cache.entry_proj,
                 cache.entry_sq, cache.entry_ids, cache.proj, queries, k,
                 itopk, params.search_width, max_iter, index.metric,
-                rerank)
+                rerank, index.graph_degree)
 
         # direct exact walk: probe 4×itopk random nodes (min 128) and
         # keep the best itopk — the reference's random-sampling buffer

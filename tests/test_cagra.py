@@ -113,6 +113,32 @@ class TestCagra:
         assert len(index._walk_tables) == n_tables     # table reused
         assert len(index._walk_entries) == n_entries + 1
 
+    def test_walk_table_int16_container_roundtrip(self, res, dataset,
+                                                  index):
+        """Regression (r4): the packed table container must be an
+        INTEGER dtype — bf16 lanes flushed denormal bit patterns (low
+        int32 id halves) in XLA relayout copies at 1M scale, silently
+        corrupting neighbor ids.  Decode must be bit-exact."""
+        import jax
+
+        db, q = dataset
+        cagra.search(res, cagra.SearchParams(), index, q, 5)
+        (pdim, _), = list(index._walk_entries)[:1]
+        table, proj = index._walk_tables[pdim]
+        assert jnp.issubdtype(table.dtype, jnp.integer)
+        unit = pdim + 4
+        deg = index.graph_degree
+        rows = table[:16, :deg * unit].reshape(16, deg, unit)
+        ids = jax.lax.bitcast_convert_type(rows[..., pdim + 2:pdim + 4],
+                                           jnp.int32)
+        np.testing.assert_array_equal(np.asarray(ids),
+                                      np.asarray(index.graph[:16]))
+        sq = jax.lax.bitcast_convert_type(rows[..., pdim:pdim + 2],
+                                          jnp.float32)
+        true_sq = np.sum(np.asarray(db, np.float32)[
+            np.asarray(index.graph[:16])] ** 2, axis=-1)
+        np.testing.assert_allclose(np.asarray(sq), true_sq, rtol=1e-5)
+
     def test_prune_reverse_edges(self, res, dataset):
         db, _ = dataset
         knn = cagra.build_knn_graph(res, db, 16)
