@@ -1,0 +1,141 @@
+"""AOT export of compiled entry points — the instantiation-layer analogue.
+
+Reference: cpp/src's 139 precompiled template instantiation units +
+pylibraft's prebuilt wheels give RAFT a compile-free deployment story.
+The TPU-native equivalent is **StableHLO export**: trace + lower a
+jitted entry point once, serialize the portable artifact
+(`jax.export`), and reload it in a process that never imports the
+algorithm's Python (or pays its trace time).  Artifacts are
+version-stable across jax releases per the StableHLO compatibility
+guarantees and are compiled (not re-traced) on load.
+
+Usage::
+
+    from raft_tpu.core import aot
+    blob = aot.export_fn(fn, example_args)         # bytes
+    g = aot.load_fn(blob)                          # callable
+    out = g(*args)                                 # same shapes/dtypes
+
+`save_search_fn` / `load_search_fn` wrap the ANN flagship: a
+searchable IVF-PQ index becomes one self-contained artifact (index
+arrays + exported search program) — the deployment shape of the
+reference's serialized index + prebuilt kernels.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import BinaryIO, Callable, Sequence
+
+import jax
+import numpy as np
+from jax import export as jax_export
+
+from raft_tpu.core.error import expects
+
+_MAGIC = b"RAFT_TPU_AOT1"
+
+
+def export_fn(fn: Callable, example_args: Sequence) -> bytes:
+    """Lower + serialize ``jit(fn)`` for the example args' shapes/dtypes.
+
+    ``fn`` must be jit-compatible; the artifact is specialized to the
+    example shapes (the reference's instantiation grid is likewise
+    shape-specialized — one unit per (T, IdxT, dims...) combination).
+    """
+    shapes = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype)
+        if not hasattr(a, "shape") else jax.ShapeDtypeStruct(a.shape, a.dtype),
+        tuple(example_args))
+    exp = jax_export.export(jax.jit(fn))(*shapes)
+    return bytes(exp.serialize())
+
+
+def load_fn(blob: bytes) -> Callable:
+    """Deserialize an exported entry point into a callable."""
+    exp = jax_export.deserialize(blob)
+
+    def call(*args):
+        return exp.call(*args)
+
+    return call
+
+
+def save_search_fn(stream: BinaryIO, fn: Callable, arrays: Sequence,
+                   example_queries) -> None:
+    """One-file deployment artifact: captured arrays + exported program.
+
+    ``fn(arrays..., queries) -> (distances, indices)``; ``arrays`` are
+    baked into the artifact (host numpy), queries stay a runtime input.
+    """
+    import jax.numpy as jnp
+
+    blob = export_fn(fn, tuple(arrays) + (example_queries,))
+    # non-executable container on purpose: npz for the arrays + a
+    # length-prefixed raw program blob (a pickle payload would execute
+    # arbitrary code when loading an untrusted artifact).  bf16 has no
+    # numpy representation; it rides as a uint16 view + dtype manifest.
+    stream.write(_MAGIC)
+    stream.write(len(blob).to_bytes(8, "little"))
+    stream.write(blob)
+    metas, store = [], {}
+    for i, a in enumerate(arrays):
+        a = jnp.asarray(a)
+        if a.dtype == jnp.bfloat16:
+            store[f"a{i}"] = np.asarray(
+                jax.lax.bitcast_convert_type(a, jnp.uint16))
+            metas.append("bfloat16")
+        else:
+            store[f"a{i}"] = np.asarray(a)
+            metas.append("native")
+    store["dtypes"] = np.asarray(metas)
+    np.savez(stream, **store)
+
+
+def load_search_fn(stream: BinaryIO) -> Callable:
+    """Load a :func:`save_search_fn` artifact; returns ``g(queries)``."""
+    magic = stream.read(len(_MAGIC))
+    expects(magic == _MAGIC, "aot: not a raft_tpu AOT artifact")
+    blob_len = int.from_bytes(stream.read(8), "little")
+    call = load_fn(stream.read(blob_len))
+    import jax.numpy as jnp
+
+    with np.load(stream, allow_pickle=False) as payload:
+        metas = [str(s) for s in payload["dtypes"]]
+        arrays = []
+        for i, meta in enumerate(metas):
+            a = jnp.asarray(payload[f"a{i}"])
+            if meta == "bfloat16":
+                a = jax.lax.bitcast_convert_type(a, jnp.bfloat16)
+            arrays.append(a)
+
+    def g(queries):
+        return call(*arrays, queries)
+
+    return g
+
+
+def export_ivf_pq_search(res, index, n_probes: int, k: int,
+                         batch: int) -> io.BytesIO:
+    """Export the flagship IVF-PQ recon search at fixed (batch, k,
+    n_probes) into a self-contained artifact (reference analogue:
+    serialized index + the prebuilt search instantiation)."""
+    from raft_tpu.neighbors import ivf_pq
+
+    expects(index.list_recon is not None,
+            "aot: index must carry the reconstruction cache")
+    metric = index.metric
+
+    def fn(centers, list_recon, list_indices, rotation, queries):
+        return ivf_pq._search_impl_recon(
+            centers, list_recon, list_indices, rotation, queries,
+            k=k, n_probes=n_probes, metric=metric)
+
+    example_q = jax.ShapeDtypeStruct((batch, index.dim),
+                                     index.centers.dtype)
+    buf = io.BytesIO()
+    save_search_fn(buf, fn,
+                   (index.centers, index.list_recon, index.list_indices,
+                    index.rotation), example_q)
+    buf.seek(0)
+    return buf

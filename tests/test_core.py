@@ -138,3 +138,45 @@ class TestInterruptible:
             interruptible.synchronize()
         # token cleared after raise
         interruptible.synchronize()
+
+
+class TestAot:
+    """AOT export (core/aot.py) — the instantiation-layer analogue
+    (reference: cpp/src precompiled template units; SURVEY §1)."""
+
+    def test_export_roundtrip(self):
+        from raft_tpu.core import aot
+
+        def fn(a, b):
+            return a @ b + 1.0
+
+        x = jnp.ones((8, 16), jnp.float32)
+        y = jnp.ones((16, 4), jnp.float32)
+        blob = aot.export_fn(fn, (x, y))
+        assert isinstance(blob, bytes) and len(blob) > 0
+        g = aot.load_fn(blob)
+        np.testing.assert_allclose(np.asarray(g(x, y)),
+                                   np.asarray(fn(x, y)), rtol=1e-6)
+
+    def test_ivf_pq_search_artifact(self, res):
+        """Flagship deployment artifact: export at fixed shapes, reload
+        in a fresh callable, identical results to the live search."""
+        from raft_tpu.core import aot
+        from raft_tpu.neighbors import ivf_pq
+
+        rng = np.random.default_rng(0)
+        db = jnp.asarray(rng.normal(size=(2048, 32)).astype(np.float32))
+        q = jnp.asarray(rng.normal(size=(16, 32)).astype(np.float32))
+        index = ivf_pq.build(
+            res, ivf_pq.IndexParams(n_lists=16, pq_dim=8,
+                                    kmeans_n_iters=4), db)
+        buf = aot.export_ivf_pq_search(res, index, n_probes=8, k=5,
+                                       batch=16)
+        g = aot.load_search_fn(buf)
+        d1, i1 = g(q)
+        d2, i2 = ivf_pq._search_impl_recon(
+            index.centers, index.list_recon, index.list_indices,
+            index.rotation, q, k=5, n_probes=8, metric=index.metric)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2),
+                                   rtol=1e-5, atol=1e-5)
