@@ -167,24 +167,43 @@ class Comms:
 
     def isend(self, x, dst: Sequence[int], tag: int = 0) -> "P2pRequest":
         """Post a send: rank r's buffer goes to absolute rank ``dst[r]``
-        (reference: comms.hpp:146 ``isend``).  Completion at waitall()."""
+        (reference: comms.hpp:146 ``isend``).  Completion at waitall().
+
+        Permutation patterns complete as ONE ``ppermute`` (ICI-direct).
+        Partial fan-in patterns (``dst[r] = -1`` marks rank r as not
+        sending; an injective map over the senders) complete via an
+        ``all_gather`` + per-rank select — n× the bandwidth of a true
+        p2p message, the honest XLA translation of dynamic routing.
+        Two senders targeting one rank need two tags (one recv can only
+        name one source); waitall() rejects unclaimed sends."""
         n = self.get_size()
         expects(isinstance(n, int), "isend needs a static axis size")
-        dsts = [int(d) % n for d in dst]
+        dsts = []
+        for d in dst:
+            d = int(d)
+            expects(-1 <= d < n,
+                    f"isend: dst ranks must be in [0, {n}) or the -1 "
+                    "no-send sentinel")
+            dsts.append(d)
         expects(len(dsts) == n, f"isend: dst must list all {n} ranks")
-        expects(sorted(dsts) == list(range(n)),
-                "isend: dst pattern must be a permutation (XLA p2p is a "
-                "static ppermute; overlapping destinations need two tags)")
         return P2pRequest(kind="send", comms=self, payload=x,
                           pattern=tuple(dsts), tag=tag)
 
     def irecv(self, src: Sequence[int], tag: int = 0) -> "P2pRequest":
         """Post a receive: rank r expects the message sent by absolute rank
         ``src[r]`` under ``tag`` (reference: comms.hpp:156 ``irecv``).  The
-        buffer materializes at waitall()."""
+        buffer materializes at waitall().  ``src[r] = -1`` marks rank r
+        as receiving nothing for this tag (fan-in patterns where only
+        some ranks are destinations); its buffer fills with zeros."""
         n = self.get_size()
         expects(isinstance(n, int), "irecv needs a static axis size")
-        srcs = [int(s) % n for s in src]
+        srcs = []
+        for s in src:
+            s = int(s)
+            expects(-1 <= s < n,
+                    f"irecv: src ranks must be in [0, {n}) or the -1 "
+                    "receive-nothing sentinel")
+            srcs.append(s)
         expects(len(srcs) == n, f"irecv: src must list all {n} ranks")
         return P2pRequest(kind="recv", comms=self, payload=None,
                           pattern=tuple(srcs), tag=tag)
@@ -210,16 +229,42 @@ class Comms:
                     "waitall: send and recv posted on different "
                     "communicators for tag "
                     f"{r.tag} ({s.comms.axis_name} vs {r.comms.axis_name})")
-            # consistency: the sender targeting rank k must be the rank k
-            # expects — dst[src[k]] == k
+            # consistency both ways: the sender targeting rank k must be
+            # the rank k expects (dst[src[k]] == k; src -1 receives
+            # nothing), and every posted send must be claimed by its
+            # destination — an unclaimed message would otherwise vanish
+            # silently (true many-to-one needs one tag per sender)
             for k, src_k in enumerate(r.pattern):
-                expects(s.pattern[src_k] == k,
-                        "waitall: send dst pattern and recv src pattern "
-                        f"disagree at rank {k}")
-            perm = [(rank, dst) for rank, dst in enumerate(s.pattern)]
-            # permute on the axis the requests were POSTED on (not the
-            # communicator waitall happens to be called through)
-            r.data = jax.lax.ppermute(s.payload, s.comms.axis_name, perm)
+                if src_k >= 0:
+                    expects(s.pattern[src_k] == k,
+                            "waitall: send dst pattern and recv src "
+                            f"pattern disagree at rank {k}")
+            for j, dst_j in enumerate(s.pattern):
+                if dst_j >= 0:
+                    expects(r.pattern[dst_j] == j,
+                            f"waitall: rank {j}'s send to rank {dst_j} "
+                            "is not claimed by any receiver (two senders "
+                            "to one rank need distinct tags)")
+            n = s.comms.get_size()
+            is_perm = (sorted(s.pattern) == list(range(n))
+                       and min(r.pattern) >= 0)
+            if is_perm:
+                perm = [(rank, dst) for rank, dst in enumerate(s.pattern)]
+                # permute on the axis the requests were POSTED on (not
+                # the communicator waitall happens to be called through)
+                r.data = jax.lax.ppermute(s.payload, s.comms.axis_name,
+                                          perm)
+            else:
+                # many-to-one / partial fan-in: gather everyone's
+                # payload and select the named source (src -1 -> zeros)
+                gathered = jax.lax.all_gather(s.payload,
+                                              s.comms.axis_name)
+                me = jax.lax.axis_index(s.comms.axis_name)
+                src_arr = jnp.asarray(r.pattern, jnp.int32)
+                src_me = src_arr[me]
+                picked = gathered[jnp.maximum(src_me, 0)]
+                r.data = jnp.where(src_me >= 0, picked,
+                                   jnp.zeros_like(picked))
             delivered.append(r.data)
         return delivered
 

@@ -125,17 +125,24 @@ def export_ivf_pq_search(res, index, n_probes: int, k: int,
     expects(index.list_recon is not None,
             "aot: index must carry the reconstruction cache")
     metric = index.metric
+    if index.list_recon_sq is None:
+        index.list_recon_sq = ivf_pq._recon_sq(index.list_recon)
 
-    def fn(centers, list_recon, list_indices, rotation, queries):
+    def fn(centers, list_recon, list_recon_sq, list_indices, rotation,
+           queries):
+        # the precomputed norms ride in the artifact — without them the
+        # exported program would recompute a full pass over the recon
+        # cache per batch (they are runtime inputs, not constants)
         return ivf_pq._search_impl_recon(
             centers, list_recon, list_indices, rotation, queries,
-            k=k, n_probes=n_probes, metric=metric)
+            k=k, n_probes=n_probes, metric=metric,
+            list_recon_sq=list_recon_sq)
 
     example_q = jax.ShapeDtypeStruct((batch, index.dim),
                                      index.centers.dtype)
     buf = io.BytesIO()
     save_search_fn(buf, fn,
-                   (index.centers, index.list_recon, index.list_indices,
-                    index.rotation), example_q)
+                   (index.centers, index.list_recon, index.list_recon_sq,
+                    index.list_indices, index.rotation), example_q)
     buf.seek(0)
     return buf
