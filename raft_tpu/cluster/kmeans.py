@@ -201,7 +201,7 @@ def init_random(res, X: jax.Array, n_clusters: int,
 @functools.partial(jax.jit, static_argnames=("n_clusters", "max_iter",
                                              "metric", "use_fused"))
 def _lloyd(X, centroids0, sample_weight, tol, n_clusters, max_iter, metric,
-           use_fused=False):
+           use_fused=0):
     """Jitted Lloyd loop (reference: detail/kmeans.cuh:359 kmeans_fit_main).
 
     Converges on centroid shift: sum ||c_new - c_old||^2 < tol (the reference
@@ -222,7 +222,9 @@ def _lloyd(X, centroids0, sample_weight, tol, n_clusters, max_iter, metric,
         if use_fused:
             from raft_tpu.ops.kmeans_update_pallas import fused_assign_update
 
-            sums, counts = fused_assign_update(X, sample_weight, centroids)
+            sums, counts, _ = fused_assign_update(X, sample_weight,
+                                                  centroids,
+                                                  tile=use_fused)
             means = sums / jnp.maximum(counts, 1.0)[:, None]
             new_c = jnp.where((counts > 0)[:, None], means,
                               centroids.astype(jnp.float32)).astype(X.dtype)
@@ -272,11 +274,11 @@ def fit(
                       DistanceType.L2SqrtUnexpanded)
         # sqrt variants share the fused path: sqrt is monotone, so the
         # in-kernel argmin is identical; inertia is computed after the
-        # loop with the caller's metric either way
-        use_fused = (jax.default_backend() == "tpu"
-                     and params.metric in l2_metrics
-                     and kup.supported(X.shape[0], X.shape[1],
-                                       params.n_clusters, True))
+        # loop with the caller's metric either way.  use_fused carries
+        # the chosen data tile (0 = XLA path).
+        use_fused = (kup.fused_tile(X.shape[0], X.shape[1],
+                                    params.n_clusters)
+                     if params.metric in l2_metrics else 0)
 
         best = None
         # Array init is deterministic — restarts would be bit-identical.

@@ -76,16 +76,38 @@ def calc_centers_and_sizes(
 
 
 @functools.partial(jax.jit, static_argnames=("n_clusters", "n_iters",
-                                             "metric"))
-def _balanced_loop(X, centroids0, key, n_clusters, n_iters, metric):
+                                             "metric", "use_fused",
+                                             "fused_interpret"))
+def _balanced_loop(X, centroids0, key, n_clusters, n_iters, metric,
+                   use_fused=0, fused_interpret=False):
+    """``use_fused`` (TPU, L2): assignment + per-cluster sums + per-row
+    min distance come from ONE Pallas pass per iteration
+    (:mod:`raft_tpu.ops.kmeans_update_pallas`) — this loop is the inner
+    engine of every IVF coarse build, where the XLA formulation was
+    ~2 s/iteration at 1M x 4000 lists."""
     xf = X.astype(jnp.float32)
     n = xf.shape[0]
+    if use_fused:
+        from raft_tpu.ops.kmeans_update_pallas import fused_assign_update
+
+        ones = jnp.ones((n,), jnp.float32)
+        x_sq = jnp.sum(xf * xf, axis=1)     # loop-invariant
 
     def body(it, carry):
         centroids, key = carry
-        labels, dists = _assign(xf, centroids, metric)
-        centers, sizes = calc_centers_and_sizes(xf, labels, n_clusters,
-                                                old_centroids=centroids)
+        if use_fused:
+            sums, counts, dmin = fused_assign_update(
+                xf, ones, centroids, tile=use_fused,
+                interpret=fused_interpret)
+            centers = (sums / jnp.maximum(counts, 1.0)[:, None])
+            centers = jnp.where((counts > 0)[:, None], centers,
+                                centroids.astype(jnp.float32))
+            sizes = counts.astype(jnp.int32)
+            dists = jnp.maximum(x_sq + dmin, 0.0)
+        else:
+            labels, dists = _assign(xf, centroids, metric)
+            centers, sizes = calc_centers_and_sizes(
+                xf, labels, n_clusters, old_centroids=centroids)
         # balancing: re-seed under-populated clusters from far-away points
         # (the adjust_centers analogue, detail/kmeans_balanced.cuh)
         avg = jnp.float32(n) / n_clusters
@@ -138,6 +160,17 @@ def _meso_partition_sample(meso_labels, key, n_meso, per):
     return order[jnp.clip(starts[:, None] + j, 0, n - 1)]
 
 
+def _fused_ok(n, dim, k, metric) -> int:
+    """Host-side choice: the data tile for the fused Pallas
+    assignment+update kernel (TPU, L2, shapes it handles), 0 = use the
+    XLA path."""
+    from raft_tpu.ops import kmeans_update_pallas as kup
+
+    if metric != DistanceType.L2Expanded:
+        return 0
+    return kup.fused_tile(n, dim, k)
+
+
 def _fit_hierarchical(xf, n_clusters, key, n_iters, metric):
     """Two-level balanced build (the build_hierarchical analogue).
 
@@ -166,8 +199,9 @@ def _fit_hierarchical(xf, n_clusters, key, n_iters, metric):
     c0 = xf[::stride][:n_meso]
     if c0.shape[0] < n_meso:
         c0 = jnp.pad(c0, ((0, n_meso - c0.shape[0]), (0, 0)), mode="edge")
-    meso_centers, meso_labels = _balanced_loop(xf, c0, k1, n_meso,
-                                               n_iters, metric)
+    meso_centers, meso_labels = _balanced_loop(
+        xf, c0, k1, n_meso, n_iters, metric,
+        use_fused=_fused_ok(n, dim, n_meso, metric))
 
     per = min(n, max(2048, 32 * k_max))
     idx = _meso_partition_sample(meso_labels, k2, n_meso, per)
@@ -193,8 +227,9 @@ def _fit_hierarchical(xf, n_clusters, key, n_iters, metric):
     centers0 = flat[order[:n_clusters]]
 
     refine_iters = max(2, n_iters // 5)
-    centers, _ = _balanced_loop(xf, centers0, k4, n_clusters,
-                                refine_iters, metric)
+    centers, _ = _balanced_loop(
+        xf, centers0, k4, n_clusters, refine_iters, metric,
+        use_fused=_fused_ok(n, dim, n_clusters, metric))
     return centers
 
 
@@ -239,8 +274,9 @@ def fit(
         if params.metric == DistanceType.InnerProduct:
             c0 = c0 / jnp.maximum(jnp.linalg.norm(c0, axis=1, keepdims=True),
                                   1e-12)
-        centroids, _ = _balanced_loop(X, c0, key, n_clusters,
-                                      params.n_iters, params.metric)
+        centroids, _ = _balanced_loop(
+            X, c0, key, n_clusters, params.n_iters, params.metric,
+            use_fused=_fused_ok(n, X.shape[1], n_clusters, params.metric))
         return centroids
 
 

@@ -36,7 +36,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _kernel(x_ref, w_ref, c_ref, csq_ref, sums_ref, counts_ref):
+def _kernel(x_ref, w_ref, c_ref, csq_ref, sums_ref, counts_ref,
+            dmin_ref):
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -49,6 +50,9 @@ def _kernel(x_ref, w_ref, c_ref, csq_ref, sums_ref, counts_ref):
                              preferred_element_type=jnp.float32)
     d = csq_ref[...] - 2.0 * ip                      # (T, K) f32
     labels = jnp.argmin(d, axis=1)                   # (T,)
+    # per-row min of the ||x||^2-free distance form; callers add the
+    # loop-invariant row norms back (balanced k-means' re-seed sampling)
+    dmin_ref[...] = jnp.min(d, axis=1, keepdims=True)
 
     k_pad = d.shape[1]
     cols = jax.lax.broadcasted_iota(jnp.int32, d.shape, 1)
@@ -72,10 +76,12 @@ def fused_assign_update(x, weights, centroids, tile=1024, interpret=False):
     """One fused assignment+update pass.
 
     ``x`` (n, dim); ``weights`` (n,) f32; ``centroids`` (k, dim).
-    Returns ``(sums (k, dim) f32, counts (k,) f32)`` — the weighted
-    per-cluster sums and total weights; callers derive the means and
-    keep old centroids for empty clusters (update_centroids contract,
-    reference detail/kmeans.cuh:285).
+    Returns ``(sums (k, dim) f32, counts (k,) f32, dmin (n,) f32)`` —
+    the weighted per-cluster sums, total weights, and each row's
+    ``min_c(||c||^2 - 2 x.c)`` (add the row's own ``||x||^2`` for a
+    true squared distance); callers derive the means and keep old
+    centroids for empty clusters (update_centroids contract, reference
+    detail/kmeans.cuh:285).
 
     bf16 MXU passes with f32 accumulation: the one-hot factor is exact
     in bf16; x is rounded once (~1e-3 relative) — within Lloyd's
@@ -97,7 +103,7 @@ def fused_assign_update(x, weights, centroids, tile=1024, interpret=False):
     w_p = jnp.zeros((n_pad, 1), jnp.float32)
     w_p = w_p.at[:n, 0].set(weights.astype(jnp.float32))
 
-    sums, counts = pl.pallas_call(
+    sums, counts, dmin = pl.pallas_call(
         _kernel,
         grid=(n_pad // tile,),
         in_specs=[
@@ -109,21 +115,23 @@ def fused_assign_update(x, weights, centroids, tile=1024, interpret=False):
         out_specs=[
             pl.BlockSpec((k_pad, d_pad), lambda i: (0, 0)),
             pl.BlockSpec((1, k_pad), lambda i: (0, 0)),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((k_pad, d_pad), jnp.float32),
             jax.ShapeDtypeStruct((1, k_pad), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
         ],
         interpret=interpret,
     )(x_p, w_p, c_p, csq_p)
-    return sums[:k, :dim], counts[0, :k]
+    return sums[:k, :dim], counts[0, :k], dmin[:n, 0]
 
 
 def supported(n: int, dim: int, k: int, metric_is_l2: bool,
               tile: int = 1024) -> bool:
-    """Shapes the kernel handles; callers fall back to the XLA path
-    otherwise.  VMEM: x tile + distance block + one-hot + accumulator +
-    centroids must fit."""
+    """Shapes the kernel handles at this tile; callers fall back to the
+    XLA path otherwise.  VMEM: x tile + distance block + one-hot +
+    accumulator + centroids must fit."""
     k_pad = _round_up(k, 128)
     d_pad = _round_up(dim, 128)
     vmem = (tile * d_pad * 2            # x tile bf16
@@ -133,3 +141,25 @@ def supported(n: int, dim: int, k: int, metric_is_l2: bool,
             + 2 * k_pad * 4)
     return (metric_is_l2 and n >= tile and vmem <= (12 << 20)
             and k_pad * d_pad * 4 <= (4 << 20))
+
+
+def best_tile(n: int, dim: int, k: int, metric_is_l2: bool) -> int:
+    """Largest supported data tile (descending ladder), 0 if none —
+    large cluster counts shrink the tile so the (tile, K) distance and
+    one-hot blocks stay inside VMEM (k=4096 @ dim 128 fits at 256)."""
+    for tile in (1024, 512, 256):
+        if supported(n, dim, k, metric_is_l2, tile=tile):
+            return tile
+    return 0
+
+
+def fused_tile(n: int, dim: int, k: int) -> int:
+    """The ONE backend+shape gate for routing a Lloyd-style loop through
+    this kernel (kmeans.fit and kmeans_balanced share it; each checks
+    its own metric family first).  dim < 32 is unprofitable — lane
+    padding makes the bf16 tiles mostly zeros."""
+    import jax
+
+    if jax.default_backend() != "tpu" or dim < 32:
+        return 0
+    return best_tile(n, dim, k, True)

@@ -58,7 +58,7 @@ class TestFusedL2NNPallas:
         w[::11] = 0.0
         c = rng.normal(size=(k, dim)).astype(np.float32)
 
-        sums, counts = fused_assign_update(
+        sums, counts, dmin = fused_assign_update(
             jnp.asarray(x), jnp.asarray(w), jnp.asarray(c), tile=128,
             interpret=True)
 
@@ -73,6 +73,10 @@ class TestFusedL2NNPallas:
                                    rtol=2e-2, atol=2e-2)
         np.testing.assert_allclose(np.asarray(counts), ref_counts,
                                    rtol=1e-5, atol=1e-5)
+        # dmin + ||x||^2 must equal the true min squared distance
+        np.testing.assert_allclose(
+            np.asarray(dmin) + (x * x).sum(-1), d.min(1),
+            rtol=2e-2, atol=2e-2)
 
     def test_kmeans_fused_lloyd_matches_xla_lloyd(self):
         """Fused Lloyd vs the XLA path: bit-equal first step on
@@ -104,7 +108,7 @@ class TestFusedL2NNPallas:
 
         c_cur = jnp.asarray(c0)
         for it in range(20):
-            sums, counts = fused_assign_update(
+            sums, counts, _ = fused_assign_update(
                 jnp.asarray(x), jnp.asarray(w), c_cur, tile=128,
                 interpret=True)
             means = sums / jnp.maximum(counts, 1.0)[:, None]
