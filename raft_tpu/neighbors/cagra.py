@@ -35,7 +35,8 @@ loop — one dataset-row gather per candidate, 64+ rows per expanded node
 — was gather-bound at ~5 ms/iteration.  The walk now fetches ONE fat row
 per expanded node from a packed **neighborhood table**: all ``degree``
 neighbors' PCA-projected vectors (bf16) + full-precision norms and ids
-(f32/int32 bitcast into bf16 lanes) in a single (degree, pdim+4) row.
+(everything bitcast into int16 lanes — see _WalkCache for why the
+container must be an integer dtype) in a single flat row.
 Distances along the walk are approximate (exact norms, PCA cross term);
 the final buffer is re-ranked with exact distances in one dense pass.
 Entry points come from a dense (q, S) matmul against a fixed random
