@@ -14,13 +14,14 @@ TPU design (SURVEY.md §7 flags this as the XLA-hostile one):
 
 - **build** composes the existing IVF-PQ + refine exactly like the reference;
 - **prune** keeps the reference's *rank-based detour* criterion, computed in
-  node blocks (``lax.map``): per block, neighbor-of-neighbor lists are
-  sorted once and membership resolves by ``searchsorted`` —
-  O(B·deg²·log deg) and O(B·deg²) memory, never the naive
-  (n, deg, deg, deg) tensor.  The reverse-edge pass
-  (graph_core.cuh's rev_graph) is a device-side sort-based bucketing:
-  edges sorted by (dst, rank) and scattered into per-node reverse slots;
-  leftover slots take the next-best pruned-out forward edges;
+  node blocks over host-chunked dispatches: per block, membership is a
+  sorted-merge (multi-operand sort + cummax run scan — ``searchsorted``
+  measured 50x slower, and one whole-graph dispatch trips execution
+  watchdogs), never the naive (n, deg, deg, deg) tensor.  The
+  reverse-edge pass (graph_core.cuh's rev_graph) is scatter-free:
+  edges sorted by (dst, rank), slots read back by gather at
+  group_start + slot; leftover slots take the next-best pruned-out
+  forward edges;
 - **search** replaces the data-dependent walk + hashmap with a
   fixed-iteration ``lax.while_loop`` over a static (q, itopk) candidate
   buffer: each step expands the best unvisited candidates' adjacency rows,
@@ -104,11 +105,11 @@ class SearchParams:
     search_width: int = 1
     num_random_samplings: int = 1
     rand_xor_mask: int = 0x128394
-    # None -> auto: the smallest PCA dim capturing >= _WALK_ENERGY of the
-    # data's second-moment spectrum (lossless-in-practice on manifold
-    # data, and automatically large — or a full fallback to the exact
-    # walk — on flat-spectrum data where a small projection would
-    # collapse recall).  0 -> exact walk; >0 -> forced projection dim.
+    # None -> auto: the smallest PCA dim whose projected distances keep
+    # >= _WALK_FIDELITY top-k overlap with exact distances on a
+    # density-matched calibration pool (lossless-in-practice on manifold
+    # data; falls all the way back to the exact direct walk on data no
+    # projection can order).  0 -> exact walk; >0 -> forced dim.
     walk_pdim: Optional[int] = None
     entry_points: int = 4096
     rerank_topk: int = 0
