@@ -279,9 +279,11 @@ def run_conf(conf_path: str) -> None:
                                           metric=metric), db)
         elif algo == "ivf_pq":
             index = ivf_pq.build(
-                res, ivf_pq.IndexParams(n_lists=bp["nlist"],
-                                        pq_dim=bp.get("pq_dim", 0),
-                                        metric=metric), db)
+                res, ivf_pq.IndexParams(
+                    n_lists=bp["nlist"], pq_dim=bp.get("pq_dim", 0),
+                    kmeans_trainset_fraction=bp.get("trainset_fraction",
+                                                    0.5),
+                    metric=metric), db)
         elif algo == "cagra":
             index = cagra.build(
                 res, cagra.IndexParams(

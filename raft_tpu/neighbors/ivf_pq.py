@@ -452,13 +452,35 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
         else:
             new_indices = ensure_array(new_indices, "new_indices")
 
-        rot = new_vectors.astype(jnp.float32) @ index.rotation
         bal = KMeansBalancedParams()
-        labels = kmeans_balanced.predict(res, bal, rot, index.centers)
-        resid = _subspace_split(rot - index.centers[labels], index.pq_dim)
-        codes_u = _encode(index.codebooks, resid, index.codebook_kind,
-                          labels)
-        codes = _pack_codes(codes_u, index.pq_bits)
+        # chunk the rotate→assign→encode pipeline: at deep scale (10M+
+        # rows) the full-width rotation + residual transients are
+        # several copies of the dataset and OOM a single chip; per-chunk
+        # the peak extra memory is O(chunk * rot_dim)
+        chunk = 1 << 20
+        codes_parts, label_parts, recon_parts = [], [], []
+        for s0 in range(0, n_new, chunk):
+            v = new_vectors[s0:s0 + chunk]
+            rot_c = v.astype(jnp.float32) @ index.rotation
+            lab_c = kmeans_balanced.predict(res, bal, rot_c, index.centers)
+            resid_c = _subspace_split(rot_c - index.centers[lab_c],
+                                      index.pq_dim)
+            cu = _encode(index.codebooks, resid_c, index.codebook_kind,
+                         lab_c)
+            if index.list_recon is not None:
+                recon_parts.append(_decode_rows(index.codebooks, cu,
+                                                lab_c,
+                                                index.codebook_kind))
+            codes_parts.append(_pack_codes(cu, index.pq_bits))
+            label_parts.append(lab_c)
+        codes = (jnp.concatenate(codes_parts)
+                 if len(codes_parts) > 1 else codes_parts[0])
+        labels = (jnp.concatenate(label_parts)
+                  if len(label_parts) > 1 else label_parts[0])
+        recon_rows = None
+        if index.list_recon is not None:
+            recon_rows = (jnp.concatenate(recon_parts)
+                          if len(recon_parts) > 1 else recon_parts[0])
 
         new_counts = jax.ops.segment_sum(
             jnp.ones(n_new, jnp.int32), labels,
@@ -469,10 +491,9 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
         if int(jnp.max(needed)) <= index.capacity:
             bufs, rows = [index.list_codes], [codes]
             if index.list_recon is not None:
-                # the new rows' decoded residuals (+ norms) append into the
-                # caches at the same slots, in the same scatter pass
-                recon_rows = _decode_rows(index.codebooks, codes_u, labels,
-                                          index.codebook_kind)
+                # the new rows' decoded residuals (+ norms, computed in
+                # the encode chunks above) append into the caches at the
+                # same slots, in the same scatter pass
                 bufs.append(index.list_recon)
                 rows.append(recon_rows)
                 if index.list_recon_sq is not None:
@@ -543,9 +564,21 @@ def _decode_lists(centers, codebooks, list_codes, codebook_kind, pq_dim,
     (ivf_pq_search.cuh:611).
     """
     del centers  # residual space: centers fold in at search time, in fp32
-    L, cap, _ = list_codes.shape
+    L, cap, W = list_codes.shape
     pq_len = codebooks.shape[-1]
-    codes = _unpack_codes(list_codes, pq_dim, pq_bits).astype(jnp.int32)
+    mask = (1 << pq_bits) - 1
+
+    def code_at(j):
+        """Unpack subspace j's codes only — a full upfront unpack is an
+        (L, cap, pq_dim) int32 transient, 4x the packed bytes (2.5 GB at
+        deep scale); per-step it is one (L, cap) slice."""
+        bitpos = j * pq_bits
+        b0 = bitpos // 8
+        shift = bitpos % 8
+        lo = jnp.take(list_codes, b0, axis=-1).astype(jnp.int32)
+        hi = jnp.take(list_codes, jnp.minimum(b0 + 1, W - 1),
+                      axis=-1).astype(jnp.int32)
+        return ((lo | (hi << 8)) >> shift) & mask
 
     # One subspace at a time via scan + dynamic_update_slice: a single
     # (L, cap, pq_dim, pq_len) gather output gets its pq_len axis padded to
@@ -553,10 +586,11 @@ def _decode_lists(centers, codebooks, list_codes, codebook_kind, pq_dim,
     # The per-step (L, cap, pq_len) transient keeps peak memory at ~2x the
     # final (L, cap, rot_dim) cache.
     def step(acc, j):
+        cj = code_at(j)                                  # (L, cap) int32
         if codebook_kind == CodebookKind.PER_SUBSPACE:
-            part = codebooks[j][codes[:, :, j]]          # (L, cap, len)
+            part = codebooks[j][cj]                      # (L, cap, len)
         else:
-            part = codebooks[jnp.arange(L)[:, None], codes[:, :, j]]
+            part = codebooks[jnp.arange(L)[:, None], cj]
         return jax.lax.dynamic_update_slice(
             acc, part.astype(jnp.bfloat16), (0, 0, j * pq_len)), None
 
