@@ -542,49 +542,94 @@ def _walk_cache(res, index: Index, pdim: int, n_entries: int) -> _WalkCache:
     return _WalkCache(table, proj, eproj, esq, eids)
 
 
-def _merge_candidates(buf_d, buf_i, visited, cand_d, cand_i, itopk,
-                      ip_metric, worst):
+def _merge_candidates(buf_d, buf_i, visited, cand_d, cand_i, itopk):
     """Dedupe candidates against the buffer and themselves (membership
     masks — the visited-hashmap analogue; O(wd·(itopk+wd)) cheap vector
-    compares instead of the round-3 double stable argsort), then ONE
-    top-k over the concatenation."""
+    compares instead of the round-3 double stable argsort), then merge.
+
+    The buffer is kept SORTED ascending-better across iterations, so the
+    merge is one narrow candidate sort + a log2-depth bitonic merge —
+    the full-width ``top_k`` it replaces was 83% of measured iteration
+    time (round-4 ablation: 8.0 -> 1.4 ms/iter budget at itopk 64).
+    ``buf_d``/``cand_d`` are KEYS (ascending-better: d for L2, -score
+    for IP), so no metric branches are needed.
+    """
     nq, wd = cand_i.shape
     dup_buf = jnp.any(cand_i[:, :, None] == buf_i[:, None, :], axis=-1)
     earlier = jnp.tril(jnp.ones((wd, wd), jnp.bool_), k=-1)
     dup_self = jnp.any((cand_i[:, :, None] == cand_i[:, None, :])
                        & earlier[None], axis=-1)
     keep = (cand_i >= 0) & ~dup_buf & ~dup_self
-    cand_d = jnp.where(keep, cand_d, worst)
+    cand_d = jnp.where(keep, cand_d, jnp.inf)
     cand_i = jnp.where(keep, cand_i, -1)
 
-    cat_d = jnp.concatenate([buf_d, cand_d], axis=1)
-    cat_i = jnp.concatenate([buf_i, cand_i], axis=1)
-    cat_v = jnp.concatenate(
-        [visited, jnp.zeros_like(keep)], axis=1)
-    if ip_metric:
-        new_d, pos = jax.lax.top_k(cat_d, itopk)
-    else:
-        new_d, pos = jax.lax.top_k(-cat_d, itopk)
-        new_d = -new_d
-    new_i = jnp.take_along_axis(cat_i, pos, axis=1)
-    new_v = jnp.take_along_axis(cat_v, pos, axis=1)
-    return new_d, new_i, new_v
+    sk, si = jax.lax.sort((cand_d, cand_i), dimension=1, num_keys=1)
+    return _bitonic_merge(buf_d, buf_i, visited, sk, si, itopk)
 
 
-def _select_parents(buf_d, buf_i, visited, search_width, ip_metric, worst):
+def _bitonic_merge(a_k, a_i, a_v, b_k, b_i, itopk):
+    """Merge sorted-ascending (a_k, a_i, a_v) with sorted-ascending
+    (b_k, b_i, unvisited) and keep the best ``itopk``: concat
+    [a | reverse(b)] is bitonic, so log2(size) compare-exchange passes
+    sort it — no full-width sort."""
+    nq, A = a_k.shape
+    B = b_k.shape[1]
+    size = 1 << (A + B - 1).bit_length()
+    pad = size - A - B
+    if pad:
+        b_k = jnp.pad(b_k, ((0, 0), (0, pad)), constant_values=jnp.inf)
+        b_i = jnp.pad(b_i, ((0, 0), (0, pad)), constant_values=-1)
+    k = jnp.concatenate([a_k, b_k[:, ::-1]], axis=1)
+    i = jnp.concatenate([a_i, b_i[:, ::-1]], axis=1)
+    v = jnp.concatenate(
+        [a_v, jnp.zeros((nq, b_k.shape[1]), jnp.bool_)], axis=1)
+
+    stride = size // 2
+    while stride >= 1:
+        ks = k.reshape(nq, size // (2 * stride), 2, stride)
+        is_ = i.reshape(nq, size // (2 * stride), 2, stride)
+        vs = v.reshape(nq, size // (2 * stride), 2, stride)
+        swap = ks[:, :, 0] > ks[:, :, 1]
+        k = jnp.stack(
+            [jnp.where(swap, ks[:, :, 1], ks[:, :, 0]),
+             jnp.where(swap, ks[:, :, 0], ks[:, :, 1])],
+            axis=2).reshape(nq, size)
+        i = jnp.stack(
+            [jnp.where(swap, is_[:, :, 1], is_[:, :, 0]),
+             jnp.where(swap, is_[:, :, 0], is_[:, :, 1])],
+            axis=2).reshape(nq, size)
+        v = jnp.stack(
+            [jnp.where(swap, vs[:, :, 1], vs[:, :, 0]),
+             jnp.where(swap, vs[:, :, 0], vs[:, :, 1])],
+            axis=2).reshape(nq, size)
+        stride //= 2
+    return k[:, :itopk], i[:, :itopk], v[:, :itopk]
+
+
+def _select_parents(buf_d, buf_i, visited, search_width):
     """Best ``search_width`` unvisited buffer entries; marks them
-    visited.  Returns (sel_ids, parent_ok, visited)."""
-    nq = buf_d.shape[0]
-    masked = jnp.where(visited | (buf_i < 0), worst, buf_d)
-    if ip_metric:
-        sel_d, sel = jax.lax.top_k(masked, search_width)
-    else:
-        sel_d, sel = jax.lax.top_k(-masked, search_width)
-        sel_d = -sel_d
-    parent_ok = jnp.logical_not(jnp.isinf(sel_d))
-    sel_ids = jnp.take_along_axis(buf_i, sel, axis=1)
-    visited = visited.at[jnp.arange(nq)[:, None], sel].set(True)
-    return sel_ids, parent_ok, visited
+    visited.  Returns (sel_ids, parent_ok, visited).  The buffer is
+    sorted ascending-better, so the j-th best unvisited entry is the
+    j-th unvisited POSITION — ``search_width`` cheap argmin passes, no
+    top_k.  ``buf_d`` is a key (see _merge_candidates)."""
+    nq, A = buf_d.shape
+    iota = jnp.arange(A)
+    ids, oks = [], []
+    for _ in range(search_width):
+        pos = jnp.min(jnp.where(visited | (buf_i < 0)
+                                | jnp.isinf(buf_d), A, iota), axis=1)
+        ok = pos < A
+        # when no VALID unvisited entry remains, consume an arbitrary
+        # unvisited slot instead — dead (-1/inf) slots must still fill
+        # up so the while_loop's all(visited) termination fires on
+        # small indices rather than running out max_iterations
+        pos_any = jnp.min(jnp.where(visited, A, iota), axis=1)
+        pc = jnp.minimum(jnp.where(ok, pos, pos_any), A - 1)
+        ids.append(jnp.where(
+            ok, jnp.take_along_axis(buf_i, pc[:, None], axis=1)[:, 0], -1))
+        oks.append(ok)
+        visited = visited.at[jnp.arange(nq), pc].set(True)
+    return (jnp.stack(ids, axis=1), jnp.stack(oks, axis=1), visited)
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -607,7 +652,9 @@ def _search_impl_walk(dataset, table, entry_proj, entry_sq, entry_ids,
     unit = pdim + 4
     wd = search_width * deg
     ip_metric = metric == DistanceType.InnerProduct
-    worst = -jnp.inf if ip_metric else jnp.inf
+    # the walk works in KEY space (ascending-better: d for L2, -score
+    # for IP) so the sorted-buffer merge needs no metric branches
+    worst = jnp.inf
 
     qf = queries.astype(jnp.float32)
     q_sq = jnp.sum(qf * qf, axis=1)
@@ -617,7 +664,7 @@ def _search_impl_walk(dataset, table, entry_proj, entry_sq, entry_ids,
     ip_e = jax.lax.dot_general(qp, entry_proj, (((1,), (1,)), ((), ())),
                                preferred_element_type=jnp.float32)
     if ip_metric:
-        d_e = ip_e
+        d_e = -ip_e
     else:
         d_e = q_sq[:, None] + entry_sq[None, :] - 2.0 * ip_e
     S = d_e.shape[1]
@@ -628,11 +675,8 @@ def _search_impl_walk(dataset, table, entry_proj, entry_sq, entry_ids,
             [d_e, jnp.full((nq, pad), worst, jnp.float32)], axis=1)
         ids_e = jnp.concatenate(
             [ids_e, jnp.full((nq, pad), -1, jnp.int32)], axis=1)
-    if ip_metric:
-        buf_d, pos = jax.lax.top_k(d_e, itopk)
-    else:
-        buf_d, pos = jax.lax.top_k(-d_e, itopk)
-        buf_d = -buf_d
+    buf_d, pos = jax.lax.top_k(-d_e, itopk)
+    buf_d = -buf_d                     # sorted ascending key
     buf_i = jnp.take_along_axis(ids_e, pos, axis=1)
     buf_i = jnp.where(jnp.isinf(buf_d), -1, buf_i)
     visited = jnp.zeros((nq, itopk), jnp.bool_)
@@ -645,7 +689,7 @@ def _search_impl_walk(dataset, table, entry_proj, entry_sq, entry_ids,
     def body(state):
         buf_d, buf_i, visited, it = state
         sel_ids, parent_ok, visited = _select_parents(
-            buf_d, buf_i, visited, search_width, ip_metric, worst)
+            buf_d, buf_i, visited, search_width)
 
         # ONE fat row per parent: the whole neighborhood (projected
         # vectors + norms + ids) in a single scattered fetch
@@ -662,36 +706,31 @@ def _search_impl_walk(dataset, table, entry_proj, entry_sq, entry_ids,
         ipx = jnp.einsum("qp,qwdp->qwd", qp, nb_p,
                          preferred_element_type=jnp.float32)
         if ip_metric:
-            d_c = ipx
+            d_c = -ipx
         else:
             d_c = q_sq[:, None, None] + nb_sq - 2.0 * ipx
 
         buf_d, buf_i, visited = _merge_candidates(
             buf_d, buf_i, visited, d_c.reshape(nq, wd),
-            nb_id.reshape(nq, wd), itopk, ip_metric, worst)
+            nb_id.reshape(nq, wd), itopk)
         return buf_d, buf_i, visited, it + 1
 
     buf_d, buf_i, visited, _ = jax.lax.while_loop(
         cond, body, (buf_d, buf_i, visited, jnp.int32(0)))
 
     # ---- exact re-rank of the best `rerank` buffer entries ---------------
-    if ip_metric:
-        _, pos = jax.lax.top_k(buf_d, rerank)
-    else:
-        _, pos = jax.lax.top_k(-buf_d, rerank)
-    r_ids = jnp.take_along_axis(buf_i, pos, axis=1)      # (q, R)
+    # (the buffer is sorted ascending-better: the best R are a slice)
+    r_ids = buf_i[:, :rerank]                            # (q, R)
     vecs = dataset[jnp.clip(r_ids, 0, n - 1)].astype(jnp.float32)
     if ip_metric:
         d_e = jnp.einsum("qd,qrd->qr", qf, vecs,
                          preferred_element_type=jnp.float32)
+        d_e = jnp.where(r_ids >= 0, d_e, -jnp.inf)
+        out_d, pos = jax.lax.top_k(d_e, k)
     else:
         diff = qf[:, None, :] - vecs
         d_e = jnp.sum(diff * diff, axis=-1)
-    d_e = jnp.where(r_ids >= 0, d_e, worst)
-
-    if ip_metric:
-        out_d, pos = jax.lax.top_k(d_e, k)
-    else:
+        d_e = jnp.where(r_ids >= 0, d_e, jnp.inf)
         out_d, pos = jax.lax.top_k(-d_e, k)
         out_d = -out_d
     out_i = jnp.take_along_axis(r_ids, pos, axis=1)
@@ -713,15 +752,16 @@ def _search_impl(dataset, graph, queries, seed_ids, k, itopk, search_width,
     degree = graph.shape[1]
     qf = queries.astype(jnp.float32)
     ip_metric = metric == DistanceType.InnerProduct
-    worst = -jnp.inf if ip_metric else jnp.inf
+    # KEY space (ascending-better; see _merge_candidates)
+    worst = jnp.inf
 
     def dists_to(ids):
-        """(q, m) ids -> (q, m) distances to the query."""
+        """(q, m) ids -> (q, m) distance KEYS to the query."""
         vecs = dataset[ids].astype(jnp.float32)       # (q, m, d)
         ip = jnp.einsum("qd,qmd->qm", qf, vecs,
                         precision=get_matmul_precision())
         if ip_metric:
-            return ip
+            return -ip
         sq = jnp.sum(vecs * vecs, axis=-1)
         qsq = jnp.sum(qf * qf, axis=-1, keepdims=True)
         return jnp.maximum(qsq + sq - 2.0 * ip, 0.0)
@@ -739,11 +779,8 @@ def _search_impl(dataset, graph, queries, seed_ids, k, itopk, search_width,
     rank = jnp.argsort(jnp.argsort(seed_ids, axis=1), axis=1)
     seed_dup = jnp.take_along_axis(dup_sorted, rank, axis=1)
     seed_d = jnp.where(seed_dup, worst, seed_d)
-    if ip_metric:
-        buf_d, pos = jax.lax.top_k(seed_d, itopk)
-    else:
-        buf_d, pos = jax.lax.top_k(-seed_d, itopk)
-        buf_d = -buf_d
+    buf_d, pos = jax.lax.top_k(-seed_d, itopk)
+    buf_d = -buf_d                     # sorted ascending key
     buf_i = jnp.take_along_axis(seed_ids, pos, axis=1)
     buf_i = jnp.where(jnp.isinf(buf_d), -1, buf_i)
     visited = jnp.zeros((nq, itopk), jnp.bool_)
@@ -756,7 +793,7 @@ def _search_impl(dataset, graph, queries, seed_ids, k, itopk, search_width,
     def body(state):
         buf_d, buf_i, visited, it = state
         sel_ids, parent_ok, visited = _select_parents(
-            buf_d, buf_i, visited, search_width, ip_metric, worst)
+            buf_d, buf_i, visited, search_width)
 
         # expand adjacency of selected nodes
         nbrs = graph[jnp.where(parent_ok, sel_ids, 0)]     # (q, w, degree)
@@ -766,15 +803,15 @@ def _search_impl(dataset, graph, queries, seed_ids, k, itopk, search_width,
         nd = jnp.where(nbrs < 0, worst, nd)
 
         buf_d, buf_i, visited = _merge_candidates(
-            buf_d, buf_i, visited, nd, nbrs, itopk, ip_metric, worst)
+            buf_d, buf_i, visited, nd, nbrs, itopk)
         return buf_d, buf_i, visited, it + 1
 
     buf_d, buf_i, visited, _ = jax.lax.while_loop(
         cond, body, (buf_d, buf_i, visited, jnp.int32(0)))
 
-    out_d, pos = (jax.lax.top_k(buf_d, k) if ip_metric
-                  else (lambda v, p: (-v, p))(*jax.lax.top_k(-buf_d, k)))
-    out_i = jnp.take_along_axis(buf_i, pos, axis=1)
+    # sorted ascending key: the output is a slice (keys back to metric)
+    out_d = -buf_d[:, :k] if ip_metric else buf_d[:, :k]
+    out_i = buf_i[:, :k]
     if metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded):
         out_d = jnp.sqrt(jnp.maximum(out_d, 0.0))
     return out_d, out_i

@@ -139,6 +139,35 @@ class TestCagra:
             np.asarray(index.graph[:16])] ** 2, axis=-1)
         np.testing.assert_allclose(np.asarray(sq), true_sq, rtol=1e-5)
 
+    @pytest.mark.parametrize("A,B", [(64, 64), (24, 64), (32, 128),
+                                     (96, 64)])
+    def test_bitonic_merge_matches_full_sort(self, A, B):
+        """The log-depth merge must equal a full sort of the
+        concatenation for any sorted inputs (incl. non-pow2 widths and
+        +inf padding)."""
+        rng = np.random.default_rng(A * 100 + B)
+        q = 13
+        a_k = np.sort(rng.normal(size=(q, A)).astype(np.float32), axis=1)
+        b_k = np.sort(rng.normal(size=(q, B)).astype(np.float32), axis=1)
+        a_i = rng.integers(0, 10000, (q, A)).astype(np.int32)
+        b_i = rng.integers(0, 10000, (q, B)).astype(np.int32)
+        a_v = rng.random((q, A)) < 0.5
+        k, i, v = cagra._bitonic_merge(
+            jnp.asarray(a_k), jnp.asarray(a_i), jnp.asarray(a_v),
+            jnp.asarray(b_k), jnp.asarray(b_i), A)
+        cat_k = np.concatenate([a_k, b_k], axis=1)
+        order = np.argsort(cat_k, axis=1)[:, :A]
+        np.testing.assert_allclose(np.asarray(k),
+                                   np.take_along_axis(cat_k, order, 1))
+        # carried payloads follow their keys (keys here are distinct
+        # with probability 1, so the id/visited rows are determined)
+        cat_i = np.concatenate([a_i, b_i], axis=1)
+        cat_v = np.concatenate([a_v, np.zeros((q, B), bool)], axis=1)
+        np.testing.assert_array_equal(np.asarray(i),
+                                      np.take_along_axis(cat_i, order, 1))
+        np.testing.assert_array_equal(np.asarray(v),
+                                      np.take_along_axis(cat_v, order, 1))
+
     def test_prune_reverse_edges(self, res, dataset):
         db, _ = dataset
         knn = cagra.build_knn_graph(res, db, 16)
