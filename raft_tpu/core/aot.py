@@ -146,3 +146,54 @@ def export_ivf_pq_search(res, index, n_probes: int, k: int,
                     index.list_indices, index.rotation), example_q)
     buf.seek(0)
     return buf
+
+
+def export_cagra_search(res, index, k: int, batch: int, *,
+                        itopk: int = 64, search_width: int = 1,
+                        max_iterations: int = 0,
+                        walk_pdim: int = 0) -> io.BytesIO:
+    """Export the CAGRA packed-neighborhood walk at fixed (batch, k,
+    itopk, search_width) into a self-contained artifact: walk table +
+    entry set + exported walk program (reference analogue: serialized
+    CAGRA index + the per-dtype prebuilt search units in
+    cpp/src/neighbors/).
+
+    The packed table and projection are calibrated/built here (the same
+    lazy path the first live search takes) and baked into the artifact;
+    fails when the fidelity calibration rejects every projection (the
+    regime where the live search falls back to the exact direct walk —
+    that path has data-dependent random seeds and is not exported).
+    """
+    from raft_tpu.neighbors import cagra
+
+    itopk = max(itopk, k)
+    pdim = walk_pdim or cagra._auto_pdim(index)
+    expects(pdim > 0,
+            "aot: walk fidelity calibration failed — no packed walk to "
+            "export (the live fallback, the exact direct walk, is not "
+            "exportable)")
+    w_pad = -(-(index.graph_degree * (pdim + 4)) // 128) * 128
+    expects(index.size * w_pad * 2 <= cagra._WALK_TABLE_MAX_BYTES,
+            "aot: packed walk table exceeds the size gate")
+    cache = cagra._walk_cache(res, index, pdim, max(4096, itopk))
+    max_iter = max_iterations or (10 + itopk // max(search_width, 1))
+    rerank = max(min(itopk, max(32, 2 * k)), k)
+    metric = index.metric
+    deg = index.graph_degree
+
+    def fn(dataset, table, entry_proj, entry_sq, entry_ids, proj,
+           queries):
+        return cagra._search_impl_walk(
+            dataset, table, entry_proj, entry_sq, entry_ids, proj,
+            queries, k, itopk, search_width, max_iter, metric, rerank,
+            deg)
+
+    example_q = jax.ShapeDtypeStruct((batch, index.dim),
+                                     index.dataset.dtype)
+    buf = io.BytesIO()
+    save_search_fn(buf, fn,
+                   (index.dataset, cache.table, cache.entry_proj,
+                    cache.entry_sq, cache.entry_ids, cache.proj),
+                   example_q)
+    buf.seek(0)
+    return buf

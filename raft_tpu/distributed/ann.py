@@ -84,24 +84,12 @@ def build(handle, params: ivf_pq.IndexParams, dataset) -> DistributedIndex:
         expects(handle.comms_initialized(),
                 "distributed.ann.build: handle has no comms (use "
                 "CommsSession.worker_handle())")
-        comms = handle.get_comms()
-        mesh = handle.mesh
-        axis = comms.axis_name
         dataset = ensure_array(dataset, "dataset")
-        n = dataset.shape[0]
-        n_dev = mesh.shape[axis]
-        expects(n % n_dev == 0,
-                f"distributed.ann.build: n ({n}) must divide evenly over "
-                f"{n_dev} devices (pad the input)")
-        per = n // n_dev
+        comms, mesh, axis, n, n_dev, per, devs = _shard_layout(
+            handle, dataset)
         expects(params.cache_reconstructions,
                 "distributed.ann: the sharded search kernel runs the "
                 "reconstruction path; cache_reconstructions must be True")
-
-        expects(mesh.devices.ndim == 1,
-                "distributed.ann.build: a 1-D mesh is required (reshape "
-                "2D grids to the data axis for index sharding)")
-        devs = mesh.devices.ravel()
 
         from raft_tpu.cluster import kmeans_balanced as kb
 
@@ -134,20 +122,26 @@ def build(handle, params: ivf_pq.IndexParams, dataset) -> DistributedIndex:
              pad_cap(ix.list_recon, 0))
             for ix in locals_]
 
-        # Assemble each stacked leaf from per-device shards — never
-        # materializing the (n_dev, ...) stack on one device, whose HBM the
-        # full index may not fit (the regime MNMG sharding exists for).
-        placed = []
-        for li in range(len(per_shard_leaves[0])):
-            shards = [jax.device_put(per_shard_leaves[s][li][None],
-                                     devs[s]) for s in range(n_dev)]
-            shape = (n_dev,) + per_shard_leaves[0][li].shape
-            sharding = jax.sharding.NamedSharding(
-                mesh, P(axis, *([None] * (len(shape) - 1))))
-            placed.append(jax.make_array_from_single_device_arrays(
-                shape, sharding, shards))
+        placed = _stack_leaves(per_shard_leaves, mesh, axis, devs)
         return DistributedIndex.tree_unflatten(
             (params.metric, n), tuple(placed))
+
+
+def _stack_leaves(per_shard_leaves, mesh, axis, devs):
+    """Assemble (n_dev, ...) stacked leaves from per-device shards —
+    never materializing the full stack on one device, whose HBM the
+    full index may not fit (the regime MNMG sharding exists for)."""
+    n_dev = len(per_shard_leaves)
+    placed = []
+    for li in range(len(per_shard_leaves[0])):
+        shards = [jax.device_put(per_shard_leaves[s][li][None],
+                                 devs[s]) for s in range(n_dev)]
+        shape = (n_dev,) + per_shard_leaves[0][li].shape
+        sharding = jax.sharding.NamedSharding(
+            mesh, P(axis, *([None] * (len(shape) - 1))))
+        placed.append(jax.make_array_from_single_device_arrays(
+            shape, sharding, shards))
+    return placed
 
 
 def _build_spmd(handle, params: ivf_pq.IndexParams, dataset, mesh, axis,
@@ -281,3 +275,275 @@ def search(handle, params: ivf_pq.SearchParams, index: DistributedIndex,
                   index.list_recon)
         return _dist_search(leaves, queries, int(k), n_probes,
                             index.metric, comms.axis_name, handle.mesh)
+
+
+# ---------------------------------------------------------------------------
+# IVF-Flat (same shard -> local search -> all_gather -> merge seam)
+# ---------------------------------------------------------------------------
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class DistributedFlatIndex:
+    """Leaf-stacked local IVF-Flat indexes (one shard per device)."""
+
+    centers: jax.Array        # (n_dev, n_lists, dim)
+    list_data: jax.Array      # (n_dev, n_lists, cap, dim)
+    list_indices: jax.Array   # (n_dev, n_lists, cap) — GLOBAL ids
+    list_sizes: jax.Array
+    metric: int = DistanceType.L2Expanded
+    size: int = 0
+
+    @property
+    def n_shards(self) -> int:
+        return self.centers.shape[0]
+
+    def tree_flatten(self):
+        return ((self.centers, self.list_data, self.list_indices,
+                 self.list_sizes), (self.metric, self.size))
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves, metric=aux[0], size=aux[1])
+
+
+def _shard_layout(handle, dataset):
+    comms = handle.get_comms()
+    mesh = handle.mesh
+    axis = comms.axis_name
+    expects(mesh.devices.ndim == 1,
+            "distributed.ann: a 1-D mesh is required (reshape 2D grids "
+            "to the data axis for index sharding)")
+    n = dataset.shape[0]
+    n_dev = mesh.shape[axis]
+    expects(n % n_dev == 0,
+            f"distributed.ann: n ({n}) must divide evenly over "
+            f"{n_dev} devices (pad the input)")
+    return comms, mesh, axis, n, n_dev, n // n_dev, mesh.devices.ravel()
+
+
+def build_flat(handle, params, dataset) -> DistributedFlatIndex:
+    """Shard rows over the mesh and build one local IVF-Flat index per
+    shard, ids globally offset (the ANN bench ``multigpu`` seam,
+    docs/source/cuda_ann_benchmarks.md:163, for raft_ivf_flat)."""
+    from raft_tpu.neighbors import ivf_flat
+
+    with named_range("distributed::ivf_flat_build"):
+        expects(handle.comms_initialized(),
+                "distributed.ann.build_flat: handle has no comms")
+        dataset = ensure_array(dataset, "dataset")
+        comms, mesh, axis, n, n_dev, per, devs = _shard_layout(
+            handle, dataset)
+
+        locals_ = []
+        for s in range(n_dev):
+            idx = ivf_flat.build(handle, params, dataset[s * per:(s + 1) * per])
+            idx.list_indices = jnp.where(
+                idx.list_indices >= 0, idx.list_indices + s * per, -1)
+            locals_.append(idx)
+        cap = max(ix.capacity for ix in locals_)
+
+        def pad_cap(a, fill):
+            return jnp.pad(a, ((0, 0), (0, cap - a.shape[1]))
+                           + ((0, 0),) * (a.ndim - 2),
+                           constant_values=fill)
+
+        leaves = [(ix.centers, pad_cap(ix.list_data, 0),
+                   pad_cap(ix.list_indices, -1), ix.list_sizes)
+                  for ix in locals_]
+        placed = _stack_leaves(leaves, mesh, axis, devs)
+        return DistributedFlatIndex.tree_unflatten(
+            (params.metric, n), tuple(placed))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "n_probes", "metric",
+                                             "axis_name", "mesh"))
+def _dist_search_flat(leaves, queries, k, n_probes, metric, axis_name,
+                      mesh):
+    specs = tuple(P(axis_name, *([None] * (leaf.ndim - 1)))
+                  for leaf in leaves)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(specs, P()), out_specs=(P(), P()),
+                       check_vma=False)
+    def run(lv, q):
+        from raft_tpu.neighbors import ivf_flat
+        centers, list_data, list_indices, _ = lv
+        ld, li = ivf_flat._search_impl(centers[0], list_data[0],
+                                       list_indices[0], q, k, n_probes,
+                                       metric)
+        select_min = metric != DistanceType.InnerProduct
+        all_d = jax.lax.all_gather(ld, axis_name)
+        all_i = jax.lax.all_gather(li, axis_name)
+        nq = q.shape[0]
+        return select_k(
+            jnp.transpose(all_d, (1, 0, 2)).reshape(nq, -1), k,
+            in_idx=jnp.transpose(all_i, (1, 0, 2)).reshape(nq, -1),
+            select_min=select_min)
+
+    return run(leaves, queries)
+
+
+def search_flat(handle, params, index: DistributedFlatIndex, queries,
+                k: int) -> Tuple[jax.Array, jax.Array]:
+    """Sharded IVF-Flat search + merge; replicated (distances, ids)."""
+    with named_range("distributed::ivf_flat_search"):
+        expects(handle.comms_initialized(),
+                "distributed.ann.search_flat: handle has no comms")
+        comms = handle.get_comms()
+        queries = ensure_array(queries, "queries")
+        n_probes = min(params.n_probes, index.centers.shape[1])
+        leaves = (index.centers, index.list_data, index.list_indices,
+                  index.list_sizes)
+        return _dist_search_flat(leaves, queries, int(k), n_probes,
+                                 index.metric, comms.axis_name,
+                                 handle.mesh)
+
+
+# ---------------------------------------------------------------------------
+# CAGRA (reference's explicit multi-GPU seam: per-GPU graph chunks +
+# merged search, detail/cagra/graph_core.cuh:333-369)
+# ---------------------------------------------------------------------------
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class DistributedCagraIndex:
+    """Per-shard CAGRA graphs + packed walk tables, leaf-stacked.  Ids
+    inside each shard's graph/table are LOCAL (0..per-1); search maps
+    them to global ids with the shard offset.  ``use_walk=False`` (walk
+    fidelity calibration failed, or the per-shard table exceeds the
+    byte gate — the same routes single-device ``cagra.search`` takes)
+    stores (1, 1)-placeholder walk leaves and searches via the exact
+    direct walk over ``graph``."""
+
+    dataset: jax.Array        # (n_dev, per, dim)
+    graph: jax.Array          # (n_dev, per, deg)
+    table: jax.Array          # (n_dev, per, W) int16 packed neighborhoods
+    proj: jax.Array           # (n_dev, dim, pdim)
+    entry_proj: jax.Array     # (n_dev, S, pdim) bf16
+    entry_sq: jax.Array       # (n_dev, S)
+    entry_ids: jax.Array      # (n_dev, S) int32 LOCAL
+    metric: int = DistanceType.L2Expanded
+    size: int = 0
+    use_walk: bool = True
+
+    @property
+    def n_shards(self) -> int:
+        return self.dataset.shape[0]
+
+    def tree_flatten(self):
+        return ((self.dataset, self.graph, self.table, self.proj,
+                 self.entry_proj, self.entry_sq, self.entry_ids),
+                (self.metric, self.size, self.use_walk))
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves, metric=aux[0], size=aux[1], use_walk=aux[2])
+
+
+def build_cagra(handle, params, dataset) -> DistributedCagraIndex:
+    """Shard rows over the mesh and build one local CAGRA graph + packed
+    walk table per shard (reference: graph_core.cuh:333-369 builds the
+    kNN graph in per-GPU chunks; here each shard also serves its own
+    walk).  A single projection dim (calibrated on shard 0) is forced on
+    every shard so the packed tables stack; when calibration fails
+    (pdim 0) or the per-shard table exceeds the byte gate, the index
+    falls back to the exact direct walk — the same two routes
+    single-device ``cagra.search`` takes."""
+    from raft_tpu.neighbors import cagra
+
+    with named_range("distributed::cagra_build"):
+        expects(handle.comms_initialized(),
+                "distributed.ann.build_cagra: handle has no comms")
+        dataset = ensure_array(dataset, "dataset")
+        comms, mesh, axis, n, n_dev, per, devs = _shard_layout(
+            handle, dataset)
+
+        locals_, pdim, use_walk = [], None, True
+        for s in range(n_dev):
+            idx = cagra.build(handle, params, dataset[s * per:(s + 1) * per])
+            if pdim is None:
+                pdim = cagra._auto_pdim(idx)
+                deg = idx.graph_degree
+                w_pad = -(-(deg * (pdim + 4)) // 128) * 128
+                use_walk = (pdim > 0
+                            and per * w_pad * 2
+                            <= cagra._WALK_TABLE_MAX_BYTES)
+            if use_walk:
+                cache = cagra._walk_cache(handle, idx, pdim, 4096)
+                walk_leaves = (cache.table, cache.proj, cache.entry_proj,
+                               cache.entry_sq, cache.entry_ids)
+            else:
+                walk_leaves = (jnp.zeros((1, 1), jnp.int16),
+                               jnp.zeros((1, 1), jnp.float32),
+                               jnp.zeros((1, 1), jnp.bfloat16),
+                               jnp.zeros((1,), jnp.float32),
+                               jnp.zeros((1,), jnp.int32))
+            locals_.append((idx.dataset, idx.graph) + walk_leaves)
+        placed = _stack_leaves(locals_, mesh, axis, devs)
+        return DistributedCagraIndex.tree_unflatten(
+            (params.metric, n, use_walk), tuple(placed))
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "k", "itopk", "search_width", "max_iterations", "metric", "rerank",
+    "deg", "axis_name", "mesh", "use_walk"))
+def _dist_search_cagra(leaves, queries, seed_key, k, itopk, search_width,
+                       max_iterations, metric, rerank, deg, axis_name,
+                       mesh, use_walk):
+    specs = tuple(P(axis_name, *([None] * (leaf.ndim - 1)))
+                  for leaf in leaves)
+    select_min = metric != DistanceType.InnerProduct
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(specs, P(), P()), out_specs=(P(), P()),
+                       check_vma=False)
+    def run(lv, q, skey):
+        from raft_tpu.neighbors import cagra
+        ds, graph, table, proj, ep, esq, eids = lv
+        per = ds.shape[1]
+        s = jax.lax.axis_index(axis_name)
+        if use_walk:
+            d, i = cagra._search_impl_walk(
+                ds[0], table[0], ep[0], esq[0], eids[0], proj[0], q, k,
+                itopk, search_width, max_iterations, metric, rerank, deg)
+        else:
+            n_seeds = max(itopk, min(per, max(4 * itopk, 128)))
+            seed_ids = jax.random.randint(
+                jax.random.fold_in(skey, s), (q.shape[0], n_seeds), 0,
+                per, dtype=jnp.int32)
+            d, i = cagra._search_impl(ds[0], graph[0], q, seed_ids, k,
+                                      itopk, search_width,
+                                      max_iterations, metric)
+        i = jnp.where(i >= 0, i + s * per, -1)
+        all_d = jax.lax.all_gather(d, axis_name)
+        all_i = jax.lax.all_gather(i, axis_name)
+        nq = q.shape[0]
+        return select_k(
+            jnp.transpose(all_d, (1, 0, 2)).reshape(nq, -1), k,
+            in_idx=jnp.transpose(all_i, (1, 0, 2)).reshape(nq, -1),
+            select_min=select_min)
+
+    return run(leaves, queries, seed_key)
+
+
+def search_cagra(handle, params, index: DistributedCagraIndex, queries,
+                 k: int) -> Tuple[jax.Array, jax.Array]:
+    """Sharded CAGRA walk + merge; replicated (distances, global ids)."""
+    with named_range("distributed::cagra_search"):
+        expects(handle.comms_initialized(),
+                "distributed.ann.search_cagra: handle has no comms")
+        comms = handle.get_comms()
+        queries = ensure_array(queries, "queries")
+        itopk = max(params.itopk_size, k)
+        max_iter = params.max_iterations or (
+            10 + itopk // max(params.search_width, 1))
+        rerank = min(itopk, params.rerank_topk or max(32, 2 * k))
+        rerank = max(rerank, k)
+        deg = index.graph.shape[2]
+        leaves = (index.dataset, index.graph, index.table, index.proj,
+                  index.entry_proj, index.entry_sq, index.entry_ids)
+        return _dist_search_cagra(leaves, queries, handle.next_key(),
+                                  int(k), itopk, params.search_width,
+                                  max_iter, index.metric, rerank, deg,
+                                  comms.axis_name, handle.mesh,
+                                  index.use_walk)

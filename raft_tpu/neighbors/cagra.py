@@ -12,7 +12,12 @@ top-M buffer and a hashmap visited set.
 
 TPU design (SURVEY.md §7 flags this as the XLA-hostile one):
 
-- **build** composes the existing IVF-PQ + refine exactly like the reference;
+- **build** replaces the reference's streamed IVF-PQ search + refine
+  batches with a list-major pass: rows are packed into padded coarse
+  lists, each list block scores its top-t neighbor lists' contiguous
+  tile with one batched MXU GEMM in calibrated-PCA space, and the
+  oversampled survivors are exact-refined inside the same dispatch
+  (see :func:`_build_knn_graph_clustered`);
 - **prune** keeps the reference's *rank-based detour* criterion, computed in
   node blocks over host-chunked dispatches: per block, membership is a
   sorted-merge (multi-operand sort + cummax run scan — ``searchsorted``
@@ -57,30 +62,40 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.core import serialize as ser
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.core.tracing import range as named_range
 from raft_tpu.distance.types import DistanceType
-from raft_tpu.matrix.select_k import merge_topk, select_k
-from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
-from raft_tpu.neighbors.refine import refine
+from raft_tpu.matrix.select_k import select_k
 from raft_tpu.utils.precision import get_matmul_precision
-from raft_tpu.core.outputs import auto_convert_output, raw
+from raft_tpu.core.outputs import auto_convert_output
 
 
 @dataclasses.dataclass
 class IndexParams:
-    """Reference: cagra_types.hpp:41 ``index_params``."""
+    """Reference: cagra_types.hpp:41 ``index_params``.
+
+    The ``build_*`` knobs steer the cluster-blocked kNN-graph pass (the
+    analogue of the reference's IVF-PQ build params inside
+    cagra_build.cuh:43): ``build_n_lists`` coarse clusters (0 -> auto),
+    up to ``build_n_probes`` candidate lists per node block, targeting
+    ``build_candidates`` candidate rows per node, with
+    ``build_refine_rate`` × degree survivors exact-refined."""
 
     intermediate_graph_degree: int = 128
     graph_degree: int = 64
     metric: int = DistanceType.L2Expanded
-    build_pq_bits: int = 8
-    build_pq_dim: int = 0
     build_n_lists: int = 0        # 0 -> auto sqrt(n)-scaled
     build_n_probes: int = 32
     build_refine_rate: float = 2.0
+    build_candidates: int = 8192
+    build_proj_dim: int = 0       # 0 -> auto-calibrated scan PCA dim
+    build_scan_recall: float = 0.95   # approx_max_k target in the scan
+    build_reverse_rounds: int = 1     # reverse-edge merge rounds
+    build_walk_rounds: int = 2        # graph-walk refinement rounds
+    build_walk_iters: int = 8         # expansion steps per walk round
 
 
 @dataclasses.dataclass
@@ -149,6 +164,440 @@ class Index:
 # build
 # ---------------------------------------------------------------------------
 
+# datasets at or below this row count take the exact all-pairs path (one
+# fused dispatch; clustering overhead is not worth it at this scale)
+_BRUTE_BUILD_MAX = 32768
+# the projected candidate scan must place >= this fraction of the exact
+# top-(deg+1) inside its top-C oversampled candidates (recall@C, scored
+# on a density-matched sample — the same lesson as _WALK_FIDELITY)
+_BUILD_FIDELITY = 0.95
+
+
+@functools.partial(jax.jit, static_argnames=("kg", "metric", "chunk"))
+def _knn_graph_exact(dataset, kg, metric, chunk=4096):
+    """Exact all-pairs kNN graph for small n: ``lax.map`` over query
+    chunks, one f32 GEMM + select per chunk."""
+    n, dim = dataset.shape
+    xf = dataset.astype(jnp.float32)
+    x_sq = jnp.sum(xf * xf, axis=1)
+    ip_metric = metric == DistanceType.InnerProduct
+    n_pad = -(-n // chunk) * chunk
+    qp = jnp.pad(xf, ((0, n_pad - n), (0, 0)))
+
+    def one(q):
+        ip = jax.lax.dot_general(q, xf, (((1,), (1,)), ((), ())),
+                                 precision=get_matmul_precision(),
+                                 preferred_element_type=jnp.float32)
+        d = -ip if ip_metric else x_sq[None, :] - 2.0 * ip
+        _, idx = select_k(d, kg, select_min=True)
+        return idx
+
+    idx = jax.lax.map(one, qp.reshape(n_pad // chunk, chunk, dim))
+    return idx.reshape(n_pad, kg)[:n].astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("pdim", "kg", "C", "ip_metric"))
+def _calib_build_recall(queries, pool, self_col, vecs, pdim, kg, C,
+                        ip_metric=False):
+    """Fraction of the exact top-``kg`` found inside the ``pdim``-projected
+    top-``C`` — the coverage the scan + reverse-merge pipeline needs
+    (unlike :func:`_calib_overlap`, which scores symmetric top-k
+    agreement).  ``self_col`` masks each query's own pool column (the
+    guaranteed self-hit would inflate recall by ~1/kg)."""
+    dim = pool.shape[1]
+    ip = jax.lax.dot_general(queries, pool, (((1,), (1,)), ((), ())),
+                             precision=get_matmul_precision(),
+                             preferred_element_type=jnp.float32)
+    proj = vecs[:, dim - pdim:]
+    qp = (queries @ proj).astype(jnp.bfloat16)
+    pp = (pool @ proj).astype(jnp.bfloat16)
+    ipa = jax.lax.dot_general(qp, pp, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    if ip_metric:
+        d_exact, d_apx = -ip, -ipa
+    else:
+        p_sq = jnp.sum(pool * pool, axis=1)
+        d_exact = p_sq[None, :] - 2.0 * ip
+        d_apx = p_sq[None, :] - 2.0 * ipa
+    cols = jnp.arange(pool.shape[0], dtype=jnp.int32)
+    self_mask = cols[None, :] == self_col[:, None]
+    d_exact = jnp.where(self_mask, jnp.inf, d_exact)
+    d_apx = jnp.where(self_mask, jnp.inf, d_apx)
+    _, ie = select_k(d_exact, kg, select_min=True)
+    _, ia = select_k(d_apx, C, select_min=True)
+    hits = jnp.any(ie[:, :, None] == ia[:, None, :], axis=-1)
+    return jnp.mean(hits.astype(jnp.float32))
+
+
+def _build_pdim(dataset, metric, kg, C) -> Tuple[int, jax.Array]:
+    """Smallest multiple-of-8 PCA dim whose projected top-C candidates
+    cover >= _BUILD_FIDELITY of the exact top-kg on a density-matched
+    sample.  ``C`` is ~2·kg: the scan emits projected top-kg per node,
+    but the reverse-merge immediately doubles each node's exactly
+    re-ranked candidate set, so top-kg-within-top-2kg is the coverage
+    the pipeline actually needs.  Returns (pdim, eigvecs); pdim == dim
+    means rotation-only."""
+    n, dim = dataset.shape
+    mq = min(n, _WALK_CALIB_QUERIES)
+    mp = min(n, _WALK_CALIB_POOL)
+    sq_, sp_ = max(n // mq, 1), max(n // mp, 1)
+    queries = dataset[::sq_][:mq].astype(jnp.float32)
+    pool = dataset[::sp_][:mp].astype(jnp.float32)
+    mq, mp = queries.shape[0], pool.shape[0]
+    qrow = np.arange(mq, dtype=np.int64) * sq_
+    col = qrow // sp_
+    self_col = jnp.asarray(
+        np.where((qrow % sp_ == 0) & (col < mp), col, -1), dtype=jnp.int32)
+    ip_metric = metric == DistanceType.InnerProduct
+    _, vecs = jnp.linalg.eigh(_second_moment(dataset))
+    p = 16
+    while p < dim:
+        ov = float(_calib_build_recall(queries, pool, self_col, vecs, p,
+                                       kg, min(C, mp), ip_metric))
+        if ov >= _BUILD_FIDELITY:
+            return p, vecs
+        p *= 2
+    return dim, vecs
+
+
+@functools.partial(jax.jit, static_argnames=("n_lists", "cap"))
+def _build_layout(xf, labels, proj, n_lists, cap):
+    """Pack rows into the padded per-list layout the blocked scan reads:
+    per list, PCA-projected rows (bf16), exact squared norms (f32, +inf
+    padding), original ids (-1 padding) and bf16 full-dim rows.  Also
+    returns each ORIGINAL row's flat slot (for the final read-back).
+
+    The TPU analogue of the reference's dataset blocking inside
+    cagra_build.cuh:104-160 — but list-major, so every query block
+    shares one contiguous candidate tile (pure batched MXU GEMMs, no
+    per-query gathers in the scan)."""
+    n, dim = xf.shape
+    order = jnp.argsort(labels)
+    sl = labels[order]
+    sizes = jax.ops.segment_sum(jnp.ones(n, jnp.int32), labels,
+                                num_segments=n_lists)
+    starts = jnp.cumsum(sizes) - sizes
+    slot = sl * cap + (jnp.arange(n, dtype=jnp.int32) - starts[sl])
+    xp = (xf @ proj).astype(jnp.bfloat16)
+    x_sq = jnp.sum(xf * xf, axis=1)
+    pdim = proj.shape[1]
+    P_proj = jnp.zeros((n_lists * cap, pdim), jnp.bfloat16
+                       ).at[slot].set(xp[order])
+    P_sq = jnp.full((n_lists * cap,), jnp.inf, jnp.float32
+                    ).at[slot].set(x_sq[order])
+    P_id = jnp.full((n_lists * cap,), -1, jnp.int32
+                    ).at[slot].set(order.astype(jnp.int32))
+    slot_of_orig = jnp.zeros(n, jnp.int32).at[order].set(slot)
+    return (P_proj.reshape(n_lists, cap, pdim),
+            P_sq.reshape(n_lists, cap),
+            P_id.reshape(n_lists, cap), slot_of_orig)
+
+
+@functools.partial(jax.jit, static_argnames=("t", "ip_metric"))
+def _center_neighbors(centers, t, ip_metric):
+    """Top-``t`` nearest lists per list by center distance (self first)."""
+    cf = centers.astype(jnp.float32)
+    ip = jax.lax.dot_general(cf, cf, (((1,), (1,)), ((), ())),
+                             precision=get_matmul_precision(),
+                             preferred_element_type=jnp.float32)
+    d = -ip if ip_metric else jnp.sum(cf * cf, axis=1)[None, :] - 2.0 * ip
+    m = centers.shape[0]
+    d = jnp.where(jnp.eye(m, dtype=jnp.bool_), -jnp.inf, d)
+    _, nb = jax.lax.top_k(-d, t)
+    return nb.astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("cap", "kg", "ip_metric",
+                                             "LB", "rt"))
+def _scan_chunk(P_proj, P_sq, P_id, center_nbrs, list_ids,
+                cap, kg, ip_metric, LB, rt=0.95):
+    """Projected candidate scan for a chunk of lists.
+
+    Per LB-list block: ONE batched bf16 MXU GEMM scores every query in
+    the block against the block's shared (t·cap)-row candidate tile in
+    projected space (exact norms + projected cross term — the same
+    approximation the packed walk uses); ``approx_max_k`` keeps the
+    top-``kg`` ids.  No exact refine here: the reverse-merge that
+    follows re-ranks everything exactly anyway, so an in-tile refine
+    paid its gather bill twice (round-5 diet).  This replaces the
+    reference's per-query IVF-PQ search + refine_host batches
+    (cagra_build.cuh:104-171) with a list-major pass whose candidate
+    reads are contiguous."""
+    t = center_nbrs.shape[1]
+
+    def block(lb_ids):                                  # (LB,)
+        nb = center_nbrs[lb_ids]                        # (LB, t)
+        qp = P_proj[lb_ids]                             # (LB, cap, pdim)
+        cp = P_proj[nb].reshape(LB, t * cap, pdim := P_proj.shape[2])
+        csq = P_sq[nb].reshape(LB, t * cap)
+        cid = P_id[nb].reshape(LB, t * cap)
+        ip = jnp.einsum("bqp,bcp->bqc", qp, cp,
+                        preferred_element_type=jnp.float32)
+        d = -ip if ip_metric else csq[:, None, :] - 2.0 * ip
+        d = jnp.where(cid[:, None, :] >= 0, d, jnp.inf)
+
+        negd = -d.reshape(LB * cap, t * cap)
+        _, pos = jax.lax.approx_max_k(negd, kg, recall_target=rt)
+        cidf = jnp.broadcast_to(cid[:, None, :], (LB, cap, t * cap)
+                                ).reshape(LB * cap, t * cap)
+        out = jnp.take_along_axis(cidf, pos, axis=1)    # (LB*cap, kg)
+        return out.reshape(LB, cap, kg)
+
+    return jax.lax.map(block, list_ids.reshape(-1, LB)
+                       ).reshape(-1, cap, kg)
+
+
+# lists per _scan_chunk dispatch — bounds single-execution time (the
+# remote-tunnel watchdog, see _DETOUR_ROWS_PER_DISPATCH) while keeping
+# ONE compiled shape (list ids are padded to a full multiple)
+_SCAN_LISTS_PER_DISPATCH = 512
+
+
+@functools.partial(jax.jit, static_argnames=("kg", "ip_metric", "chunk",
+                                             "with_d"))
+def _merge_refine_chunked(xf, first, second, kg, ip_metric, chunk=4096,
+                          first_d=None, with_d=False):
+    """Exact re-rank of [first | second] candidate ids per node
+    (``lax.map`` over node chunks): gather bf16 rows, one f32-accumulate
+    einsum, duplicate/invalid slots masked to +inf, keep top-``kg``.
+
+    ``first_d`` (optional) carries already-exact keys for ``first`` so
+    only ``second`` is gathered/scored — the refinement rounds carry
+    their graph's distances this way, halving the gather bill.
+    ``with_d=True`` also returns the top-``kg`` keys."""
+    n, dim = xf.shape
+    xb = xf.astype(jnp.bfloat16)
+    x_sq = jnp.sum(xf * xf, axis=1)
+    m1 = first.shape[1]
+    cand = jnp.concatenate([first, second], axis=1)     # (n, m)
+    m = cand.shape[1]
+    n_pad = -(-n // chunk) * chunk
+    cand = jnp.pad(cand, ((0, n_pad - n), (0, 0)), constant_values=-1)
+    qx = jnp.pad(xb, ((0, n_pad - n), (0, 0)))
+    if first_d is not None:
+        fd = jnp.pad(first_d, ((0, n_pad - n), (0, 0)),
+                     constant_values=jnp.inf)
+    else:
+        fd = jnp.zeros((n_pad, 1), jnp.float32)   # unused placeholder
+
+    def one(args):
+        c, q, f = args                  # (chunk, m), (chunk, dim), (chunk, m1?)
+        valid = c >= 0
+        safe = jnp.where(valid, c, 0)
+        # mask duplicate ids (an id may appear in both operands): sort
+        # per row, flag equal-adjacent, map flags back by rank.  first
+        # precedes second, so carried entries win over re-scored dups.
+        cs = jnp.sort(c, axis=1)
+        dup_sorted = jnp.concatenate(
+            [jnp.zeros((c.shape[0], 1), jnp.bool_),
+             cs[:, 1:] == cs[:, :-1]], axis=1)
+        rank = jnp.argsort(jnp.argsort(c, axis=1, stable=True), axis=1)
+        dup = jnp.take_along_axis(dup_sorted, rank, axis=1)
+        if first_d is not None:
+            sc = safe[:, m1:]
+            rows = xb[sc]                               # (chunk, m2, dim)
+            ip = jnp.einsum("qd,qmd->qm", q, rows,
+                            preferred_element_type=jnp.float32)
+            d2 = -ip if ip_metric else x_sq[sc] - 2.0 * ip
+            d = jnp.concatenate([f, d2], axis=1)
+        else:
+            rows = xb[safe]                             # (chunk, m, dim)
+            ip = jnp.einsum("qd,qmd->qm", q, rows,
+                            preferred_element_type=jnp.float32)
+            d = -ip if ip_metric else x_sq[safe] - 2.0 * ip
+        d = jnp.where(valid & ~dup, d, jnp.inf)
+        nd, pos = jax.lax.top_k(-d, kg)
+        return jnp.take_along_axis(c, pos, axis=1), -nd
+
+    out, outd = jax.lax.map(one, (cand.reshape(-1, chunk, m),
+                                  qx.reshape(-1, chunk, dim),
+                                  fd.reshape(-1, chunk, fd.shape[1])))
+    out = out.reshape(n_pad, kg)[:n]
+    if with_d:
+        return out, outd.reshape(n_pad, kg)[:n]
+    return out
+
+
+def _build_knn_graph_clustered(res, dataset, kg: int, p: IndexParams
+                               ) -> jax.Array:
+    """Cluster-blocked kNN-graph pass (device-side; no per-batch host
+    loop).  Returns (n, kg) int32 ranked ids (self included)."""
+    n, dim = dataset.shape
+    xf = dataset.astype(jnp.float32)
+    ip_metric = p.metric == DistanceType.InnerProduct
+    n_lists = p.build_n_lists or max(min(n // 64, 4 * int(np.sqrt(n))), 8)
+    n_lists = min(n_lists, n)
+
+    # coarse centers on a strided subsample (strided, not leading — see
+    # _second_moment), then one fused assignment pass over all rows
+    n_train = min(n, max(n_lists * 8, max(65536, n // 10)))
+    bal = kmeans_balanced.KMeansBalancedParams(
+        n_iters=10, metric=p.metric if ip_metric
+        else DistanceType.L2Expanded)
+    trainset = xf[::max(n // n_train, 1)][:n_train]
+    centers = kmeans_balanced.fit(res, bal, trainset, n_lists)
+    labels = kmeans_balanced.predict(res, bal, xf, centers)
+    sizes = jax.ops.segment_sum(jnp.ones(n, jnp.int32), labels,
+                                num_segments=n_lists)
+    cap = max(-(-int(jnp.max(sizes)) // 8) * 8, 8)      # one host sync
+
+    # candidate width: enough lists to reach ~build_candidates candidate
+    # rows per node, never fewer than build_n_probes lists — per-LIST
+    # probing needs a wider net than the reference's per-query probes
+    # (boundary nodes; measured ceiling 0.86 at 32 small lists vs 0.96
+    # at 64 on a 40k sample)
+    mean = max(n / n_lists, 1.0)
+    t = min(n_lists,
+            max(p.build_n_probes, -(-p.build_candidates // int(mean))))
+    C = min(max(int(p.build_refine_rate * kg), kg), t * cap)
+    expects(kg <= t * cap, "cagra.build: candidate pool smaller than "
+            "intermediate degree — raise build_n_probes/build_candidates")
+
+    if p.build_proj_dim:
+        pdim = min(p.build_proj_dim, dim)
+        _, vecs = jnp.linalg.eigh(_second_moment(dataset))
+    else:
+        pdim, vecs = _build_pdim(dataset, p.metric, kg, C)
+    proj = (vecs[:, dim - pdim:] if pdim < dim
+            else jnp.eye(dim, dtype=jnp.float32))
+    P_proj, P_sq, P_id, slot_of_orig = _build_layout(
+        xf, labels, proj, n_lists, cap)
+    nbrs = _center_neighbors(centers, t, ip_metric)
+
+    # block size: bound the (LB, cap, t*cap) f32 distance transient
+    LB = max(1, min(8, (256 << 20) // max(cap * t * cap * 4, 1)))
+    CH = _SCAN_LISTS_PER_DISPATCH
+    n_pad = -(-n_lists // (LB * CH)) * (LB * CH) if n_lists > LB * CH \
+        else -(-n_lists // LB) * LB
+    ids = np.minimum(np.arange(n_pad, dtype=np.int32), n_lists - 1)
+    out = [_scan_chunk(P_proj, P_sq, P_id, nbrs,
+                       jnp.asarray(ids[s:s + LB * CH]), cap, kg,
+                       ip_metric, LB, rt=p.build_scan_recall)
+           for s in range(0, n_pad, LB * CH)]
+    out = jnp.concatenate(out, axis=0) if len(out) > 1 else out[0]
+    knn = out.reshape(-1, kg)[slot_of_orig]
+    # reverse rounds: a boundary node whose true neighbor fell outside
+    # its own list's candidate tile is usually inside that neighbor's
+    # tile — merge reverse edges and re-rank exactly (the kNN relation
+    # is nearly symmetric).  This doubles as the scan's exact refine
+    # (the scan emits projected-ranked ids only).
+    knn_d = None
+    for _ in range(max(p.build_reverse_rounds, 1)):
+        rev = _reverse_edges(knn, n, kg)
+        knn, knn_d = _merge_refine_chunked(xf, knn, rev, kg, ip_metric,
+                                           with_d=True)
+    # graph-walk refinement rounds: escape the candidate-pool ceiling
+    # entirely (see _graph_refine_round).  Skipped when no projection
+    # passed calibration (pdim == dim would pack full-dim rows: a 17 GB
+    # table at 1M, and projected ordering is unreliable there anyway).
+    if pdim < dim:
+        for _ in range(p.build_walk_rounds):
+            knn, knn_d = _graph_refine_round(
+                res, dataset, knn, kg, p.metric, pdim,
+                p.build_walk_iters, knn_d=knn_d)
+    return knn
+
+
+@functools.partial(jax.jit, static_argnames=("itopk", "iters",
+                                             "search_width", "metric",
+                                             "deg", "chunk"))
+def _self_walk_chunked(dataset, table, proj, itopk, iters, search_width,
+                       metric, deg, chunk=8192):
+    """Warm-seeded greedy walk with queries = the dataset itself
+    (``lax.map`` over node chunks): each node's buffer is seeded by
+    expanding its OWN packed-neighborhood row (so the walk starts at its
+    current approximate neighbors, not at random entries), then runs
+    ``iters`` expansion steps over the packed table.  Returns each
+    node's (itopk) candidate ids, best-first by the projected key.
+
+    This is the engine of :func:`_graph_refine_round` — unlike the
+    candidate-tile scan, its reach is not bounded by any cluster
+    geometry: each step can cross the whole graph."""
+    n, dim = dataset.shape
+    pdim = proj.shape[1]
+    unit = pdim + 4
+    ip_metric = metric == DistanceType.InnerProduct
+    n_pad = -(-n // chunk) * chunk
+    ids_all = jnp.arange(n_pad, dtype=jnp.int32).reshape(-1, chunk)
+
+    def one(ids):
+        ids_c = jnp.minimum(ids, n - 1)
+        qf = dataset[ids_c].astype(jnp.float32)
+        q_sq = jnp.sum(qf * qf, axis=1)
+        qp = (qf @ proj).astype(jnp.bfloat16)
+
+        def expand(sel_ids, parent_ok):
+            rows = table[jnp.where(parent_ok, sel_ids, 0)]
+            w = sel_ids.shape[1]
+            rows = rows[..., :deg * unit].reshape(chunk, w, deg, unit)
+            nb_p = jax.lax.bitcast_convert_type(rows[..., :pdim],
+                                                jnp.bfloat16)
+            nb_sq = jax.lax.bitcast_convert_type(
+                rows[..., pdim:pdim + 2], jnp.float32)
+            nb_id = jax.lax.bitcast_convert_type(
+                rows[..., pdim + 2:pdim + 4], jnp.int32)
+            nb_id = jnp.where(parent_ok[:, :, None], nb_id, -1)
+            ipx = jnp.einsum("qp,qwdp->qwd", qp, nb_p,
+                             preferred_element_type=jnp.float32)
+            d = -ipx if ip_metric else q_sq[:, None, None] + nb_sq \
+                - 2.0 * ipx
+            return d.reshape(chunk, w * deg), nb_id.reshape(chunk, w * deg)
+
+        # seed: expand self (one fat fetch per node)
+        d0, i0 = expand(ids_c[:, None], jnp.ones((chunk, 1), jnp.bool_))
+        if d0.shape[1] < itopk:
+            d0 = jnp.pad(d0, ((0, 0), (0, itopk - d0.shape[1])),
+                         constant_values=jnp.inf)
+            i0 = jnp.pad(i0, ((0, 0), (0, itopk - i0.shape[1])),
+                         constant_values=-1)
+        buf_d, pos = jax.lax.top_k(-d0, itopk)
+        buf_d = -buf_d
+        buf_i = jnp.take_along_axis(i0, pos, axis=1)
+        buf_i = jnp.where(jnp.isinf(buf_d), -1, buf_i)
+        # the node itself is its own nearest neighbor — pre-mark it
+        # visited so the first expansion step does not re-expand it
+        visited = buf_i == ids_c[:, None]
+
+        def body(it, state):
+            buf_d, buf_i, visited = state
+            sel_ids, parent_ok, visited = _select_parents(
+                buf_d, buf_i, visited, search_width)
+            d_c, nb_id = expand(sel_ids, parent_ok)
+            buf_d, buf_i, visited = _merge_candidates(
+                buf_d, buf_i, visited, d_c, nb_id, itopk)
+            return buf_d, buf_i, visited
+
+        _, buf_i, _ = jax.lax.fori_loop(0, iters, body,
+                                        (buf_d, buf_i, visited))
+        return buf_i
+
+    out = jax.lax.map(one, ids_all)
+    return out.reshape(n_pad, itopk)[:n]
+
+
+def _graph_refine_round(res, dataset, knn, kg, metric, pdim, iters,
+                        itopk=0, knn_d=None):
+    """One graph-walk refinement round: pack the current graph's best
+    edges into a walk table, self-walk every node, and exact-rerank
+    [current neighbors | walk buffer].  Monotone: the rerank set
+    contains the current neighbors, so per-node recall cannot drop.
+    Returns (knn, exact keys) for the next round's carry.
+
+    This is how the build escapes the candidate-pool ceiling of any
+    clustered scan (measured at 1M: per-list pools cap at ~0.47
+    recall@128 even at 2x the candidate budget; the walk's reach is the
+    whole graph)."""
+    deg_t = min(kg, 64)
+    itopk = itopk or min(max(-(-kg * 3 // 2) // 32 * 32, 64), 256)
+    ip_metric = metric == DistanceType.InnerProduct
+    table, proj = _build_walk_table(dataset, knn[:, :deg_t], pdim)
+    cand = _self_walk_chunked(dataset, table, proj, itopk, iters, 1,
+                              metric, deg_t)
+    return _merge_refine_chunked(dataset.astype(jnp.float32), knn, cand,
+                                 kg, ip_metric, first_d=knn_d,
+                                 with_d=True)
+
+
 def build_knn_graph(
     res,
     dataset,
@@ -157,34 +606,30 @@ def build_knn_graph(
     params: Optional[IndexParams] = None,
     batch: int = 8192,
 ) -> jax.Array:
-    """All-nodes kNN graph via IVF-PQ + exact refine
-    (reference: cagra.cuh:77 → cagra_build.cuh:43-171).
-    Returns (n, intermediate_degree) int32 (self-edges removed).
+    """All-nodes kNN graph (reference: cagra.cuh:77 →
+    cagra_build.cuh:43-171 — there: IVF-PQ build + batched search with
+    gpu_top_k = 2×degree + refine_host).  Returns
+    (n, intermediate_degree) int32 (self-edges removed).
+
+    TPU design: the reference streams per-query IVF-PQ searches; here
+    the whole pass is list-major — rows are packed into padded coarse
+    lists, each list block scans its top-t neighbor lists' contiguous
+    tile with one batched MXU GEMM in calibrated-PCA space, and the
+    oversampled survivors are exact-refined in the same fused dispatch
+    (round 5; the round-4 host loop over 123 search+refine batches was
+    ~200 s of the 250 s 1M build).  ``batch`` is the query chunk of the
+    small-n exact path.
     """
     with named_range("cagra::build_knn_graph"):
         dataset = ensure_array(dataset, "dataset")
         n, dim = dataset.shape
         p = params or IndexParams()
-        n_lists = p.build_n_lists or max(min(n // 64, 4 * int(np.sqrt(n))), 8)
-        pq_params = ivf_pq_mod.IndexParams(
-            n_lists=n_lists, metric=p.metric, pq_bits=p.build_pq_bits,
-            pq_dim=p.build_pq_dim, kmeans_n_iters=10)
-        pq_index = ivf_pq_mod.build(res, pq_params, dataset)
-        sp = ivf_pq_mod.SearchParams(n_probes=min(p.build_n_probes, n_lists))
-
-        # gpu_top_k = refine_rate × degree oversampling, +1 for self hit
-        top_k = min(int(p.build_refine_rate * intermediate_degree) + 1, n)
-        rows = []
-        for start in range(0, n, batch):
-            q = dataset[start:start + batch]
-            _, cand = raw(ivf_pq_mod.search)(res, sp, pq_index, q, top_k)
-            _, idx = raw(refine)(res, dataset, q, cand,
-                            min(intermediate_degree + 1, top_k),
-                            metric=DistanceType.L2Expanded
-                            if p.metric != DistanceType.InnerProduct
-                            else p.metric)
-            rows.append(idx)
-        knn = jnp.concatenate(rows, axis=0)           # (n, deg+1)
+        kg = min(intermediate_degree + 1, n)
+        if n <= _BRUTE_BUILD_MAX:
+            knn = _knn_graph_exact(dataset, kg, p.metric,
+                                   chunk=min(batch, 4096))
+        else:
+            knn = _build_knn_graph_clustered(res, dataset, kg, p)
 
         # drop self-edges: shift left where the first column is the node
         ids = jnp.arange(n, dtype=knn.dtype)[:, None]
@@ -201,43 +646,60 @@ def _detour_chunk(knn_graph, blocks, block=256):
 
     Membership (is neighbor r in neighbor rp's adjacency?) is a
     **sorted-merge**: concat [adjacency row | keys] per (node, rp),
-    one multi-operand ``lax.sort`` by (value, source-tag), run-aware
-    member flags via two ``cummax`` scans (robust to duplicate ids on
-    either side), and a second small sort carrying the flags back into
-    key order.  The earlier ``searchsorted`` formulation lowered to
-    serial per-key gathers — measured **50x slower** on TPU than this
-    all-sort form (profiles round 4: 50.0 s vs 0.97 s per 32k rows).
+    one ``lax.sort`` by (value, source-tag), run-aware member flags via
+    two ``cummax`` scans (robust to duplicate ids on either side), and
+    a second small sort carrying the flags back into key order.  The
+    earlier ``searchsorted`` formulation lowered to serial per-key
+    gathers — measured **50x slower** on TPU than this all-sort form
+    (profiles round 4: 50.0 s vs 0.97 s per 32k rows).  When ids fit,
+    (value, tag, rank) are packed into ONE int32 key so both sorts are
+    single-operand — the multi-operand form cost ~1.6x more (round 5).
     """
     n, deg = knn_graph.shape
     rank = jnp.arange(deg)
+    packed = n * 2 * deg < 2**31
+    iota = jnp.arange(2 * deg, dtype=jnp.int32)
 
     def one_block(kb):                               # (B, deg)
         B = kb.shape[0]
         non = knn_graph[jnp.clip(kb, 0, n - 1)]      # (B, rp=deg, deg)
         keys = jnp.broadcast_to(kb[:, None, :], (B, deg, deg))
-        vals = jnp.concatenate([non, keys], axis=-1)           # (B,deg,2deg)
-        tags = jnp.concatenate(
-            [jnp.zeros((B, deg, deg), jnp.int32),
-             jnp.ones((B, deg, deg), jnp.int32)], -1)
-        ridx = jnp.concatenate(
-            [jnp.zeros((B, deg, deg), jnp.int32),
-             jnp.broadcast_to(rank[None, None, :], (B, deg, deg))], -1)
-        sv, st, sr = jax.lax.sort((vals, tags, ridx), dimension=-1,
-                                  num_keys=2)
+        if packed:
+            # key = val*(2deg) + (tag ? deg + r : 0): sorts by
+            # (val, tag, r) with ONE operand, decoded after
+            adj_k = non * (2 * deg)
+            key_k = keys * (2 * deg) + deg + rank[None, None, :]
+            sk = jax.lax.sort(
+                jnp.concatenate([adj_k, key_k], axis=-1), dimension=-1)
+            sv = sk // (2 * deg)
+            rem = sk - sv * (2 * deg)
+            st1 = rem >= deg                         # from the key side
+            sr = rem - deg
+        else:
+            vals = jnp.concatenate([non, keys], axis=-1)       # (B,deg,2deg)
+            tags = jnp.concatenate(
+                [jnp.zeros((B, deg, deg), jnp.int32),
+                 jnp.ones((B, deg, deg), jnp.int32)], -1)
+            ridx = jnp.concatenate(
+                [jnp.zeros((B, deg, deg), jnp.int32),
+                 jnp.broadcast_to(rank[None, None, :], (B, deg, deg))], -1)
+            sv, st, sr = jax.lax.sort((vals, tags, ridx), dimension=-1,
+                                      num_keys=2)
+            st1 = st == 1
         # run-aware membership: a key is a member iff its equal-value
         # run contains an adjacency (tag==0) element
-        iota = jnp.arange(2 * deg, dtype=jnp.int32)
         is_start = jnp.concatenate(
             [jnp.ones_like(sv[..., :1], jnp.bool_),
              sv[..., 1:] != sv[..., :-1]], -1)
         run_start = jax.lax.cummax(jnp.where(is_start, iota, 0), axis=2)
-        last_sn = jax.lax.cummax(jnp.where(st == 0, iota, -1), axis=2)
-        is_member_key = (st == 1) & (last_sn >= run_start)
-        # flags back into key order r (non-keys past the end via sentinel)
-        sr2 = jnp.where(st == 1, sr, deg)
-        _, member_r = jax.lax.sort((sr2, is_member_key.astype(jnp.int32)),
-                                   dimension=-1, num_keys=1)
-        member = member_r[..., :deg].astype(jnp.bool_)         # (B, rp, r)
+        last_sn = jax.lax.cummax(jnp.where(~st1, iota, -1), axis=2)
+        is_member_key = st1 & (last_sn >= run_start)
+        # flags back into key order r via one packed single-operand
+        # sort: key2 = sr2*2 + member (non-keys to the end via sentinel)
+        sr2 = jnp.where(st1, sr, deg)
+        sk2 = jax.lax.sort(sr2 * 2 + is_member_key.astype(jnp.int32),
+                           dimension=-1)
+        member = (sk2[..., :deg] & 1).astype(jnp.bool_)        # (B, rp, r)
 
         stronger = rank[:, None] < rank[None, :]     # first hop rp < r
         detours = jnp.sum(member & stronger[None], axis=1)   # (B, deg)
@@ -262,11 +724,11 @@ def _detour_order(knn_graph, block=256):
     stronger edge.  Edges are ordered by (detour_count, original rank);
     callers slice the first ``graph_degree`` columns.
 
-    Blocked: ``lax.map`` over node blocks; per block the neighbor-of-
-    neighbor lists (B, deg, deg) are sorted once and each membership
-    resolves via ``searchsorted`` — O(B·deg²) memory, no
-    (n, deg, deg, deg) intermediate (that is ~2×10¹⁵ elements at the
-    reference's 1M×128 defaults).  The blocks are dispatched in
+    Blocked: ``lax.map`` over node blocks; per block membership resolves
+    via the multi-operand sorted-merge in :func:`_detour_chunk` —
+    O(B·deg²) memory, no (n, deg, deg, deg) intermediate (that is
+    ~2×10¹⁵ elements at the reference's 1M×128 defaults).  The blocks
+    are dispatched in
     fixed-size host chunks (two compiled shapes max) so no single
     device execution runs long enough to trip execution watchdogs.
     """
@@ -414,12 +876,14 @@ _WALK_CALIB_K = 10
 
 
 @functools.partial(jax.jit, static_argnames=("pdim", "k", "ip_metric"))
-def _calib_overlap(queries, pool, vecs, pdim, k, ip_metric=False):
+def _calib_overlap(queries, pool, self_col, vecs, pdim, k, ip_metric=False):
     """Top-k overlap between exact and pdim-projected distances for
     calibration queries against a candidate pool — scored under the
     index's own metric (an IP walk ranks purely by the projected cross
     term; gating it on L2 overlap would let the exact-norm term mask
-    cross-term error)."""
+    cross-term error).  ``self_col`` (q,) is each query's own column in
+    the pool (-1 when absent): the guaranteed self-match would inflate
+    overlap by ~1/k, silently loosening the fidelity gate."""
     dim = pool.shape[1]
     ip = jax.lax.dot_general(queries, pool, (((1,), (1,)), ((), ())),
                              precision=get_matmul_precision(),
@@ -435,8 +899,12 @@ def _calib_overlap(queries, pool, vecs, pdim, k, ip_metric=False):
         p_sq = jnp.sum(pool * pool, axis=1)
         d_exact = p_sq[None, :] - 2.0 * ip
         d_apx = p_sq[None, :] - 2.0 * ipa
-    _, ie = jax.lax.top_k(-d_exact, k + 1)   # +1: query may be in pool
-    _, ia = jax.lax.top_k(-d_apx, k + 1)
+    cols = jnp.arange(pool.shape[0], dtype=jnp.int32)
+    self_mask = cols[None, :] == self_col[:, None]
+    d_exact = jnp.where(self_mask, jnp.inf, d_exact)
+    d_apx = jnp.where(self_mask, jnp.inf, d_apx)
+    _, ie = jax.lax.top_k(-d_exact, k)
+    _, ia = jax.lax.top_k(-d_apx, k)
     hits = jnp.any(ie[:, :, None] == ia[:, None, :], axis=-1)
     return jnp.mean(hits.astype(jnp.float32))
 
@@ -454,14 +922,23 @@ def _auto_pdim(index: Index) -> int:
         # gaps approach index-scale density
         mq = min(n, _WALK_CALIB_QUERIES)
         mp = min(n, _WALK_CALIB_POOL)
-        queries = index.dataset[::max(n // mq, 1)][:mq].astype(jnp.float32)
-        pool = index.dataset[::max(n // mp, 1)][:mp].astype(jnp.float32)
+        sq_, sp_ = max(n // mq, 1), max(n // mp, 1)
+        queries = index.dataset[::sq_][:mq].astype(jnp.float32)
+        pool = index.dataset[::sp_][:mp].astype(jnp.float32)
+        mq, mp = queries.shape[0], pool.shape[0]
+        # each query is dataset row i*sq_; it sits in the pool at column
+        # i*sq_/sp_ when divisible — mask that self column in the overlap
+        qrow = np.arange(mq, dtype=np.int64) * sq_
+        col = qrow // sp_
+        self_col = jnp.asarray(
+            np.where((qrow % sp_ == 0) & (col < mp), col, -1),
+            dtype=jnp.int32)
         ip_metric = index.metric == DistanceType.InnerProduct
         _, vecs = jnp.linalg.eigh(_second_moment(index.dataset))
         p = 8
         cached = 0
         while p < dim:
-            ov = float(_calib_overlap(queries, pool, vecs, p,
+            ov = float(_calib_overlap(queries, pool, self_col, vecs, p,
                                       _WALK_CALIB_K, ip_metric))
             if ov >= _WALK_FIDELITY:
                 cached = p
@@ -471,7 +948,7 @@ def _auto_pdim(index: Index) -> int:
             # full-dim projection = rotation only, but the packed table
             # is bf16 — if even that loses the ordering (tight clusters
             # with |x| >> NN gaps), 0 routes to the exact direct walk
-            ov = float(_calib_overlap(queries, pool, vecs, dim,
+            ov = float(_calib_overlap(queries, pool, self_col, vecs, dim,
                                       _WALK_CALIB_K, ip_metric))
             cached = dim if ov >= _WALK_FIDELITY else 0
         object.__setattr__(index, "_walk_auto_pdim", cached)
@@ -520,9 +997,11 @@ def _build_entry_set(dataset, proj, key, n_entries):
 def _walk_cache(res, index: Index, pdim: int, n_entries: int) -> _WalkCache:
     """Get-or-build the packed neighborhood table (mutates the index —
     the cache stays attached, same lazy pattern as ivf_flat's
-    ``list_data_sq``).  The big table is cached PER pdim; the small
-    entry set per (pdim, n_entries) — a second entry size must not
-    duplicate the multi-GB table."""
+    ``list_data_sq``).  At most ONE table is kept: a caller sweeping
+    ``walk_pdim`` values would otherwise accumulate several multi-GB
+    tables until the index is dropped.  The small entry sets are cached
+    per (pdim, n_entries) — a second entry size must not rebuild the
+    multi-GB table."""
     pdim = min(pdim, index.dim)
     n_entries = min(n_entries, index.size)
     tables = getattr(index, "_walk_tables", None)
@@ -531,6 +1010,7 @@ def _walk_cache(res, index: Index, pdim: int, n_entries: int) -> _WalkCache:
         object.__setattr__(index, "_walk_tables", tables)
         object.__setattr__(index, "_walk_entries", {})
     if pdim not in tables:
+        tables.clear()                     # evict any previous-pdim table
         tables[pdim] = _build_walk_table(index.dataset, index.graph, pdim)
     table, proj = tables[pdim]
     entries = index._walk_entries
@@ -844,7 +1324,11 @@ def search(res, params: SearchParams, index: Index, queries, k: int
         pdim = 0
         if params.walk_pdim != 0 and not traced:
             pdim = min(params.walk_pdim or _auto_pdim(index), index.dim)
-        table_bytes = index.size * index.graph_degree * (pdim + 4) * 2
+        # the packed table pads its row width to 128 int16 lanes — the
+        # gate must use the padded width or small deg*(pdim+4) rows can
+        # exceed the cap by up to ~33%
+        w_pad = -(-(index.graph_degree * (pdim + 4)) // 128) * 128
+        table_bytes = index.size * w_pad * 2
         if pdim > 0 and table_bytes <= _WALK_TABLE_MAX_BYTES:
             cache = _walk_cache(res, index, pdim,
                                 max(params.entry_points, itopk))

@@ -156,7 +156,11 @@ class Index:
 
     @property
     def pq_dim(self) -> int:
-        return self.pq_dim_ or self.list_codes.shape[2]
+        # derive from rotation/codebook shapes, NOT list_codes.shape[2]:
+        # codes are bit-packed, so their trailing axis is the packed byte
+        # width W != pq_dim whenever pq_bits < 8 — an Index constructed
+        # directly with default pq_dim_=0 must still decode correctly
+        return self.pq_dim_ or self.rotation.shape[1] // self.codebooks.shape[2]
 
     @property
     def code_width(self) -> int:

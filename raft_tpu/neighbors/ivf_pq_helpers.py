@@ -1,0 +1,105 @@
+"""Public IVF-PQ list-data helpers.
+
+Reference: raft/neighbors/ivf_pq_helpers.cuh — the tuning/inspection
+surface over a built index's per-list storage: ``unpack_list_data``
+(codes out of the bit-packed list layout), ``pack_list_data`` (codes
+back in), and ``reconstruct_list_data`` (decode codes to approximate
+dataset vectors).  The reference operates in-place on device buffers;
+here the pack path returns the functionally-updated :class:`Index`
+(JAX arrays are immutable) with its derived reconstruction caches kept
+consistent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import ensure_array
+from raft_tpu.core.outputs import auto_convert_output
+from raft_tpu.neighbors.ivf_pq import (
+    Index,
+    _decode_rows,
+    _pack_codes,
+    _recon_sq,
+    _unpack_codes,
+)
+
+
+def _row_bounds(index: Index, label: int, offset: int,
+                n_rows: Optional[int]) -> int:
+    expects(0 <= label < index.n_lists,
+            "ivf_pq_helpers: list label out of range")
+    size = int(index.list_sizes[label])
+    expects(0 <= offset <= size,
+            f"ivf_pq_helpers: offset {offset} > list size {size}")
+    if n_rows is None:
+        n_rows = size - offset
+    expects(offset + n_rows <= size,
+            f"ivf_pq_helpers: offset+n_rows {offset + n_rows} > list "
+            f"size {size}")
+    return n_rows
+
+
+@auto_convert_output
+def unpack_list_data(res, index: Index, label: int, *, offset: int = 0,
+                     n_rows: Optional[int] = None) -> jax.Array:
+    """Flat (n_rows, pq_dim) uint8 codes of one list, starting at
+    ``offset`` (reference: ivf_pq_helpers.cuh ``unpack_list_data`` /
+    ``unpack_contiguous_list_data``)."""
+    n_rows = _row_bounds(index, label, offset, n_rows)
+    packed = jax.lax.dynamic_slice_in_dim(index.list_codes[label], offset,
+                                          n_rows, axis=0)
+    return _unpack_codes(packed, index.pq_dim, index.pq_bits)
+
+
+def pack_list_data(res, index: Index, label: int, codes, *,
+                   offset: int = 0) -> Index:
+    """Write flat (n_rows, pq_dim) uint8 codes into one list at
+    ``offset`` (reference: ivf_pq_helpers.cuh ``pack_list_data``);
+    returns the updated index.  The rows must already exist (this edits
+    codes in place; use ``extend`` to add rows).  The bf16
+    reconstruction cache, when attached, is re-decoded for the edited
+    rows so searches stay consistent."""
+    codes = ensure_array(codes, "codes")
+    expects(codes.ndim == 2 and codes.shape[1] == index.pq_dim,
+            "ivf_pq_helpers.pack_list_data: (n_rows, pq_dim) codes "
+            "required")
+    n_rows = codes.shape[0]
+    _row_bounds(index, label, offset, n_rows)
+    packed = _pack_codes(codes.astype(jnp.uint8), index.pq_bits)
+    upd = {"list_codes": index.list_codes.at[
+        label, offset:offset + n_rows].set(packed)}
+    if index.list_recon is not None:
+        labels = jnp.full((n_rows,), label, jnp.int32)
+        recon = _decode_rows(index.codebooks, codes.astype(jnp.uint8),
+                             labels, index.codebook_kind)
+        upd["list_recon"] = index.list_recon.at[
+            label, offset:offset + n_rows].set(recon)
+        if index.list_recon_sq is not None:
+            upd["list_recon_sq"] = index.list_recon_sq.at[
+                label, offset:offset + n_rows].set(
+                    _recon_sq(recon[None])[0])
+    return dataclasses.replace(index, **upd)
+
+
+@auto_convert_output
+def reconstruct_list_data(res, index: Index, label: int, *,
+                          offset: int = 0,
+                          n_rows: Optional[int] = None) -> jax.Array:
+    """Decode one list's codes back to approximate dataset vectors
+    (n_rows, dim) float32 (reference: ivf_pq_helpers.cuh
+    ``reconstruct_list_data``): residual reconstruction + list center,
+    rotated back through the orthonormal transform."""
+    n_rows = _row_bounds(index, label, offset, n_rows)
+    codes = unpack_list_data.__wrapped__(res, index, label, offset=offset,
+                                         n_rows=n_rows)
+    labels = jnp.full((n_rows,), label, jnp.int32)
+    recon = _decode_rows(index.codebooks, codes, labels,
+                         index.codebook_kind).astype(jnp.float32)
+    x_rot = recon + index.centers[label][None, :]
+    return x_rot @ index.rotation.T

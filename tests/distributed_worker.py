@@ -100,5 +100,68 @@ assert np.isfinite(c).all()
 d = ((c[:, None, :] - centers_true[None]) ** 2).sum(-1)
 assert (d.min(0) < 4.0).all(), d.min(0)
 
+# ---- p2p across the process boundary (reference: test_comms.py's
+# send/recv suites run per transport; here the ppermute ring necessarily
+# crosses the OS-process boundary on a 2-device-per-process mesh) ------
+
+
+def _ring_shift():
+    v = jax.lax.axis_index(session.axis_name).astype(jnp.float32)[None]
+    s = comms.device_send(v, 1)          # rank r's value -> rank r+1
+    return comms.allgather(s)
+
+
+out = replicated(_ring_shift)()
+got = np.asarray(out.addressable_data(0)).ravel()
+np.testing.assert_array_equal(
+    got, np.roll(np.arange(n_dev, dtype=np.float32), 1))
+
+
+def _isend_irecv():
+    v = 10.0 + jax.lax.axis_index(session.axis_name).astype(jnp.float32)
+    sreq = comms.isend(v[None], [(r - 1) % n_dev for r in range(n_dev)],
+                       tag=7)
+    rreq = comms.irecv([(r + 1) % n_dev for r in range(n_dev)], tag=7)
+    (data,) = comms.waitall([sreq, rreq])
+    return comms.allgather(data)
+
+
+out = replicated(_isend_irecv)()
+got = np.asarray(out.addressable_data(0)).ravel()
+np.testing.assert_array_equal(
+    got, 10.0 + (np.arange(n_dev) + 1) % n_dev)
+
 session.destroy()
+
+# ---- 2D comm_split over the cross-process mesh (reference:
+# test_comms.py:199-248 runs the full suite on sub-communicators; the
+# (row, col) grid here spans both OS processes) ------------------------
+from raft_tpu.comms import make_2d_session  # noqa: E402
+
+assert n_dev % 2 == 0
+s2 = make_2d_session(2, n_dev // 2, devices=devs).init()
+c2 = s2.comms()
+row = c2.comm_split("row")
+col = c2.comm_split("col")
+grp = c2.comm_split(grouped_by="row")    # same row -> communicate on col
+
+
+def _grid():
+    ri = jax.lax.axis_index("row").astype(jnp.float32)
+    ci = jax.lax.axis_index("col").astype(jnp.float32)
+    a = row.allreduce(ri, op_t.SUM)      # sum of row indices = 1
+    b = col.allreduce(ci, op_t.SUM)      # sum of col indices
+    g = grp.allreduce(ci, op_t.SUM)      # grouped_by row == along col
+    return jnp.stack([a, b, g])[None]
+
+
+out = jax.jit(jax.shard_map(_grid, mesh=s2.mesh, in_specs=(),
+                            out_specs=P(), check_vma=False))()
+a, b, g = np.asarray(out.addressable_data(0)).ravel()
+cols = n_dev // 2
+assert a == 1.0, a
+assert b == cols * (cols - 1) / 2, b
+assert g == b, (g, b)
+s2.destroy()
+
 print(f"MULTIPROC_OK rank={proc_id} ndev={n_dev}", flush=True)

@@ -180,3 +180,30 @@ class TestAot:
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
         np.testing.assert_allclose(np.asarray(d1), np.asarray(d2),
                                    rtol=1e-5, atol=1e-5)
+
+    def test_cagra_search_artifact(self, res):
+        """CAGRA walk deployment artifact: the walk table + entry set +
+        exported walk program reload into a callable that matches the
+        live packed-walk search exactly."""
+        from raft_tpu.core import aot
+        from raft_tpu.neighbors import cagra
+
+        rng = np.random.default_rng(1)
+        lat = rng.normal(size=(2048 + 16, 8)).astype(np.float32)
+        A = rng.normal(size=(8, 32)).astype(np.float32)
+        X = jnp.asarray(lat @ A)
+        db, q = X[:2048], X[2048:]
+        index = cagra.build(
+            res, cagra.IndexParams(intermediate_graph_degree=32,
+                                   graph_degree=16), db)
+        buf = aot.export_cagra_search(res, index, k=5, batch=16,
+                                      itopk=32)
+        g = aot.load_search_fn(buf)
+        d1, i1 = g(q)
+        assert np.asarray(i1).shape == (16, 5)
+        # live search at the same operating point agrees
+        d2, i2 = cagra.search(
+            res, cagra.SearchParams(itopk_size=32, search_width=1),
+            index, q, 5)
+        same = np.mean(np.asarray(i1) == np.asarray(i2))
+        assert same == 1.0, same
