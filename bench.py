@@ -298,6 +298,9 @@ def run_conf(conf_path: str) -> None:
                         graph_degree=bp.get("graph_degree", 64),
                         intermediate_graph_degree=bp.get(
                             "intermediate_graph_degree", 128),
+                        build_n_lists=bp.get("nlist", 0),
+                        build_n_probes=bp.get("build_n_probes", 32),
+                        build_candidates=bp.get("build_candidates", 8192),
                         metric=metric), mg_db)
             else:
                 raise ValueError(f"unknown multigpu algo {algo}")
@@ -321,6 +324,9 @@ def run_conf(conf_path: str) -> None:
                     graph_degree=bp.get("graph_degree", 64),
                     intermediate_graph_degree=bp.get(
                         "intermediate_graph_degree", 128),
+                    build_n_lists=bp.get("nlist", 0),
+                    build_n_probes=bp.get("build_n_probes", 32),
+                    build_candidates=bp.get("build_candidates", 8192),
                     metric=metric), db)
         else:
             raise ValueError(f"unknown algo {algo}")

@@ -51,11 +51,12 @@ def main():
         time.perf_counter() - t0, 1), "graph_recall": grec(knn)}),
         flush=True)
 
-    pdim, _ = 16, None
+    pdim, knn_d = 16, None
     for r in range(1, 4):
         t0 = time.perf_counter()
-        knn = cagra._graph_refine_round(res, db, knn, kg, p.metric, pdim,
-                                        p.build_walk_iters)
+        knn, knn_d = cagra._graph_refine_round(res, db, knn, kg, p.metric,
+                                               pdim, p.build_walk_iters,
+                                               knn_d=knn_d)
         np.asarray(knn[0, 0])
         out = {"stage": f"walk_round{r}",
                "s": round(time.perf_counter() - t0, 1),

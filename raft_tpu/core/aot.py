@@ -172,28 +172,45 @@ def export_cagra_search(res, index, k: int, batch: int, *,
             "aot: walk fidelity calibration failed — no packed walk to "
             "export (the live fallback, the exact direct walk, is not "
             "exportable)")
-    w_pad = -(-(index.graph_degree * (pdim + 4)) // 128) * 128
-    expects(index.size * w_pad * 2 <= cagra._WALK_TABLE_MAX_BYTES,
-            "aot: packed walk table exceeds the size gate")
-    cache = cagra._walk_cache(res, index, pdim, max(4096, itopk))
+    # same format ladder the live search uses (bf16, else the quantized
+    # deep-scale format) — the exporter must cover every index the live
+    # packed walk serves
+    fmt = cagra._search_table_format(index, pdim)
+    expects(fmt is not None,
+            "aot: no packed walk table format fits the size gate")
+    pdim, quant = fmt
+    cache = cagra._walk_cache(res, index, pdim, max(4096, itopk),
+                              quant=quant)
     max_iter = max_iterations or (10 + itopk // max(search_width, 1))
     rerank = max(min(itopk, max(32, 2 * k)), k)
     metric = index.metric
     deg = index.graph_degree
 
-    def fn(dataset, table, entry_proj, entry_sq, entry_ids, proj,
-           queries):
-        return cagra._search_impl_walk(
-            dataset, table, entry_proj, entry_sq, entry_ids, proj,
-            queries, k, itopk, search_width, max_iter, metric, rerank,
-            deg)
+    if quant:
+        def fn(dataset, table, entry_proj, entry_sq, entry_ids, proj,
+               scales, queries):
+            return cagra._search_impl_walk(
+                dataset, table, entry_proj, entry_sq, entry_ids, proj,
+                queries, k, itopk, search_width, max_iter, metric,
+                rerank, deg, quant=True, scales=scales)
+
+        arrays = (index.dataset, cache.table, cache.entry_proj,
+                  cache.entry_sq, cache.entry_ids, cache.proj,
+                  cache.scales)
+    else:
+        def fn(dataset, table, entry_proj, entry_sq, entry_ids, proj,
+               queries):
+            return cagra._search_impl_walk(
+                dataset, table, entry_proj, entry_sq, entry_ids, proj,
+                queries, k, itopk, search_width, max_iter, metric,
+                rerank, deg)
+
+        arrays = (index.dataset, cache.table, cache.entry_proj,
+                  cache.entry_sq, cache.entry_ids, cache.proj)
 
     example_q = jax.ShapeDtypeStruct((batch, index.dim),
                                      index.dataset.dtype)
     buf = io.BytesIO()
-    save_search_fn(buf, fn,
-                   (index.dataset, cache.table, cache.entry_proj,
-                    cache.entry_sq, cache.entry_ids, cache.proj),
-                   example_q)
+    save_search_fn(buf, fn, arrays, example_q)
     buf.seek(0)
     return buf

@@ -463,11 +463,9 @@ def build_cagra(handle, params, dataset) -> DistributedCagraIndex:
             idx = cagra.build(handle, params, dataset[s * per:(s + 1) * per])
             if pdim is None:
                 pdim = cagra._auto_pdim(idx)
-                deg = idx.graph_degree
-                w_pad = -(-(deg * (pdim + 4)) // 128) * 128
-                use_walk = (pdim > 0
-                            and per * w_pad * 2
-                            <= cagra._WALK_TABLE_MAX_BYTES)
+                use_walk = (pdim > 0 and cagra._table_bytes(
+                    per, idx.graph_degree, pdim, False)
+                    <= cagra._WALK_TABLE_MAX_BYTES)
             if use_walk:
                 cache = cagra._walk_cache(handle, idx, pdim, 4096)
                 walk_leaves = (cache.table, cache.proj, cache.entry_proj,
@@ -486,10 +484,10 @@ def build_cagra(handle, params, dataset) -> DistributedCagraIndex:
 
 @functools.partial(jax.jit, static_argnames=(
     "k", "itopk", "search_width", "max_iterations", "metric", "rerank",
-    "deg", "axis_name", "mesh", "use_walk"))
+    "deg", "axis_name", "mesh", "use_walk", "n_samplings"))
 def _dist_search_cagra(leaves, queries, seed_key, k, itopk, search_width,
                        max_iterations, metric, rerank, deg, axis_name,
-                       mesh, use_walk):
+                       mesh, use_walk, n_samplings=1):
     specs = tuple(P(axis_name, *([None] * (leaf.ndim - 1)))
                   for leaf in leaves)
     select_min = metric != DistanceType.InnerProduct
@@ -507,7 +505,9 @@ def _dist_search_cagra(leaves, queries, seed_key, k, itopk, search_width,
                 ds[0], table[0], ep[0], esq[0], eids[0], proj[0], q, k,
                 itopk, search_width, max_iterations, metric, rerank, deg)
         else:
-            n_seeds = max(itopk, min(per, max(4 * itopk, 128)))
+            # same seed-count formula as single-device cagra.search
+            n_seeds = max(itopk,
+                          min(per, max(n_samplings * 4 * itopk, 128)))
             seed_ids = jax.random.randint(
                 jax.random.fold_in(skey, s), (q.shape[0], n_seeds), 0,
                 per, dtype=jnp.int32)
@@ -546,4 +546,6 @@ def search_cagra(handle, params, index: DistributedCagraIndex, queries,
                                   int(k), itopk, params.search_width,
                                   max_iter, index.metric, rerank, deg,
                                   comms.axis_name, handle.mesh,
-                                  index.use_walk)
+                                  index.use_walk,
+                                  n_samplings=max(
+                                      params.num_random_samplings, 1))

@@ -238,16 +238,13 @@ def _build_pdim(dataset, metric, kg, C) -> Tuple[int, jax.Array]:
     the pipeline actually needs.  Returns (pdim, eigvecs); pdim == dim
     means rotation-only."""
     n, dim = dataset.shape
-    mq = min(n, _WALK_CALIB_QUERIES)
-    mp = min(n, _WALK_CALIB_POOL)
-    sq_, sp_ = max(n // mq, 1), max(n // mp, 1)
-    queries = dataset[::sq_][:mq].astype(jnp.float32)
-    pool = dataset[::sp_][:mp].astype(jnp.float32)
-    mq, mp = queries.shape[0], pool.shape[0]
-    qrow = np.arange(mq, dtype=np.int64) * sq_
-    col = qrow // sp_
-    self_col = jnp.asarray(
-        np.where((qrow % sp_ == 0) & (col < mp), col, -1), dtype=jnp.int32)
+    # a smaller pool than the search-time calibration: the scan only
+    # seeds the walk-refinement rounds, so its fidelity gate need not
+    # resolve index-scale NN gaps (and the wide select over the pool is
+    # per-pdim-try cost)
+    queries, pool, self_col = _calib_sample(dataset,
+                                            _WALK_CALIB_POOL // 2)
+    mp = pool.shape[0]
     ip_metric = metric == DistanceType.InnerProduct
     _, vecs = jnp.linalg.eigh(_second_moment(dataset))
     p = 16
@@ -261,11 +258,11 @@ def _build_pdim(dataset, metric, kg, C) -> Tuple[int, jax.Array]:
 
 
 @functools.partial(jax.jit, static_argnames=("n_lists", "cap"))
-def _build_layout(xf, labels, proj, n_lists, cap):
+def _build_layout(xf, xp32, labels, n_lists, cap):
     """Pack rows into the padded per-list layout the blocked scan reads:
-    per list, PCA-projected rows (bf16), exact squared norms (f32, +inf
-    padding), original ids (-1 padding) and bf16 full-dim rows.  Also
-    returns each ORIGINAL row's flat slot (for the final read-back).
+    per list, PCA-projected rows (bf16, ``xp32`` precomputed by the
+    caller), exact squared norms (f32, +inf padding) and original ids
+    (-1 padding).
 
     The TPU analogue of the reference's dataset blocking inside
     cagra_build.cuh:104-160 — but list-major, so every query block
@@ -278,19 +275,18 @@ def _build_layout(xf, labels, proj, n_lists, cap):
                                 num_segments=n_lists)
     starts = jnp.cumsum(sizes) - sizes
     slot = sl * cap + (jnp.arange(n, dtype=jnp.int32) - starts[sl])
-    xp = (xf @ proj).astype(jnp.bfloat16)
+    xp = xp32.astype(jnp.bfloat16)
     x_sq = jnp.sum(xf * xf, axis=1)
-    pdim = proj.shape[1]
+    pdim = xp32.shape[1]
     P_proj = jnp.zeros((n_lists * cap, pdim), jnp.bfloat16
                        ).at[slot].set(xp[order])
     P_sq = jnp.full((n_lists * cap,), jnp.inf, jnp.float32
                     ).at[slot].set(x_sq[order])
     P_id = jnp.full((n_lists * cap,), -1, jnp.int32
                     ).at[slot].set(order.astype(jnp.int32))
-    slot_of_orig = jnp.zeros(n, jnp.int32).at[order].set(slot)
     return (P_proj.reshape(n_lists, cap, pdim),
             P_sq.reshape(n_lists, cap),
-            P_id.reshape(n_lists, cap), slot_of_orig)
+            P_id.reshape(n_lists, cap))
 
 
 @functools.partial(jax.jit, static_argnames=("t", "ip_metric"))
@@ -351,6 +347,64 @@ def _scan_chunk(P_proj, P_sq, P_id, center_nbrs, list_ids,
 # remote-tunnel watchdog, see _DETOUR_ROWS_PER_DISPATCH) while keeping
 # ONE compiled shape (list ids are padded to a full multiple)
 _SCAN_LISTS_PER_DISPATCH = 512
+
+# above this edge count the reverse-edge sort runs on the host: the
+# device path's argsort transients (~3 edge-list copies) plus the padded
+# (n, kg) carriers exceed HBM in the deep-scale regime
+_REV_HOST_EDGES = 200_000_000
+
+# row count at which the build switches to the deep-scale memory
+# regime (in-place fused walk rounds, host reverse/prune tails)
+_DEEP_SCALE_ROWS = 4_000_000
+_HBM_BYTES = 16 << 30
+
+
+def _deep_walk_round(dataset, knn, kg, metric, pdim, iters, vecs=None):
+    """One fused in-place walk-refinement round for the deep-scale
+    regime: the packed table is sized to the HBM headroom left by the
+    dataset and the (lane-padded) knn carrier, and the walk + exact
+    rerank run per chunk inside one donated dispatch
+    (:func:`_walk_refine_fused`)."""
+    n, dim = dataset.shape
+    budget = min(_WALK_TABLE_MAX_BYTES,
+                 _HBM_BYTES - n * dim * 4
+                 - n * (-(-kg // 128) * 128) * 4 - (3 << 30))
+    itopk = min(max(-(-(kg + 16) // 32) * 32, 64), 256)
+    plan = _table_plan(n, kg, pdim, budget, deep=True)
+    if plan is None:
+        return knn                 # no table fits: round skipped
+    table, proj, scales, q = _build_refine_table(dataset, knn, plan,
+                                                 vecs)
+    return _walk_refine_fused(dataset, knn, table, proj, scales, kg,
+                              itopk, iters, metric, plan[0], quant=q)
+
+
+def _reverse_edges_host(fwd: np.ndarray, n: int, rev_cap: int
+                        ) -> np.ndarray:
+    """Host twin of :func:`_reverse_edges` (same (dst asc, rank asc)
+    semantics) for the deep-scale regime."""
+    kg = fwd.shape[1]
+    dst = fwd.T.ravel()
+    src = np.tile(np.arange(n, dtype=np.int32), kg)
+    order = np.argsort(dst, kind="stable")
+    dsts = dst[order]
+    srcs = src[order]
+    starts = np.searchsorted(dsts, np.arange(n))
+    counts = np.searchsorted(dsts, np.arange(n), side="right") - starts
+    idx = starts[:, None] + np.arange(rev_cap)[None, :]
+    rev = srcs[np.clip(idx, 0, dsts.shape[0] - 1)]
+    valid = np.arange(rev_cap)[None, :] < counts[:, None]
+    return np.where(valid, rev, -1).astype(np.int32)
+
+
+def _reverse_edges_auto(knn, n, rev_cap):
+    """Device reverse edges, or the host counting-sort fallback when the
+    edge-list sort transients would not fit next to the deep-scale
+    carriers."""
+    kg = knn.shape[1]
+    if n * kg <= _REV_HOST_EDGES:
+        return _reverse_edges(knn, n, rev_cap)
+    return jnp.asarray(_reverse_edges_host(np.asarray(knn), n, rev_cap))
 
 
 @functools.partial(jax.jit, static_argnames=("kg", "ip_metric", "chunk",
@@ -428,15 +482,30 @@ def _build_knn_graph_clustered(res, dataset, kg: int, p: IndexParams
     n_lists = p.build_n_lists or max(min(n // 64, 4 * int(np.sqrt(n))), 8)
     n_lists = min(n_lists, n)
 
+    # projection FIRST: clustering, assignment and the candidate scan
+    # all run in the calibrated-PCA space — the full-dim f32 assignment
+    # pass alone was ~24 PFLOP at 10M x 12649 lists (~20 min on chip);
+    # projected it is dim/pdim (8x at 128->16) cheaper, and the scan
+    # scores in this space anyway so the pipeline stays self-consistent
+    C = max(int(p.build_refine_rate * kg), kg)
+    if p.build_proj_dim:
+        pdim = min(p.build_proj_dim, dim)
+        _, vecs = jnp.linalg.eigh(_second_moment(dataset))
+    else:
+        pdim, vecs = _build_pdim(dataset, p.metric, kg, C)
+    proj = (vecs[:, dim - pdim:] if pdim < dim
+            else jnp.eye(dim, dtype=jnp.float32))
+    xp32 = xf @ proj                                   # (n, pdim) f32
+
     # coarse centers on a strided subsample (strided, not leading — see
-    # _second_moment), then one fused assignment pass over all rows
+    # _second_moment), then one assignment pass over all rows
     n_train = min(n, max(n_lists * 8, max(65536, n // 10)))
     bal = kmeans_balanced.KMeansBalancedParams(
         n_iters=10, metric=p.metric if ip_metric
         else DistanceType.L2Expanded)
-    trainset = xf[::max(n // n_train, 1)][:n_train]
+    trainset = xp32[::max(n // n_train, 1)][:n_train]
     centers = kmeans_balanced.fit(res, bal, trainset, n_lists)
-    labels = kmeans_balanced.predict(res, bal, xf, centers)
+    labels = kmeans_balanced.predict(res, bal, xp32, centers)
     sizes = jax.ops.segment_sum(jnp.ones(n, jnp.int32), labels,
                                 num_segments=n_lists)
     cap = max(-(-int(jnp.max(sizes)) // 8) * 8, 8)      # one host sync
@@ -449,19 +518,11 @@ def _build_knn_graph_clustered(res, dataset, kg: int, p: IndexParams
     mean = max(n / n_lists, 1.0)
     t = min(n_lists,
             max(p.build_n_probes, -(-p.build_candidates // int(mean))))
-    C = min(max(int(p.build_refine_rate * kg), kg), t * cap)
     expects(kg <= t * cap, "cagra.build: candidate pool smaller than "
             "intermediate degree — raise build_n_probes/build_candidates")
 
-    if p.build_proj_dim:
-        pdim = min(p.build_proj_dim, dim)
-        _, vecs = jnp.linalg.eigh(_second_moment(dataset))
-    else:
-        pdim, vecs = _build_pdim(dataset, p.metric, kg, C)
-    proj = (vecs[:, dim - pdim:] if pdim < dim
-            else jnp.eye(dim, dtype=jnp.float32))
-    P_proj, P_sq, P_id, slot_of_orig = _build_layout(
-        xf, labels, proj, n_lists, cap)
+    P_proj, P_sq, P_id = _build_layout(xf, xp32, labels, n_lists, cap)
+    del xp32
     nbrs = _center_neighbors(centers, t, ip_metric)
 
     # block size: bound the (LB, cap, t*cap) f32 distance transient
@@ -470,39 +531,63 @@ def _build_knn_graph_clustered(res, dataset, kg: int, p: IndexParams
     n_pad = -(-n_lists // (LB * CH)) * (LB * CH) if n_lists > LB * CH \
         else -(-n_lists // LB) * LB
     ids = np.minimum(np.arange(n_pad, dtype=np.int32), n_lists - 1)
-    out = [_scan_chunk(P_proj, P_sq, P_id, nbrs,
-                       jnp.asarray(ids[s:s + LB * CH]), cap, kg,
-                       ip_metric, LB, rt=p.build_scan_recall)
-           for s in range(0, n_pad, LB * CH)]
-    out = jnp.concatenate(out, axis=0) if len(out) > 1 else out[0]
-    knn = out.reshape(-1, kg)[slot_of_orig]
-    # reverse rounds: a boundary node whose true neighbor fell outside
+    # scatter each chunk's rows straight into the (n, kg) output by the
+    # chunk lists' original ids — the flat (n_lists_pad*cap, kg) slot
+    # array this replaces cost 8.8 GB at 10M (TPU lane padding doubles
+    # any (rows, kg<=128) int32 array)
+    knn = jnp.full((n, kg), -1, jnp.int32)
+    for s in range(0, n_pad, LB * CH):
+        cid = jnp.asarray(ids[s:s + LB * CH])
+        out_c = _scan_chunk(P_proj, P_sq, P_id, nbrs, cid, cap, kg,
+                            ip_metric, LB, rt=p.build_scan_recall)
+        rows = P_id[cid].reshape(-1)               # original ids (-1 pad)
+        rows = jnp.where(rows >= 0, rows, n)       # pad -> dropped
+        knn = knn.at[rows].set(out_c.reshape(-1, kg), mode="drop")
+    # reverse edges: a boundary node whose true neighbor fell outside
     # its own list's candidate tile is usually inside that neighbor's
-    # tile — merge reverse edges and re-rank exactly (the kNN relation
-    # is nearly symmetric).  This doubles as the scan's exact refine
-    # (the scan emits projected-ranked ids only).
+    # tile (the kNN relation is nearly symmetric).  They join the FIRST
+    # refinement rerank below instead of paying their own full-width
+    # exact pass (round-5 diet: the standalone reverse-merge was 17 s
+    # of the 1M build).
+    rev = _reverse_edges_auto(knn, n, min(kg, 64))
+    deep = n >= _DEEP_SCALE_ROWS
+    if deep:
+        # deep-scale memory regime (TPU lane padding makes EVERY
+        # (n, w<=128) int32 array n*512 bytes): fold the reverse edges
+        # immediately and drop them, then run fused in-place rounds
+        knn = _merge_refine_inplace(dataset, knn, rev, kg, ip_metric)
+        rev = None
+        if pdim < dim:
+            for _ in range(p.build_walk_rounds):
+                knn = _deep_walk_round(dataset, knn, kg, p.metric, pdim,
+                                       p.build_walk_iters, vecs=vecs)
+        return knn
     knn_d = None
-    for _ in range(max(p.build_reverse_rounds, 1)):
-        rev = _reverse_edges(knn, n, kg)
-        knn, knn_d = _merge_refine_chunked(xf, knn, rev, kg, ip_metric,
-                                           with_d=True)
-    # graph-walk refinement rounds: escape the candidate-pool ceiling
-    # entirely (see _graph_refine_round).  Skipped when no projection
-    # passed calibration (pdim == dim would pack full-dim rows: a 17 GB
-    # table at 1M, and projected ordering is unreliable there anyway).
-    if pdim < dim:
-        for _ in range(p.build_walk_rounds):
+    if pdim < dim and p.build_walk_rounds > 0:
+        # graph-walk refinement rounds: escape the candidate-pool
+        # ceiling entirely (see _graph_refine_round).  Skipped when no
+        # projection passed calibration (pdim == dim would pack
+        # full-dim rows: a 17 GB table at 1M, and projected ordering is
+        # unreliable there anyway).
+        for r in range(p.build_walk_rounds):
             knn, knn_d = _graph_refine_round(
                 res, dataset, knn, kg, p.metric, pdim,
-                p.build_walk_iters, knn_d=knn_d)
+                p.build_walk_iters, knn_d=knn_d,
+                extra=rev if r == 0 else None, vecs=vecs)
+    else:
+        for r in range(max(p.build_reverse_rounds, 1)):
+            if r > 0:
+                rev = _reverse_edges_auto(knn, n, min(kg, 64))
+            knn, knn_d = _merge_refine_chunked(xf, knn, rev, kg,
+                                               ip_metric, with_d=True)
     return knn
 
 
 @functools.partial(jax.jit, static_argnames=("itopk", "iters",
                                              "search_width", "metric",
-                                             "deg", "chunk"))
+                                             "deg", "chunk", "quant"))
 def _self_walk_chunked(dataset, table, proj, itopk, iters, search_width,
-                       metric, deg, chunk=8192):
+                       metric, deg, chunk=8192, quant=False, scales=None):
     """Warm-seeded greedy walk with queries = the dataset itself
     (``lax.map`` over node chunks): each node's buffer is seeded by
     expanding its OWN packed-neighborhood row (so the walk starts at its
@@ -513,9 +598,7 @@ def _self_walk_chunked(dataset, table, proj, itopk, iters, search_width,
     This is the engine of :func:`_graph_refine_round` — unlike the
     candidate-tile scan, its reach is not bounded by any cluster
     geometry: each step can cross the whole graph."""
-    n, dim = dataset.shape
-    pdim = proj.shape[1]
-    unit = pdim + 4
+    n = dataset.shape[0]
     ip_metric = metric == DistanceType.InnerProduct
     n_pad = -(-n // chunk) * chunk
     ids_all = jnp.arange(n_pad, dtype=jnp.int32).reshape(-1, chunk)
@@ -523,76 +606,184 @@ def _self_walk_chunked(dataset, table, proj, itopk, iters, search_width,
     def one(ids):
         ids_c = jnp.minimum(ids, n - 1)
         qf = dataset[ids_c].astype(jnp.float32)
-        q_sq = jnp.sum(qf * qf, axis=1)
-        qp = (qf @ proj).astype(jnp.bfloat16)
-
-        def expand(sel_ids, parent_ok):
-            rows = table[jnp.where(parent_ok, sel_ids, 0)]
-            w = sel_ids.shape[1]
-            rows = rows[..., :deg * unit].reshape(chunk, w, deg, unit)
-            nb_p = jax.lax.bitcast_convert_type(rows[..., :pdim],
-                                                jnp.bfloat16)
-            nb_sq = jax.lax.bitcast_convert_type(
-                rows[..., pdim:pdim + 2], jnp.float32)
-            nb_id = jax.lax.bitcast_convert_type(
-                rows[..., pdim + 2:pdim + 4], jnp.int32)
-            nb_id = jnp.where(parent_ok[:, :, None], nb_id, -1)
-            ipx = jnp.einsum("qp,qwdp->qwd", qp, nb_p,
-                             preferred_element_type=jnp.float32)
-            d = -ipx if ip_metric else q_sq[:, None, None] + nb_sq \
-                - 2.0 * ipx
-            return d.reshape(chunk, w * deg), nb_id.reshape(chunk, w * deg)
-
-        # seed: expand self (one fat fetch per node)
-        d0, i0 = expand(ids_c[:, None], jnp.ones((chunk, 1), jnp.bool_))
-        if d0.shape[1] < itopk:
-            d0 = jnp.pad(d0, ((0, 0), (0, itopk - d0.shape[1])),
-                         constant_values=jnp.inf)
-            i0 = jnp.pad(i0, ((0, 0), (0, itopk - i0.shape[1])),
-                         constant_values=-1)
-        buf_d, pos = jax.lax.top_k(-d0, itopk)
-        buf_d = -buf_d
-        buf_i = jnp.take_along_axis(i0, pos, axis=1)
-        buf_i = jnp.where(jnp.isinf(buf_d), -1, buf_i)
-        # the node itself is its own nearest neighbor — pre-mark it
-        # visited so the first expansion step does not re-expand it
-        visited = buf_i == ids_c[:, None]
-
-        def body(it, state):
-            buf_d, buf_i, visited = state
-            sel_ids, parent_ok, visited = _select_parents(
-                buf_d, buf_i, visited, search_width)
-            d_c, nb_id = expand(sel_ids, parent_ok)
-            buf_d, buf_i, visited = _merge_candidates(
-                buf_d, buf_i, visited, d_c, nb_id, itopk)
-            return buf_d, buf_i, visited
-
-        _, buf_i, _ = jax.lax.fori_loop(0, iters, body,
-                                        (buf_d, buf_i, visited))
-        return buf_i
+        return _walk_chunk_body(qf, ids_c, table, proj, scales, itopk,
+                                iters, search_width, ip_metric, deg,
+                                quant)
 
     out = jax.lax.map(one, ids_all)
     return out.reshape(n_pad, itopk)[:n]
 
 
+def _walk_chunk_body(qf, ids_c, table, proj, scales, itopk, iters,
+                     search_width, ip_metric, deg, quant):
+    """Warm-seeded walk for one chunk of self-queries (the shared engine
+    of :func:`_self_walk_chunked` and :func:`_walk_refine_fused`):
+    buffer seeded by expanding each node's OWN packed row, then
+    ``iters`` expansion steps.  Returns (chunk, itopk) candidate ids."""
+    chunk = qf.shape[0]
+    pdim = proj.shape[1]
+    unit = _quant_unit(pdim) if quant else pdim + 4
+    q_sq = jnp.sum(qf * qf, axis=1)
+    qpf = qf @ proj
+    if quant:
+        qpf = qpf * (scales[0] / 127.0)
+    qp = qpf.astype(jnp.bfloat16)
+
+    def expand(sel_ids, parent_ok):
+        rows = table[jnp.where(parent_ok, sel_ids, 0)]
+        w = sel_ids.shape[1]
+        rows = rows[..., :deg * unit].reshape(chunk, w, deg, unit)
+        nb_p, nb_sq, nb_id = _decode_neighborhood(rows, pdim, deg,
+                                                  quant, scales)
+        nb_id = jnp.where(parent_ok[:, :, None], nb_id, -1)
+        ipx = jnp.einsum("qp,qwdp->qwd", qp, nb_p,
+                         preferred_element_type=jnp.float32)
+        d = -ipx if ip_metric else q_sq[:, None, None] + nb_sq \
+            - 2.0 * ipx
+        return d.reshape(chunk, w * deg), nb_id.reshape(chunk, w * deg)
+
+    # seed: expand self (one fat fetch per node)
+    d0, i0 = expand(ids_c[:, None], jnp.ones((chunk, 1), jnp.bool_))
+    if d0.shape[1] < itopk:
+        d0 = jnp.pad(d0, ((0, 0), (0, itopk - d0.shape[1])),
+                     constant_values=jnp.inf)
+        i0 = jnp.pad(i0, ((0, 0), (0, itopk - i0.shape[1])),
+                     constant_values=-1)
+    buf_d, pos = jax.lax.top_k(-d0, itopk)
+    buf_d = -buf_d
+    buf_i = jnp.take_along_axis(i0, pos, axis=1)
+    buf_i = jnp.where(jnp.isinf(buf_d), -1, buf_i)
+    # the node itself is its own nearest neighbor — pre-mark it
+    # visited so the first expansion step does not re-expand it
+    visited = buf_i == ids_c[:, None]
+
+    def body(it, state):
+        buf_d, buf_i, visited = state
+        sel_ids, parent_ok, visited = _select_parents(
+            buf_d, buf_i, visited, search_width)
+        d_c, nb_id = expand(sel_ids, parent_ok)
+        buf_d, buf_i, visited = _merge_candidates(
+            buf_d, buf_i, visited, d_c, nb_id, itopk)
+        return buf_d, buf_i, visited
+
+    _, buf_i, _ = jax.lax.fori_loop(0, iters, body,
+                                    (buf_d, buf_i, visited))
+    return buf_i
+
+
+@functools.partial(jax.jit, static_argnames=("kg", "itopk", "iters",
+                                             "metric", "deg", "chunk",
+                                             "quant"),
+                   donate_argnums=(1,))
+def _walk_refine_fused(dataset, knn, table, proj, scales, kg, itopk,
+                       iters, metric, deg, chunk=8192, quant=False):
+    """Deep-scale walk-refinement round: walk + exact rerank fused per
+    node chunk inside ONE donated ``fori_loop``, updating ``knn`` in
+    place — neither the (n, itopk) candidate array nor a second (n, kg)
+    output ever exists (each is ~5 GB at 10M after TPU lane padding).
+    Rows are processed once, so in-place chunk updates cannot corrupt a
+    later chunk's inputs (the walk reads the packed TABLE, a snapshot,
+    not ``knn``)."""
+    n, dim = dataset.shape
+    ip_metric = metric == DistanceType.InnerProduct
+    x_sq_all = jnp.sum(dataset.astype(jnp.float32) ** 2, axis=1)
+    n_chunks = -(-n // chunk)
+
+    def body(ci, carry):
+        start = jnp.minimum(ci * chunk, n - chunk)
+        ids_c = start + jnp.arange(chunk, dtype=jnp.int32)
+        qf = jax.lax.dynamic_slice(dataset, (start, 0),
+                                   (chunk, dim)).astype(jnp.float32)
+        cand = _walk_chunk_body(qf, ids_c, table, proj, scales, itopk,
+                                iters, 1, ip_metric, deg, quant)
+        old = jax.lax.dynamic_slice(carry, (start, 0), (chunk, kg))
+        new_rows = _rerank_rows(dataset, x_sq_all, qf, old, cand, kg,
+                                ip_metric)
+        return jax.lax.dynamic_update_slice(carry, new_rows, (start, 0))
+
+    return jax.lax.fori_loop(0, n_chunks, body, knn)
+
+
+def _rerank_rows(dataset, x_sq_all, qf, old, cand, kg, ip_metric):
+    """Exact rerank of [old | cand] ids for one chunk of self-queries
+    (gathered rows cast to bf16 AFTER the gather — a full bf16 dataset
+    copy is a ~2 GB transient at deep scale)."""
+    chunk = qf.shape[0]
+    c = jnp.concatenate([old, cand], axis=1)
+    valid = c >= 0
+    safe = jnp.where(valid, c, 0)
+    cs = jnp.sort(c, axis=1)
+    dup_sorted = jnp.concatenate(
+        [jnp.zeros((chunk, 1), jnp.bool_),
+         cs[:, 1:] == cs[:, :-1]], axis=1)
+    rank = jnp.argsort(jnp.argsort(c, axis=1, stable=True), axis=1)
+    dup = jnp.take_along_axis(dup_sorted, rank, axis=1)
+    rows = dataset[safe].astype(jnp.bfloat16)
+    ip = jnp.einsum("qd,qmd->qm", qf.astype(jnp.bfloat16), rows,
+                    preferred_element_type=jnp.float32)
+    d = -ip if ip_metric else x_sq_all[safe] - 2.0 * ip
+    d = jnp.where(valid & ~dup, d, jnp.inf)
+    _, pos = jax.lax.top_k(-d, kg)
+    return jnp.take_along_axis(c, pos, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("kg", "ip_metric", "chunk"),
+                   donate_argnums=(1,))
+def _merge_refine_inplace(dataset, knn, second, kg, ip_metric,
+                          chunk=8192):
+    """Deep-scale twin of :func:`_merge_refine_chunked`: the rerank of
+    [knn | second] runs per chunk inside one donated ``fori_loop`` —
+    the full-width concat alone would be a ~10 GB lane-padded temp at
+    10M."""
+    n, dim = dataset.shape
+    m2 = second.shape[1]
+    x_sq_all = jnp.sum(dataset.astype(jnp.float32) ** 2, axis=1)
+    n_chunks = -(-n // chunk)
+
+    def body(ci, carry):
+        start = jnp.minimum(ci * chunk, n - chunk)
+        qf = jax.lax.dynamic_slice(dataset, (start, 0),
+                                   (chunk, dim)).astype(jnp.float32)
+        old = jax.lax.dynamic_slice(carry, (start, 0), (chunk, kg))
+        sec = jax.lax.dynamic_slice(second, (start, 0), (chunk, m2))
+        new_rows = _rerank_rows(dataset, x_sq_all, qf, old, sec, kg,
+                                ip_metric)
+        return jax.lax.dynamic_update_slice(carry, new_rows, (start, 0))
+
+    return jax.lax.fori_loop(0, n_chunks, body, knn)
+
+
 def _graph_refine_round(res, dataset, knn, kg, metric, pdim, iters,
-                        itopk=0, knn_d=None):
+                        itopk=0, knn_d=None, extra=None, vecs=None):
     """One graph-walk refinement round: pack the current graph's best
     edges into a walk table, self-walk every node, and exact-rerank
-    [current neighbors | walk buffer].  Monotone: the rerank set
-    contains the current neighbors, so per-node recall cannot drop.
-    Returns (knn, exact keys) for the next round's carry.
+    [current neighbors | walk buffer (| extra)].  Monotone: the rerank
+    set contains the current neighbors, so per-node recall cannot drop.
+    Returns (knn, exact keys) for the next round's carry.  ``extra``
+    (n, m) ids join the rerank set — the build folds the reverse edges
+    in here instead of paying a separate full-width rerank pass.
 
     This is how the build escapes the candidate-pool ceiling of any
     clustered scan (measured at 1M: per-list pools cap at ~0.47
     recall@128 even at 2x the candidate budget; the walk's reach is the
     whole graph)."""
-    deg_t = min(kg, 64)
-    itopk = itopk or min(max(-(-kg * 3 // 2) // 32 * 32, 64), 256)
+    # ~kg + 25% slack, rounded to a 32 lane multiple (kg 129 -> 160)
+    itopk = itopk or min(max(-(-(kg + 16) // 32) * 32, 64), 256)
     ip_metric = metric == DistanceType.InnerProduct
-    table, proj = _build_walk_table(dataset, knn[:, :deg_t], pdim)
+    n = dataset.shape[0]
+    plan = _table_plan(n, kg, pdim, _WALK_TABLE_MAX_BYTES)
+    if plan is None:           # nothing fits: no walk, but never drop
+        # the reverse edges — merge them (exactly) and return
+        second = extra if extra is not None else knn[:, :1]
+        return _merge_refine_chunked(
+            dataset.astype(jnp.float32), knn, second, kg, ip_metric,
+            first_d=knn_d, with_d=True)
+    table, proj, scales, q = _build_refine_table(dataset, knn, plan,
+                                                 vecs)
     cand = _self_walk_chunked(dataset, table, proj, itopk, iters, 1,
-                              metric, deg_t)
+                              metric, plan[0], quant=q, scales=scales)
+    if extra is not None:
+        cand = jnp.concatenate([cand, extra], axis=1)
     return _merge_refine_chunked(dataset.astype(jnp.float32), knn, cand,
                                  kg, ip_metric, first_d=knn_d,
                                  with_d=True)
@@ -791,6 +982,21 @@ def prune(res, knn_graph, graph_degree: int) -> jax.Array:
         ordered = _detour_order(knn_graph)
         half = (max(graph_degree // 2, 1) if graph_degree < deg
                 else graph_degree)
+        if n >= _DEEP_SCALE_ROWS:
+            # deep-scale: the tail's (n, <=128) temporaries each cost
+            # n*512 B after lane padding — run it on the host
+            o = np.asarray(ordered)
+            del ordered
+            fwd = o[:, :half]
+            if half == graph_degree:
+                return jnp.asarray(fwd)
+            rev_cap = graph_degree - half
+            rev = _reverse_edges_host(fwd, n, rev_cap)
+            fillers = o[:, half:half + rev_cap]
+            cand = np.concatenate([rev, fillers], axis=1)
+            sel = np.argsort(cand < 0, axis=1, kind="stable")[:, :rev_cap]
+            rest = np.take_along_axis(cand, sel, axis=1)
+            return jnp.asarray(np.concatenate([fwd, rest], axis=1))
         fwd = ordered[:, :half]
         if half == graph_degree:
             return fwd
@@ -846,6 +1052,8 @@ class _WalkCache:
     entry_proj: jax.Array      # (S, pdim) bf16
     entry_sq: jax.Array        # (S,) f32
     entry_ids: jax.Array       # (S,) int32
+    quant: bool = False        # int8/uint16 row format (10M regime)
+    scales: Optional[jax.Array] = None   # (3,) [a, sq_min, sq_scale]
 
 
 @jax.jit
@@ -875,22 +1083,34 @@ _WALK_CALIB_POOL = 131072
 _WALK_CALIB_K = 10
 
 
-@functools.partial(jax.jit, static_argnames=("pdim", "k", "ip_metric"))
-def _calib_overlap(queries, pool, self_col, vecs, pdim, k, ip_metric=False):
+@functools.partial(jax.jit, static_argnames=("pdim", "k", "ip_metric",
+                                             "quant"))
+def _calib_overlap(queries, pool, self_col, vecs, pdim, k,
+                   ip_metric=False, quant=False):
     """Top-k overlap between exact and pdim-projected distances for
     calibration queries against a candidate pool — scored under the
     index's own metric (an IP walk ranks purely by the projected cross
     term; gating it on L2 overlap would let the exact-norm term mask
     cross-term error).  ``self_col`` (q,) is each query's own column in
     the pool (-1 when absent): the guaranteed self-match would inflate
-    overlap by ~1/k, silently loosening the fidelity gate."""
+    overlap by ~1/k, silently loosening the fidelity gate.  ``quant``
+    additionally applies the int8 table quantization to the pool side
+    (the format _build_walk_table_q stores), so the quantized walk is
+    gated on its own fidelity, not the bf16 format's."""
     dim = pool.shape[1]
     ip = jax.lax.dot_general(queries, pool, (((1,), (1,)), ((), ())),
                              precision=get_matmul_precision(),
                              preferred_element_type=jnp.float32)
     proj = vecs[:, dim - pdim:]
-    qp = (queries @ proj).astype(jnp.bfloat16)
-    pp = (pool @ proj).astype(jnp.bfloat16)
+    ppf = pool @ proj
+    if quant:
+        a = jnp.maximum(jnp.percentile(jnp.abs(ppf), 99.9), 1e-12)
+        pp = jnp.clip(jnp.round(ppf / a * 127.0), -127,
+                      127).astype(jnp.bfloat16)
+        qp = ((queries @ proj) * (a / 127.0)).astype(jnp.bfloat16)
+    else:
+        pp = ppf.astype(jnp.bfloat16)
+        qp = (queries @ proj).astype(jnp.bfloat16)
     ipa = jax.lax.dot_general(qp, pp, (((1,), (1,)), ((), ())),
                               preferred_element_type=jnp.float32)
     if ip_metric:
@@ -916,25 +1136,9 @@ def _auto_pdim(index: Index) -> int:
     cached = getattr(index, "_walk_auto_pdim", None)
     if cached is None:
         dim = index.dim
-        n = index.size
-        # strided samples (see _second_moment: leading rows bias
-        # cluster-grouped datasets); the pool must be large so its NN
-        # gaps approach index-scale density
-        mq = min(n, _WALK_CALIB_QUERIES)
-        mp = min(n, _WALK_CALIB_POOL)
-        sq_, sp_ = max(n // mq, 1), max(n // mp, 1)
-        queries = index.dataset[::sq_][:mq].astype(jnp.float32)
-        pool = index.dataset[::sp_][:mp].astype(jnp.float32)
-        mq, mp = queries.shape[0], pool.shape[0]
-        # each query is dataset row i*sq_; it sits in the pool at column
-        # i*sq_/sp_ when divisible — mask that self column in the overlap
-        qrow = np.arange(mq, dtype=np.int64) * sq_
-        col = qrow // sp_
-        self_col = jnp.asarray(
-            np.where((qrow % sp_ == 0) & (col < mp), col, -1),
-            dtype=jnp.int32)
+        queries, pool, self_col = _calib_sample(index.dataset)
         ip_metric = index.metric == DistanceType.InnerProduct
-        _, vecs = jnp.linalg.eigh(_second_moment(index.dataset))
+        vecs = _calib_vecs(index)
         p = 8
         cached = 0
         while p < dim:
@@ -955,19 +1159,143 @@ def _auto_pdim(index: Index) -> int:
     return cached
 
 
+def _calib_sample(dataset, pool_size=_WALK_CALIB_POOL):
+    """Strided calibration (queries, pool, self_col) — strided, not
+    leading (see _second_moment: leading rows bias cluster-grouped
+    datasets); the pool must be large so its NN gaps approach
+    index-scale density.  ``self_col`` marks each query's own pool
+    column for masking."""
+    n = dataset.shape[0]
+    mq = min(n, _WALK_CALIB_QUERIES)
+    mp = min(n, pool_size)
+    sq_, sp_ = max(n // mq, 1), max(n // mp, 1)
+    queries = dataset[::sq_][:mq].astype(jnp.float32)
+    pool = dataset[::sp_][:mp].astype(jnp.float32)
+    mq, mp = queries.shape[0], pool.shape[0]
+    # each query is dataset row i*sq_; it sits in the pool at column
+    # i*sq_/sp_ when divisible
+    qrow = np.arange(mq, dtype=np.int64) * sq_
+    col = qrow // sp_
+    self_col = jnp.asarray(
+        np.where((qrow % sp_ == 0) & (col < mp), col, -1),
+        dtype=jnp.int32)
+    return queries, pool, self_col
+
+
+def _calib_vecs(index: Index) -> jax.Array:
+    """Second-moment eigenvectors, computed once per index (both the
+    pdim ladder and the quantized-format gate need them; recomputing
+    the full-dataset moment per probe is seconds at 10M)."""
+    vecs = getattr(index, "_walk_calib_vecs", None)
+    if vecs is None:
+        _, vecs = jnp.linalg.eigh(_second_moment(index.dataset))
+        object.__setattr__(index, "_walk_calib_vecs", vecs)
+    return vecs
+
+
+def _quant_calib_ok(index: Index, pdim: int) -> bool:
+    """Does the int8-quantized pdim projection still clear the walk
+    fidelity bar?  (cached per (index, pdim))."""
+    cache = getattr(index, "_walk_quant_ok", None)
+    if cache is None:
+        cache = {}
+        object.__setattr__(index, "_walk_quant_ok", cache)
+    if pdim not in cache:
+        queries, pool, self_col = _calib_sample(index.dataset)
+        ip_metric = index.metric == DistanceType.InnerProduct
+        ov = float(_calib_overlap(queries, pool, self_col,
+                                  _calib_vecs(index),
+                                  min(pdim, index.dim), _WALK_CALIB_K,
+                                  ip_metric, quant=True))
+        cache[pdim] = ov >= _WALK_FIDELITY
+    return cache[pdim]
+
+
+def _walk_proj(dataset, pdim, vecs=None):
+    """(dim, pdim) projection for the packed walk: uncentered PCA (top
+    singular subspace of the second moment) — the walk approximates the
+    CROSS TERM <q, x> by <q P, x P>, so the right subspace is the one
+    capturing raw inner products, not the mean-centered covariance's.
+    Pass precomputed ``vecs`` to skip the full-dataset moment pass
+    (multi-second at 10M; the build/calibration already holds them)."""
+    dim = dataset.shape[1]
+    if pdim < dim:
+        if vecs is None:
+            _, vecs = jnp.linalg.eigh(_second_moment(dataset))  # ascending
+        return vecs[:, dim - pdim:]
+    return jnp.eye(dim, dtype=jnp.float32)
+
+
+def _table_plan(n, kg, pdim, budget, deep=False):
+    """First (deg_t, pdim, quant) packed-table rung whose 128-lane
+    padded bytes fit ``budget`` (quant pdims forced even — the int8
+    format packs lane pairs).  The deep regime skips the bf16 rung:
+    its builder's unchunked gathers materialize the very lane-padded
+    transients the regime exists to avoid.  None when nothing fits."""
+    pde = max(pdim - pdim % 2, 8)
+    rungs = [] if deep else [(min(kg, 64), pdim, False)]
+    rungs += [(min(kg, 64), pde, True),
+              (min(kg, 32), pde, True),
+              (min(kg, 32), max(pde // 2 - (pde // 2) % 2, 8), True),
+              (min(kg, 16), 8, True)]
+    for deg_t, pd, q in rungs:
+        if _table_bytes(n, deg_t, pd, q) <= budget:
+            return deg_t, pd, q
+    return None
+
+
+def _build_refine_table(dataset, knn, plan, vecs):
+    """Build the walk table for a refinement round per ``plan``;
+    returns (table, proj, scales-or-None, quant)."""
+    deg_t, pd, q = plan
+    if q:
+        table, proj, scales = _build_walk_table_q(dataset, knn, pd,
+                                                  deg=deg_t, vecs=vecs)
+        return table, proj, scales, True
+    table, proj = _build_walk_table(dataset, knn[:, :deg_t], pd,
+                                    vecs=vecs)
+    return table, proj, None, False
+
+
+def _quant_unit(pdim: int) -> int:
+    """int16 lanes per neighbor in the quantized row format: pdim/2
+    lanes of int8 pairs + 1 norm lane + 2 id lanes."""
+    return pdim // 2 + 3
+
+
+def _table_bytes(n: int, deg: int, pdim: int, quant: bool) -> int:
+    """Packed-table bytes for n rows at this (deg, pdim, format) —
+    the 128-lane padded row width times int16 (the ONE definition of
+    the size gate; five call sites diverged before round 5)."""
+    unit = _quant_unit(pdim) if quant else pdim + 4
+    return n * (-(-(deg * unit) // 128) * 128) * 2
+
+
+def _search_table_format(index: "Index", pdim: int):
+    """Format selection for the SEARCH walk table (shared by
+    ``search`` and the AOT exporter): bf16 when it fits the byte gate,
+    else the int8/uint16 format at the calibrated pdim then half of it
+    (each quant rung gated on its own measured fidelity).  Returns
+    (pdim, quant) or None when nothing fits."""
+    deg = index.graph_degree
+    if _table_bytes(index.size, deg, pdim, False) <= _WALK_TABLE_MAX_BYTES:
+        return pdim, False
+    for p_try in dict.fromkeys(
+            (max(pdim - pdim % 2, 8),
+             max(pdim // 2 - (pdim // 2) % 2, 8))):
+        if (_table_bytes(index.size, deg, p_try, True)
+                <= _WALK_TABLE_MAX_BYTES
+                and _quant_calib_ok(index, p_try)):
+            return p_try, True
+    return None
+
+
 @functools.partial(jax.jit, static_argnames=("pdim",))
-def _build_walk_table(dataset, graph, pdim):
+def _build_walk_table(dataset, graph, pdim, vecs=None):
+    """bf16 packed-neighborhood table (n, W) int16 — see _WalkCache."""
     n, dim = dataset.shape
     xf = dataset.astype(jnp.float32)
-    if pdim < dim:
-        # uncentered PCA (top singular subspace of the second moment):
-        # the walk approximates the CROSS TERM <q, x> by <q P, x P>, so
-        # the right subspace is the one capturing raw inner products,
-        # not the mean-centered covariance's
-        _, vecs = jnp.linalg.eigh(_second_moment(dataset))  # ascending
-        proj = vecs[:, dim - pdim:]                # (dim, pdim)
-    else:
-        proj = jnp.eye(dim, dtype=jnp.float32)
+    proj = _walk_proj(dataset, pdim, vecs)
     xp = (xf @ proj).astype(jnp.bfloat16)          # (n, pdim)
     x_sq = jnp.sum(xf * xf, axis=1)                # (n,) f32
 
@@ -984,6 +1312,84 @@ def _build_walk_table(dataset, graph, pdim):
     return table, proj
 
 
+@functools.partial(jax.jit, static_argnames=("pdim", "deg", "chunk"))
+def _build_walk_table_q(dataset, graph, pdim, deg=0, chunk=65536,
+                        vecs=None):
+    """Quantized packed-neighborhood table: int8 projected lanes (two
+    per int16 lane, global symmetric scale at the 99.9th |value|
+    percentile) + uint16-quantized squared norms + int32 ids — 2.5x
+    smaller than the bf16 format at pdim 16, the difference between
+    CAGRA fitting 10M rows on one chip or not.  ``deg`` (0 -> all)
+    takes a per-chunk prefix of ``graph`` — passing a pre-sliced
+    (n, deg) array would materialize a lane-padded 5 GB temp at 10M.
+    Rows pack in chunks for the same reason.  Returns (table (n, Wq)
+    int16, proj, scales (3,) f32 = [a, sq_min, sq_scale])."""
+    n, dim = dataset.shape
+    deg = deg or graph.shape[1]
+    xf32 = dataset.astype(jnp.float32)
+    proj = _walk_proj(dataset, pdim, vecs)
+    xp = xf32 @ proj                               # (n, pdim) f32
+    x_sq = jnp.sum(xf32 * xf32, axis=1)
+    # clip-scale at the 99.9th percentile of |xp| (outlier-robust)
+    a = jnp.percentile(jnp.abs(xp[:: max(n // 65536, 1)]), 99.9)
+    a = jnp.maximum(a, 1e-12)
+    s8 = jnp.clip(jnp.round(xp / a * 127.0), -127, 127).astype(jnp.int8)
+    del xp
+    sq_min = jnp.min(x_sq)
+    sq_scale = jnp.maximum(jnp.max(x_sq) - sq_min, 1e-12) / 65535.0
+    sq_q = jnp.round((x_sq - sq_min) / sq_scale).astype(jnp.uint16)
+
+    unit = _quant_unit(pdim)
+    w_pad = -(-(deg * unit) // 128) * 128
+    chunk = min(chunk, n)
+    n_chunks = -(-n // chunk)
+
+    def body(ci, table):
+        start = jnp.minimum(ci * chunk, n - chunk)
+        nb = jax.lax.dynamic_slice(
+            graph, (start, 0), (chunk, graph.shape[1])
+        )[:, :deg].astype(jnp.int32)
+        p16 = jax.lax.bitcast_convert_type(
+            s8[nb].reshape(chunk, deg, pdim // 2, 2), jnp.int16)
+        sq1 = jax.lax.bitcast_convert_type(sq_q[nb], jnp.int16)[..., None]
+        id2 = jax.lax.bitcast_convert_type(nb, jnp.int16)
+        rows = jnp.concatenate([p16, sq1, id2], axis=2
+                               ).reshape(chunk, deg * unit)
+        rows = jnp.pad(rows, ((0, 0), (0, w_pad - deg * unit)))
+        return jax.lax.dynamic_update_slice(table, rows, (start, 0))
+
+    table = jax.lax.fori_loop(
+        0, n_chunks, body, jnp.zeros((n, w_pad), jnp.int16))
+    scales = jnp.stack([a, sq_min, sq_scale * 1.0])
+    return table, proj, scales.astype(jnp.float32)
+
+
+def _decode_neighborhood(rows, pdim, deg, quant, scales):
+    """Shared unpack of (q, w, deg, unit) int16 neighborhood rows into
+    (nb_p bf16 (q,w,deg,pdim), nb_sq f32, nb_id int32).  For the
+    quantized format the int8 lanes decode EXACTLY into bf16 (integers
+    up to 256 are representable); the caller's query side carries the
+    a/127 scale."""
+    if not quant:
+        nb_p = jax.lax.bitcast_convert_type(rows[..., :pdim],
+                                            jnp.bfloat16)
+        nb_sq = jax.lax.bitcast_convert_type(
+            rows[..., pdim:pdim + 2], jnp.float32)
+        nb_id = jax.lax.bitcast_convert_type(
+            rows[..., pdim + 2:pdim + 4], jnp.int32)
+        return nb_p, nb_sq, nb_id
+    h = pdim // 2
+    v = rows[..., :h].astype(jnp.int32)
+    lo = ((v << 24) >> 24).astype(jnp.bfloat16)            # sign-extended
+    hi = ((v << 16) >> 24).astype(jnp.bfloat16)
+    nb_p = jnp.stack([lo, hi], axis=-1).reshape(*rows.shape[:-1], pdim)
+    uq = rows[..., h].astype(jnp.int32) & 0xFFFF
+    nb_sq = scales[1] + scales[2] * uq.astype(jnp.float32)
+    nb_id = jax.lax.bitcast_convert_type(rows[..., h + 1:h + 3],
+                                         jnp.int32)
+    return nb_p, nb_sq, nb_id
+
+
 @functools.partial(jax.jit, static_argnames=("n_entries",))
 def _build_entry_set(dataset, proj, key, n_entries):
     n = dataset.shape[0]
@@ -994,7 +1400,8 @@ def _build_entry_set(dataset, proj, key, n_entries):
             jnp.sum(rows * rows, axis=1), entry_ids)
 
 
-def _walk_cache(res, index: Index, pdim: int, n_entries: int) -> _WalkCache:
+def _walk_cache(res, index: Index, pdim: int, n_entries: int,
+                quant: bool = False) -> _WalkCache:
     """Get-or-build the packed neighborhood table (mutates the index —
     the cache stays attached, same lazy pattern as ivf_flat's
     ``list_data_sq``).  At most ONE table is kept: a caller sweeping
@@ -1009,17 +1416,25 @@ def _walk_cache(res, index: Index, pdim: int, n_entries: int) -> _WalkCache:
         tables = {}
         object.__setattr__(index, "_walk_tables", tables)
         object.__setattr__(index, "_walk_entries", {})
-    if pdim not in tables:
-        tables.clear()                     # evict any previous-pdim table
-        tables[pdim] = _build_walk_table(index.dataset, index.graph, pdim)
-    table, proj = tables[pdim]
+    tkey = (pdim, quant)
+    if tkey not in tables:
+        tables.clear()                     # evict any previous table
+        vecs = _calib_vecs(index) if pdim < index.dim else None
+        if quant:
+            tables[tkey] = _build_walk_table_q(index.dataset, index.graph,
+                                               pdim, vecs=vecs)
+        else:
+            tables[tkey] = _build_walk_table(index.dataset, index.graph,
+                                             pdim, vecs=vecs) + (None,)
+    table, proj, scales = tables[tkey]
     entries = index._walk_entries
     ekey = (pdim, n_entries)
     if ekey not in entries:
         entries[ekey] = _build_entry_set(index.dataset, proj,
                                          res.next_key(), n_entries)
     eproj, esq, eids = entries[ekey]
-    return _WalkCache(table, proj, eproj, esq, eids)
+    return _WalkCache(table, proj, eproj, esq, eids, quant=quant,
+                      scales=scales)
 
 
 def _merge_candidates(buf_d, buf_i, visited, cand_d, cand_i, itopk):
@@ -1114,22 +1529,25 @@ def _select_parents(buf_d, buf_i, visited, search_width):
 
 @functools.partial(jax.jit, static_argnames=(
     "k", "itopk", "search_width", "max_iterations", "metric", "rerank",
-    "deg"))
+    "deg", "quant"))
 def _search_impl_walk(dataset, table, entry_proj, entry_sq, entry_ids,
                       proj, queries, k, itopk, search_width,
-                      max_iterations, metric, rerank, deg):
+                      max_iterations, metric, rerank, deg, quant=False,
+                      scales=None):
     """Greedy walk over the packed neighborhood table.
 
     Walk distances are approximate (exact ||x||², PCA-projected bf16
     cross term); the final ``rerank`` buffer entries are re-scored
     exactly.  One scattered fat-row fetch per expanded node per
     iteration — the gather-latency analysis that motivates this is in
-    the module docstring.
+    the module docstring.  ``quant`` selects the int8/uint16 row format
+    (see :func:`_build_walk_table_q`); ``scales`` carries its dequant
+    constants.
     """
     nq, dim = queries.shape
     n = dataset.shape[0]
     pdim = proj.shape[1]
-    unit = pdim + 4
+    unit = _quant_unit(pdim) if quant else pdim + 4
     wd = search_width * deg
     ip_metric = metric == DistanceType.InnerProduct
     # the walk works in KEY space (ascending-better: d for L2, -score
@@ -1138,7 +1556,14 @@ def _search_impl_walk(dataset, table, entry_proj, entry_sq, entry_ids,
 
     qf = queries.astype(jnp.float32)
     q_sq = jnp.sum(qf * qf, axis=1)
-    qp = (qf @ proj).astype(jnp.bfloat16)            # (q, pdim)
+    qpf = qf @ proj                                  # (q, pdim) f32
+    qp = qpf.astype(jnp.bfloat16)      # entry scoring (unscaled bf16)
+    if quant:
+        # fold the int8 scale into the query side for TABLE rows only:
+        # <q, x> ~ (a/127) <q, s8>  (the entry set stays bf16/unscaled)
+        qp_t = (qpf * (scales[0] / 127.0)).astype(jnp.bfloat16)
+    else:
+        qp_t = qp
 
     # ---- dense entry scoring (no scattered seed gather) ------------------
     ip_e = jax.lax.dot_general(qp, entry_proj, (((1,), (1,)), ((), ())),
@@ -1175,15 +1600,11 @@ def _search_impl_walk(dataset, table, entry_proj, entry_sq, entry_ids,
         # vectors + norms + ids) in a single scattered fetch
         rows = table[jnp.where(parent_ok, sel_ids, 0)]  # (q, w, W) int16
         rows = rows[..., :deg * unit].reshape(nq, search_width, deg, unit)
-        nb_p = jax.lax.bitcast_convert_type(rows[..., :pdim],
-                                            jnp.bfloat16)
-        nb_sq = jax.lax.bitcast_convert_type(
-            rows[..., pdim:pdim + 2], jnp.float32)      # (q, w, deg)
-        nb_id = jax.lax.bitcast_convert_type(
-            rows[..., pdim + 2:pdim + 4], jnp.int32)
+        nb_p, nb_sq, nb_id = _decode_neighborhood(rows, pdim, deg, quant,
+                                                  scales)
         nb_id = jnp.where(parent_ok[:, :, None], nb_id, -1)
 
-        ipx = jnp.einsum("qp,qwdp->qwd", qp, nb_p,
+        ipx = jnp.einsum("qp,qwdp->qwd", qp_t, nb_p,
                          preferred_element_type=jnp.float32)
         if ip_metric:
             d_c = -ipx
@@ -1324,14 +1745,12 @@ def search(res, params: SearchParams, index: Index, queries, k: int
         pdim = 0
         if params.walk_pdim != 0 and not traced:
             pdim = min(params.walk_pdim or _auto_pdim(index), index.dim)
-        # the packed table pads its row width to 128 int16 lanes — the
-        # gate must use the padded width or small deg*(pdim+4) rows can
-        # exceed the cap by up to ~33%
-        w_pad = -(-(index.graph_degree * (pdim + 4)) // 128) * 128
-        table_bytes = index.size * w_pad * 2
-        if pdim > 0 and table_bytes <= _WALK_TABLE_MAX_BYTES:
+        fmt = _search_table_format(index, pdim) if pdim > 0 else None
+        if fmt is not None:
+            pdim, quant = fmt
             cache = _walk_cache(res, index, pdim,
-                                max(params.entry_points, itopk))
+                                max(params.entry_points, itopk),
+                                quant=quant)
             rerank = min(itopk,
                          params.rerank_topk or max(32, 2 * k))
             rerank = max(rerank, k)
@@ -1339,7 +1758,8 @@ def search(res, params: SearchParams, index: Index, queries, k: int
                 index.dataset, cache.table, cache.entry_proj,
                 cache.entry_sq, cache.entry_ids, cache.proj, queries, k,
                 itopk, params.search_width, max_iter, index.metric,
-                rerank, index.graph_degree)
+                rerank, index.graph_degree, quant=cache.quant,
+                scales=cache.scales)
 
         # direct exact walk: probe 4×itopk random nodes (min 128) and
         # keep the best itopk — the reference's random-sampling buffer

@@ -131,7 +131,9 @@ def supported(n: int, dim: int, k: int, metric_is_l2: bool,
               tile: int = 1024) -> bool:
     """Shapes the kernel handles at this tile; callers fall back to the
     XLA path otherwise.  VMEM: x tile + distance block + one-hot +
-    accumulator + centroids must fit."""
+    accumulator + centroids must fit (cap measured round 5: tile 2048 @
+    k 1024, dim 128 — ~17.5 MB of blocks — compiles and runs ~20%
+    faster than tile 1024; the earlier 12 MB cap was conservative)."""
     k_pad = _round_up(k, 128)
     d_pad = _round_up(dim, 128)
     vmem = (tile * d_pad * 2            # x tile bf16
@@ -139,7 +141,7 @@ def supported(n: int, dim: int, k: int, metric_is_l2: bool,
             + k_pad * d_pad * 2         # centroids bf16
             + k_pad * d_pad * 4         # sums accumulator
             + 2 * k_pad * 4)
-    return (metric_is_l2 and n >= tile and vmem <= (12 << 20)
+    return (metric_is_l2 and n >= tile and vmem <= (18 << 20)
             and k_pad * d_pad * 4 <= (4 << 20))
 
 
@@ -147,7 +149,7 @@ def best_tile(n: int, dim: int, k: int, metric_is_l2: bool) -> int:
     """Largest supported data tile (descending ladder), 0 if none —
     large cluster counts shrink the tile so the (tile, K) distance and
     one-hot blocks stay inside VMEM (k=4096 @ dim 128 fits at 256)."""
-    for tile in (1024, 512, 256):
+    for tile in (2048, 1024, 512, 256):
         if supported(n, dim, k, metric_is_l2, tile=tile):
             return tile
     return 0

@@ -123,8 +123,9 @@ class TestCagra:
 
         db, q = dataset
         cagra.search(res, cagra.SearchParams(), index, q, 5)
-        (pdim, _), = list(index._walk_entries)[:1]
-        table, proj = index._walk_tables[pdim]
+        (pdim, quant), = list(index._walk_tables)[:1]
+        assert not quant          # small index: bf16 format selected
+        table, proj, _ = index._walk_tables[(pdim, quant)]
         assert jnp.issubdtype(table.dtype, jnp.integer)
         unit = pdim + 4
         deg = index.graph_degree
@@ -264,3 +265,104 @@ class TestClusteredBuild:
         rec = sum(len(set(a) & set(b))
                   for a, b in zip(knn[sample], gt)) / gt.size
         assert rec >= 0.9
+
+
+class TestQuantWalkTable:
+    """int8/uint16 packed-row format (the 10M-rows-per-chip regime)."""
+
+    def test_decode_roundtrip(self, res, dataset):
+        db, _ = dataset
+        db = jnp.asarray(db)
+        params = cagra.IndexParams(intermediate_graph_degree=32,
+                                   graph_degree=16)
+        index = cagra.build(res, params, db)
+        pdim = 8
+        table, proj, scales = cagra._build_walk_table_q(
+            db, index.graph, pdim)
+        deg = index.graph_degree
+        unit = cagra._quant_unit(pdim)
+        rows = table[:64, None, :deg * unit].reshape(64, 1, deg, unit)
+        nb_p, nb_sq, nb_id = cagra._decode_neighborhood(
+            rows, pdim, deg, True, scales)
+        # ids decode exactly
+        np.testing.assert_array_equal(
+            np.asarray(nb_id[:, 0]), np.asarray(index.graph[:64]))
+        # norms decode to within the uint16 quantization step
+        x_sq = np.sum(np.asarray(db).astype(np.float64) ** 2, axis=1)
+        want = x_sq[np.asarray(index.graph[:64])]
+        got = np.asarray(nb_sq[:, 0])
+        step = float(scales[2])
+        assert np.max(np.abs(got - want)) <= step * 1.01 + 1e-3
+        # projected lanes: in-range values decode to within one int8
+        # step; only the ~0.1% beyond the 99.9th-percentile clip scale
+        # may exceed it
+        xp = np.asarray(db, dtype=np.float64) @ np.asarray(proj)
+        want_p = xp[np.asarray(index.graph[:64])]
+        got_p = np.asarray(nb_p[:, 0].astype(jnp.float32)) \
+            * float(scales[0]) / 127.0
+        err = np.abs(got_p - want_p)
+        step = float(scales[0]) / 127.0
+        assert np.quantile(err, 0.99) <= step
+        clipped = np.abs(want_p) > float(scales[0])
+        assert np.all(err[~clipped] <= step)
+
+    def test_quant_walk_recall_matches_bf16(self, res):
+        rng = np.random.default_rng(7)
+        n, dim, latent = 6000, 32, 6
+        Z = rng.normal(size=(n + 64, latent)).astype(np.float32)
+        A = rng.normal(size=(latent, dim)).astype(np.float32) / np.sqrt(latent)
+        X = jnp.asarray((Z @ A).astype(np.float32))
+        db, q = X[:n], X[n:]
+        params = cagra.IndexParams(intermediate_graph_degree=32,
+                                   graph_degree=16)
+        index = cagra.build(res, params, db)
+        pdim = cagra._auto_pdim(index) or 16
+        k, itopk = 10, 48
+        outs = {}
+        for quant in (False, True):
+            cache = cagra._walk_cache(res, index, pdim, 256, quant=quant)
+            d, i = cagra._search_impl_walk(
+                index.dataset, cache.table, cache.entry_proj,
+                cache.entry_sq, cache.entry_ids, cache.proj, q, k,
+                itopk, 1, 60, index.metric, 32, index.graph_degree,
+                quant=cache.quant, scales=cache.scales)
+            outs[quant] = np.asarray(i)
+        from raft_tpu.neighbors import brute_force
+        _, gt = brute_force.knn(res, db, q, k)
+        gt = np.asarray(gt)
+        for quant, ii in outs.items():
+            rec = sum(len(set(a) & set(b))
+                      for a, b in zip(ii, gt)) / gt.size
+            assert rec >= 0.85, (quant, rec)
+
+
+class TestDeepScalePath:
+    def test_deep_regime_matches_default(self, res, monkeypatch):
+        """The deep-scale memory regime (in-place fused rounds, host
+        reverse/prune tails) must produce graphs of the same quality as
+        the default path — exercised here by lowering the row
+        threshold."""
+        rng = np.random.default_rng(11)
+        n, dim, latent = 40_000, 32, 8
+        Z = rng.normal(size=(n, latent)).astype(np.float32)
+        A = rng.normal(size=(latent, dim)).astype(np.float32) / np.sqrt(latent)
+        X = (Z @ A + 0.05 * rng.normal(size=(n, dim))).astype(np.float32)
+        deg = 16
+        knn_default = np.asarray(cagra.build_knn_graph(res, X, deg))
+        monkeypatch.setattr(cagra, "_DEEP_SCALE_ROWS", 10_000)
+        monkeypatch.setattr(cagra, "_REV_HOST_EDGES", 100_000)
+        knn_deep = np.asarray(cagra.build_knn_graph(res, X, deg))
+        pruned = np.asarray(cagra.prune(res, jnp.asarray(knn_deep), 8))
+        assert pruned.shape == (n, 8)
+        from raft_tpu.neighbors import brute_force
+        sample = np.arange(0, n, 97)
+        _, gt = brute_force.knn(res, X, X[sample], deg + 1)
+        gt = np.asarray(gt)[:, 1:]
+
+        def rec(knn):
+            return sum(len(set(a) & set(b))
+                       for a, b in zip(knn[sample], gt)) / gt.size
+
+        r_def, r_deep = rec(knn_default), rec(knn_deep)
+        assert r_deep >= 0.9, r_deep
+        assert r_deep >= r_def - 0.05, (r_def, r_deep)
