@@ -356,7 +356,19 @@ _REV_HOST_EDGES = 200_000_000
 # row count at which the build switches to the deep-scale memory
 # regime (in-place fused walk rounds, host reverse/prune tails)
 _DEEP_SCALE_ROWS = 4_000_000
-_HBM_BYTES = 16 << 30
+
+
+def _hbm_bytes() -> int:
+    """Default-device HBM, from the runtime when it reports it (a v5e
+    constant otherwise — the one chip this repo is tuned on)."""
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = int(stats.get("bytes_limit", 0))
+        if limit > 0:
+            return limit
+    except Exception:
+        pass
+    return 16 << 30
 
 
 def _deep_walk_round(dataset, knn, kg, metric, pdim, iters, vecs=None):
@@ -367,7 +379,7 @@ def _deep_walk_round(dataset, knn, kg, metric, pdim, iters, vecs=None):
     (:func:`_walk_refine_fused`)."""
     n, dim = dataset.shape
     budget = min(_WALK_TABLE_MAX_BYTES,
-                 _HBM_BYTES - n * dim * 4
+                 _hbm_bytes() - n * dim * 4
                  - n * (-(-kg // 128) * 128) * 4 - (3 << 30))
     itopk = min(max(-(-(kg + 16) // 32) * 32, 64), 256)
     plan = _table_plan(n, kg, pdim, budget, deep=True)
@@ -436,6 +448,9 @@ def _merge_refine_chunked(xf, first, second, kg, ip_metric, chunk=4096,
 
     def one(args):
         c, q, f = args                  # (chunk, m), (chunk, dim), (chunk, m1?)
+        if first_d is None:
+            return _rerank_rows(xb, x_sq, q, c[:, :m1], c[:, m1:], kg,
+                                ip_metric)
         valid = c >= 0
         safe = jnp.where(valid, c, 0)
         # mask duplicate ids (an id may appear in both operands): sort
@@ -447,18 +462,12 @@ def _merge_refine_chunked(xf, first, second, kg, ip_metric, chunk=4096,
              cs[:, 1:] == cs[:, :-1]], axis=1)
         rank = jnp.argsort(jnp.argsort(c, axis=1, stable=True), axis=1)
         dup = jnp.take_along_axis(dup_sorted, rank, axis=1)
-        if first_d is not None:
-            sc = safe[:, m1:]
-            rows = xb[sc]                               # (chunk, m2, dim)
-            ip = jnp.einsum("qd,qmd->qm", q, rows,
-                            preferred_element_type=jnp.float32)
-            d2 = -ip if ip_metric else x_sq[sc] - 2.0 * ip
-            d = jnp.concatenate([f, d2], axis=1)
-        else:
-            rows = xb[safe]                             # (chunk, m, dim)
-            ip = jnp.einsum("qd,qmd->qm", q, rows,
-                            preferred_element_type=jnp.float32)
-            d = -ip if ip_metric else x_sq[safe] - 2.0 * ip
+        sc = safe[:, m1:]
+        rows = xb[sc]                               # (chunk, m2, dim)
+        ip = jnp.einsum("qd,qmd->qm", q, rows,
+                        preferred_element_type=jnp.float32)
+        d2 = -ip if ip_metric else x_sq[sc] - 2.0 * ip
+        d = jnp.concatenate([f, d2], axis=1)
         d = jnp.where(valid & ~dup, d, jnp.inf)
         nd, pos = jax.lax.top_k(-d, kg)
         return jnp.take_along_axis(c, pos, axis=1), -nd
@@ -697,17 +706,20 @@ def _walk_refine_fused(dataset, knn, table, proj, scales, kg, itopk,
         cand = _walk_chunk_body(qf, ids_c, table, proj, scales, itopk,
                                 iters, 1, ip_metric, deg, quant)
         old = jax.lax.dynamic_slice(carry, (start, 0), (chunk, kg))
-        new_rows = _rerank_rows(dataset, x_sq_all, qf, old, cand, kg,
-                                ip_metric)
+        new_rows, _ = _rerank_rows(dataset, x_sq_all, qf, old, cand, kg,
+                                   ip_metric)
         return jax.lax.dynamic_update_slice(carry, new_rows, (start, 0))
 
     return jax.lax.fori_loop(0, n_chunks, body, knn)
 
 
 def _rerank_rows(dataset, x_sq_all, qf, old, cand, kg, ip_metric):
-    """Exact rerank of [old | cand] ids for one chunk of self-queries
-    (gathered rows cast to bf16 AFTER the gather — a full bf16 dataset
-    copy is a ~2 GB transient at deep scale)."""
+    """Exact rerank of [old | cand] ids for one chunk of self-queries —
+    the ONE copy of the duplicate-mask + rerank body (duplicates keep
+    their FIRST occurrence via the stable double-argsort, so ``old``
+    entries win ties).  Gathered rows cast to bf16 AFTER the gather — a
+    full bf16 dataset copy is a ~2 GB transient at deep scale.  Returns
+    (ids (chunk, kg), keys (chunk, kg))."""
     chunk = qf.shape[0]
     c = jnp.concatenate([old, cand], axis=1)
     valid = c >= 0
@@ -723,8 +735,8 @@ def _rerank_rows(dataset, x_sq_all, qf, old, cand, kg, ip_metric):
                     preferred_element_type=jnp.float32)
     d = -ip if ip_metric else x_sq_all[safe] - 2.0 * ip
     d = jnp.where(valid & ~dup, d, jnp.inf)
-    _, pos = jax.lax.top_k(-d, kg)
-    return jnp.take_along_axis(c, pos, axis=1)
+    nd, pos = jax.lax.top_k(-d, kg)
+    return jnp.take_along_axis(c, pos, axis=1), -nd
 
 
 @functools.partial(jax.jit, static_argnames=("kg", "ip_metric", "chunk"),
@@ -746,8 +758,8 @@ def _merge_refine_inplace(dataset, knn, second, kg, ip_metric,
                                    (chunk, dim)).astype(jnp.float32)
         old = jax.lax.dynamic_slice(carry, (start, 0), (chunk, kg))
         sec = jax.lax.dynamic_slice(second, (start, 0), (chunk, m2))
-        new_rows = _rerank_rows(dataset, x_sq_all, qf, old, sec, kg,
-                                ip_metric)
+        new_rows, _ = _rerank_rows(dataset, x_sq_all, qf, old, sec, kg,
+                                   ip_metric)
         return jax.lax.dynamic_update_slice(carry, new_rows, (start, 0))
 
     return jax.lax.fori_loop(0, n_chunks, body, knn)
@@ -1278,11 +1290,14 @@ def _search_table_format(index: "Index", pdim: int):
     (each quant rung gated on its own measured fidelity).  Returns
     (pdim, quant) or None when nothing fits."""
     deg = index.graph_degree
+    pdim = min(pdim, index.dim)
     if _table_bytes(index.size, deg, pdim, False) <= _WALK_TABLE_MAX_BYTES:
         return pdim, False
     for p_try in dict.fromkeys(
             (max(pdim - pdim % 2, 8),
              max(pdim // 2 - (pdim // 2) % 2, 8))):
+        if p_try > index.dim:      # tiny-dim index: no even rung exists
+            continue
         if (_table_bytes(index.size, deg, p_try, True)
                 <= _WALK_TABLE_MAX_BYTES
                 and _quant_calib_ok(index, p_try)):

@@ -144,11 +144,36 @@ def build_groups(probes: jax.Array, n_lists: int, n_groups: int
                             groups_per_list, total_repeat_length=n_groups)
     starts = jnp.cumsum(counts) - counts
     rank = jnp.arange(P) - starts[pl_s]
-    g = gstart[pl_s] + rank // GROUP
+    g = gstart[jnp.minimum(pl_s, n_lists - 1)] + rank // GROUP
     s = rank % GROUP
     slot_pairs = jnp.full((n_groups, GROUP), P, jnp.int32)
-    slot_pairs = slot_pairs.at[g, s].set(order, mode="drop")
+    # probes >= n_lists are sentinels (the super-tile dedupe marks
+    # duplicate pairs that way): their pairs write the empty-slot
+    # sentinel wherever they land, so they can never surface results
+    vals = jnp.where(pl_s < n_lists, order, P)
+    slot_pairs = slot_pairs.at[g, s].set(vals, mode="drop")
     return group_list, slot_pairs
+
+
+@functools.partial(jax.jit, static_argnames=("factor", "n_super"))
+def dedup_super_probes(probes: jax.Array, factor: int, n_super: int
+                       ) -> jax.Array:
+    """Map per-query probes onto super-tiles of ``factor`` adjacent
+    lists and mask per-row duplicates with the ``n_super`` sentinel.
+
+    Small lists fragment pairs into many groups whose per-group cost is
+    flat (~22 us measured at any cap, round 5); scanning ``factor``
+    lists per tile cuts the group count, and a query probing several
+    lists of one tile pays for the tile ONCE — the duplicate pairs are
+    sentineled out here and dropped by :func:`build_groups`."""
+    sp = probes // factor
+    ss = jnp.sort(sp, axis=1)
+    dup_sorted = jnp.concatenate(
+        [jnp.zeros((sp.shape[0], 1), jnp.bool_),
+         ss[:, 1:] == ss[:, :-1]], axis=1)
+    rank = jnp.argsort(jnp.argsort(sp, axis=1, stable=True), axis=1)
+    dup = jnp.take_along_axis(dup_sorted, rank, axis=1)
+    return jnp.where(dup, n_super, sp)
 
 
 def finalize_topk(outd: jax.Array, outi: jax.Array, nq: int, k: int,

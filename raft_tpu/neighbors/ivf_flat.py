@@ -202,6 +202,15 @@ def build(res, params: IndexParams, dataset) -> Index:
                                    if params.metric == DistanceType.InnerProduct
                                    else DistanceType.L2Expanded)
         centers = kmeans_balanced.fit(res, bal, trainset, params.n_lists)
+        # order lists along the centers' first principal component:
+        # spatially adjacent lists get adjacent ids, so a query's probes
+        # cluster into few super-tiles (the small-cap scan regime —
+        # see search()'s super-tile dedupe)
+        cf = centers.astype(jnp.float32)
+        _, cvecs = jnp.linalg.eigh(
+            jax.lax.dot_general(cf, cf, (((0,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32))
+        centers = centers[jnp.argsort(cf @ cvecs[:, -1])]
 
         index = Index(centers=centers,
                       list_data=jnp.zeros((params.n_lists, _LIST_ALIGN, dim),
@@ -490,31 +499,59 @@ def search(res, params: SearchParams, index: Index, queries, k: int
                                 index.metric)
         probes = _select_clusters(index.centers, queries, n_probes,
                                   index.metric)
-        gkey = (queries.shape[0], n_probes)
-        n_groups, pending = grouped.cached_groups(
-            index, gkey, probes, index.n_lists)
-        G = grouped.GROUP
-
         # the fused kernel's one-hot id contraction is f32 — require
         # every actual candidate id (incl. user-supplied extend ids)
         # to be f32-exact, not just the row count
         use_pallas = (jax.default_backend() == "tpu"
                       and grouped.ids_f32_exact(index, index.list_indices))
         if use_pallas and index.list_data_sq is None:
-            # lazily attach the row-norm cache (stays on the index)
+            # lazily attach the row-norm cache (stays on the index);
+            # the XLA fallback recomputes row norms in its own fused
+            # block, so attaching here would only force a retrace
             index.list_data_sq = jnp.sum(
                 index.list_data.astype(jnp.float32) ** 2, axis=-1)
 
+        # super-tiles: the fused scan's per-group cost is flat in cap
+        # (~22 us measured at cap 160 AND 416, round 5), so small lists
+        # — the nlist=16384 regime — fragment pairs into pure overhead.
+        # Scan F adjacent lists per tile and dedupe per-query probes
+        # that land in the same tile.
+        cap = index.capacity
+        n_lists_eff = index.n_lists
+        F = 1
+        while (cap * F < 512 and F < 8
+               and n_lists_eff % 2 == 0 and n_lists_eff > n_probes):
+            F *= 2
+            n_lists_eff //= 2
+        dsq = index.list_data_sq
+        if F > 1:
+            probes_eff = grouped.dedup_super_probes(probes, F,
+                                                    n_lists_eff)
+            data_eff = index.list_data.reshape(n_lists_eff, F * cap,
+                                               index.dim)
+            ids_eff = index.list_indices.reshape(n_lists_eff, F * cap)
+            dsq_eff = (dsq.reshape(n_lists_eff, F * cap)
+                       if dsq is not None else None)
+            centers_eff = index.centers[::F]
+        else:
+            probes_eff, data_eff, ids_eff = probes, index.list_data, \
+                index.list_indices
+            dsq_eff, centers_eff = dsq, index.centers
+
+        gkey = (queries.shape[0], n_probes, F)
+        n_groups, pending = grouped.cached_groups(
+            index, gkey, probes_eff, n_lists_eff)
+        G = grouped.GROUP
+
         def dispatch(ng):
-            cap = index.capacity
             block = grouped.block_size(
                 ng,
-                G * cap * 8,                # fp32 distances + broadcast ids
-                (cap + G) * index.dim * 4)  # data slice + query gather
-            return _search_impl_grouped(index.centers, index.list_data,
-                                        index.list_indices, queries, probes,
+                G * F * cap * 8,            # fp32 distances + broadcast ids
+                (F * cap + G) * index.dim * 4)  # data slice + query gather
+            return _search_impl_grouped(centers_eff, data_eff,
+                                        ids_eff, queries, probes_eff,
                                         k, index.metric, ng, block,
-                                        list_data_sq=index.list_data_sq,
+                                        list_data_sq=dsq_eff,
                                         use_pallas=use_pallas)
 
         out = dispatch(n_groups)

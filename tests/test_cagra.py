@@ -366,3 +366,31 @@ class TestDeepScalePath:
         r_def, r_deep = rec(knn_default), rec(knn_deep)
         assert r_deep >= 0.9, r_deep
         assert r_deep >= r_def - 0.05, (r_def, r_deep)
+
+
+class TestSearchTableFormat:
+    def test_format_ladder(self, res, monkeypatch):
+        """bf16 when it fits, quantized when only that fits, None when
+        nothing does — the ONE gate shared by search and the AOT
+        exporter.  Manifold data: the quant rung is fidelity-gated and
+        tight blobs legitimately fail it."""
+        rng = np.random.default_rng(13)
+        n, dim, latent = 6000, 32, 6
+        Z = rng.normal(size=(n, latent)).astype(np.float32)
+        A = rng.normal(size=(latent, dim)).astype(np.float32) / np.sqrt(latent)
+        X = jnp.asarray((Z @ A).astype(np.float32))
+        index = cagra.build(
+            res, cagra.IndexParams(intermediate_graph_degree=32,
+                                   graph_degree=16), X)
+        pdim = cagra._auto_pdim(index) or 16
+        assert cagra._search_table_format(index, pdim) == (pdim, False)
+        bf16_bytes = cagra._table_bytes(index.size, index.graph_degree,
+                                        pdim, False)
+        q_bytes = cagra._table_bytes(index.size, index.graph_degree,
+                                     max(pdim - pdim % 2, 8), True)
+        assert q_bytes < bf16_bytes
+        monkeypatch.setattr(cagra, "_WALK_TABLE_MAX_BYTES", q_bytes)
+        fmt = cagra._search_table_format(index, pdim)
+        assert fmt is not None and fmt[1] is True
+        monkeypatch.setattr(cagra, "_WALK_TABLE_MAX_BYTES", 1)
+        assert cagra._search_table_format(index, pdim) is None

@@ -221,3 +221,29 @@ class TestDistributedCagra:
         gt = np.asarray(gt)
         rec = sum(len(set(a) & set(b)) for a, b in zip(ii, gt)) / gt.size
         assert rec >= 0.8
+
+    def test_direct_walk_fallback(self, res, handle, monkeypatch):
+        """When the packed table is infeasible (tiny byte gate), the
+        sharded search must fall back to the exact direct walk and stay
+        correct (the same route single-device search takes)."""
+        from raft_tpu.distributed import ann as dist_ann
+        from raft_tpu.neighbors import brute_force, cagra
+        monkeypatch.setattr(cagra, "_WALK_TABLE_MAX_BYTES", 1)
+        rng = np.random.default_rng(6)
+        n, dim, latent = 2048, 32, 8
+        Z = rng.normal(size=(n, latent)).astype(np.float32)
+        A = rng.normal(size=(latent, dim)).astype(np.float32) / np.sqrt(latent)
+        X = jnp.asarray((Z @ A).astype(np.float32))
+        Q = X[:32]
+        params = cagra.IndexParams(intermediate_graph_degree=32,
+                                   graph_degree=16)
+        dindex = dist_ann.build_cagra(handle, params, X)
+        assert not dindex.use_walk
+        d, i = dist_ann.search_cagra(
+            handle, cagra.SearchParams(itopk_size=32), dindex, Q, 10)
+        ii = np.asarray(i)
+        assert ii.min() >= 0 and ii.max() < n
+        _, gt = brute_force.knn(res, X, Q, 10)
+        gt = np.asarray(gt)
+        rec = sum(len(set(a) & set(b)) for a, b in zip(ii, gt)) / gt.size
+        assert rec >= 0.7, rec
