@@ -23,4 +23,5 @@ from raft_tpu.matrix.ops import (  # noqa: F401
     set_diagonal,
     triangular_upper,
     zero_small_values,
+    row_duplicate_mask,
 )

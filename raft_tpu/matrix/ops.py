@@ -110,3 +110,18 @@ def triangular_upper(matrix: jax.Array) -> jax.Array:
 def zero_small_values(matrix: jax.Array, thresh: float) -> jax.Array:
     """Zero entries below threshold (reference: matrix/threshold.cuh)."""
     return jnp.where(jnp.abs(matrix) < thresh, 0.0, matrix).astype(matrix.dtype)
+
+
+def row_duplicate_mask(matrix: jax.Array) -> jax.Array:
+    """Per-row mask of duplicate values, keeping each value's FIRST
+    occurrence (stable double-argsort maps the sorted adjacent-equal
+    flags back to original positions, so earlier columns win ties).
+    Shared by the scan paths that merge candidate-id operands (CAGRA
+    rerank, IVF-Flat super-tile probe dedupe) — the tie/stability
+    semantics are subtle enough that one copy must own them."""
+    s = jnp.sort(matrix, axis=1)
+    dup_sorted = jnp.concatenate(
+        [jnp.zeros((matrix.shape[0], 1), jnp.bool_),
+         s[:, 1:] == s[:, :-1]], axis=1)
+    rank = jnp.argsort(jnp.argsort(matrix, axis=1, stable=True), axis=1)
+    return jnp.take_along_axis(dup_sorted, rank, axis=1)

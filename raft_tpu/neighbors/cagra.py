@@ -68,6 +68,7 @@ from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.core.tracing import range as named_range
 from raft_tpu.distance.types import DistanceType
+from raft_tpu.matrix import ops as matrix_ops
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.utils.precision import get_matmul_precision
 from raft_tpu.core.outputs import auto_convert_output
@@ -448,29 +449,9 @@ def _merge_refine_chunked(xf, first, second, kg, ip_metric, chunk=4096,
 
     def one(args):
         c, q, f = args                  # (chunk, m), (chunk, dim), (chunk, m1?)
-        if first_d is None:
-            return _rerank_rows(xb, x_sq, q, c[:, :m1], c[:, m1:], kg,
-                                ip_metric)
-        valid = c >= 0
-        safe = jnp.where(valid, c, 0)
-        # mask duplicate ids (an id may appear in both operands): sort
-        # per row, flag equal-adjacent, map flags back by rank.  first
-        # precedes second, so carried entries win over re-scored dups.
-        cs = jnp.sort(c, axis=1)
-        dup_sorted = jnp.concatenate(
-            [jnp.zeros((c.shape[0], 1), jnp.bool_),
-             cs[:, 1:] == cs[:, :-1]], axis=1)
-        rank = jnp.argsort(jnp.argsort(c, axis=1, stable=True), axis=1)
-        dup = jnp.take_along_axis(dup_sorted, rank, axis=1)
-        sc = safe[:, m1:]
-        rows = xb[sc]                               # (chunk, m2, dim)
-        ip = jnp.einsum("qd,qmd->qm", q, rows,
-                        preferred_element_type=jnp.float32)
-        d2 = -ip if ip_metric else x_sq[sc] - 2.0 * ip
-        d = jnp.concatenate([f, d2], axis=1)
-        d = jnp.where(valid & ~dup, d, jnp.inf)
-        nd, pos = jax.lax.top_k(-d, kg)
-        return jnp.take_along_axis(c, pos, axis=1), -nd
+        return _rerank_rows(xb, x_sq, q, c[:, :m1], c[:, m1:], kg,
+                            ip_metric,
+                            old_d=None if first_d is None else f)
 
     out, outd = jax.lax.map(one, (cand.reshape(-1, chunk, m),
                                   qx.reshape(-1, chunk, dim),
@@ -713,27 +694,27 @@ def _walk_refine_fused(dataset, knn, table, proj, scales, kg, itopk,
     return jax.lax.fori_loop(0, n_chunks, body, knn)
 
 
-def _rerank_rows(dataset, x_sq_all, qf, old, cand, kg, ip_metric):
+def _rerank_rows(dataset, x_sq_all, qf, old, cand, kg, ip_metric,
+                 old_d=None):
     """Exact rerank of [old | cand] ids for one chunk of self-queries —
     the ONE copy of the duplicate-mask + rerank body (duplicates keep
     their FIRST occurrence via the stable double-argsort, so ``old``
-    entries win ties).  Gathered rows cast to bf16 AFTER the gather — a
-    full bf16 dataset copy is a ~2 GB transient at deep scale.  Returns
-    (ids (chunk, kg), keys (chunk, kg))."""
-    chunk = qf.shape[0]
+    entries win ties).  ``old_d`` (optional) carries already-exact keys
+    for ``old`` so only ``cand`` is gathered/scored — the refinement
+    rounds' half-gather path.  Gathered rows cast to bf16 AFTER the
+    gather — a full bf16 dataset copy is a ~2 GB transient at deep
+    scale.  Returns (ids (chunk, kg), keys (chunk, kg))."""
     c = jnp.concatenate([old, cand], axis=1)
     valid = c >= 0
     safe = jnp.where(valid, c, 0)
-    cs = jnp.sort(c, axis=1)
-    dup_sorted = jnp.concatenate(
-        [jnp.zeros((chunk, 1), jnp.bool_),
-         cs[:, 1:] == cs[:, :-1]], axis=1)
-    rank = jnp.argsort(jnp.argsort(c, axis=1, stable=True), axis=1)
-    dup = jnp.take_along_axis(dup_sorted, rank, axis=1)
-    rows = dataset[safe].astype(jnp.bfloat16)
+    dup = matrix_ops.row_duplicate_mask(c)
+    gathered = safe if old_d is None else safe[:, old.shape[1]:]
+    rows = dataset[gathered].astype(jnp.bfloat16)
     ip = jnp.einsum("qd,qmd->qm", qf.astype(jnp.bfloat16), rows,
                     preferred_element_type=jnp.float32)
-    d = -ip if ip_metric else x_sq_all[safe] - 2.0 * ip
+    d = -ip if ip_metric else x_sq_all[gathered] - 2.0 * ip
+    if old_d is not None:
+        d = jnp.concatenate([old_d, d], axis=1)
     d = jnp.where(valid & ~dup, d, jnp.inf)
     nd, pos = jax.lax.top_k(-d, kg)
     return jnp.take_along_axis(c, pos, axis=1), -nd

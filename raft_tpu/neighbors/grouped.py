@@ -34,6 +34,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.matrix import ops as matrix_ops
+
 GROUP = 128          # pair-group size: one full MXU tile of queries
 _GROUP_ROUND = 256   # n_groups rounding quantum (compile-cache stability)
 
@@ -167,12 +169,7 @@ def dedup_super_probes(probes: jax.Array, factor: int, n_super: int
     lists of one tile pays for the tile ONCE — the duplicate pairs are
     sentineled out here and dropped by :func:`build_groups`."""
     sp = probes // factor
-    ss = jnp.sort(sp, axis=1)
-    dup_sorted = jnp.concatenate(
-        [jnp.zeros((sp.shape[0], 1), jnp.bool_),
-         ss[:, 1:] == ss[:, :-1]], axis=1)
-    rank = jnp.argsort(jnp.argsort(sp, axis=1, stable=True), axis=1)
-    dup = jnp.take_along_axis(dup_sorted, rank, axis=1)
+    dup = matrix_ops.row_duplicate_mask(sp)
     return jnp.where(dup, n_super, sp)
 
 

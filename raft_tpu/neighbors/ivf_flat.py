@@ -207,10 +207,14 @@ def build(res, params: IndexParams, dataset) -> Index:
         # cluster into few super-tiles (the small-cap scan regime —
         # see search()'s super-tile dedupe)
         cf = centers.astype(jnp.float32)
+        # mean-center before the gram: off-origin data (e.g. all-positive
+        # SIFT features) would otherwise put the mean direction in the
+        # top eigenvector and make the projections ~constant
+        cc = cf - jnp.mean(cf, axis=0, keepdims=True)
         _, cvecs = jnp.linalg.eigh(
-            jax.lax.dot_general(cf, cf, (((0,), (0,)), ((), ())),
+            jax.lax.dot_general(cc, cc, (((0,), (0,)), ((), ())),
                                 preferred_element_type=jnp.float32))
-        centers = centers[jnp.argsort(cf @ cvecs[:, -1])]
+        centers = centers[jnp.argsort(cc @ cvecs[:, -1])]
 
         index = Index(centers=centers,
                       list_data=jnp.zeros((params.n_lists, _LIST_ALIGN, dim),
