@@ -224,8 +224,12 @@ def _calib_build_recall(queries, pool, self_col, vecs, pdim, kg, C,
     self_mask = cols[None, :] == self_col[:, None]
     d_exact = jnp.where(self_mask, jnp.inf, d_exact)
     d_apx = jnp.where(self_mask, jnp.inf, d_apx)
-    _, ie = select_k(d_exact, kg, select_min=True)
-    _, ia = select_k(d_apx, C, select_min=True)
+    # approx_max_k on both sides: the gate reads an overlap STATISTIC,
+    # not a ranking — +-1-2% measurement noise is far inside the
+    # fidelity margin, and the exact selects were ~10 s of per-process
+    # XLA compile (the build pays calibration exactly once)
+    _, ie = jax.lax.approx_max_k(-d_exact, kg, recall_target=0.97)
+    _, ia = jax.lax.approx_max_k(-d_apx, C, recall_target=0.97)
     hits = jnp.any(ie[:, :, None] == ia[:, None, :], axis=-1)
     return jnp.mean(hits.astype(jnp.float32))
 
@@ -410,10 +414,18 @@ def _reverse_edges_host(fwd: np.ndarray, n: int, rev_cap: int
     return np.where(valid, rev, -1).astype(np.int32)
 
 
+# reverse-edge SOURCE width for the refinement reranks: "u ranks v in
+# its top-48" is the strong reverse relation, and the edge sort scales
+# with n*width (129 -> 48 columns cut the 1M device sort ~2.7x; the
+# exact rerank filters weak candidates either way)
+_REV_SRC_CAP = 48
+
+
 def _reverse_edges_auto(knn, n, rev_cap):
-    """Device reverse edges, or the host counting-sort fallback when the
-    edge-list sort transients would not fit next to the deep-scale
-    carriers."""
+    """Reverse edges from the top-``_REV_SRC_CAP`` forward columns —
+    device path, or the host counting-sort fallback when the edge-list
+    sort transients would not fit next to the deep-scale carriers."""
+    knn = knn[:, :min(knn.shape[1], _REV_SRC_CAP)]
     kg = knn.shape[1]
     if n * kg <= _REV_HOST_EDGES:
         return _reverse_edges(knn, n, rev_cap)
@@ -449,9 +461,27 @@ def _merge_refine_chunked(xf, first, second, kg, ip_metric, chunk=4096,
 
     def one(args):
         c, q, f = args                  # (chunk, m), (chunk, dim), (chunk, m1?)
-        return _rerank_rows(xb, x_sq, q, c[:, :m1], c[:, m1:], kg,
-                            ip_metric,
-                            old_d=None if first_d is None else f)
+        if first_d is None:
+            return _rerank_rows(xb, x_sq, q, c[:, :m1], c[:, m1:], kg,
+                                ip_metric)
+        # first carries exact sorted keys (the previous round's merge
+        # output): score only `second`, then reuse the search path's
+        # sorted-buffer bitonic merge — membership-mask dedupe + one
+        # narrow candidate sort instead of three full-width (m1+m2)
+        # stable sorts + a wide top_k (the build rounds were
+        # merge-sort-bound, ~14 s/round at 1M before this)
+        sec = c[:, m1:]
+        valid = sec >= 0
+        safe = jnp.where(valid, sec, 0)
+        rows = xb[safe]                              # (chunk, m2, dim)
+        ip = jnp.einsum("qd,qmd->qm", q, rows,
+                        preferred_element_type=jnp.float32)
+        d2 = -ip if ip_metric else x_sq[safe] - 2.0 * ip
+        d2 = jnp.where(valid, d2, jnp.inf)
+        bd, bi, _ = _merge_candidates(
+            f, c[:, :m1], jnp.zeros((c.shape[0], m1), jnp.bool_),
+            d2, sec, kg)
+        return bi, bd
 
     out, outd = jax.lax.map(one, (cand.reshape(-1, chunk, m),
                                   qx.reshape(-1, chunk, dim),
@@ -538,7 +568,7 @@ def _build_knn_graph_clustered(res, dataset, kg: int, p: IndexParams
     # tile (the kNN relation is nearly symmetric).  They join the FIRST
     # refinement rerank below instead of paying their own full-width
     # exact pass (round-5 diet: the standalone reverse-merge was 17 s
-    # of the 1M build).
+    # of the 1M build; source width capped inside _reverse_edges_auto).
     rev = _reverse_edges_auto(knn, n, min(kg, 64))
     deep = n >= _DEEP_SCALE_ROWS
     if deep:
@@ -694,27 +724,23 @@ def _walk_refine_fused(dataset, knn, table, proj, scales, kg, itopk,
     return jax.lax.fori_loop(0, n_chunks, body, knn)
 
 
-def _rerank_rows(dataset, x_sq_all, qf, old, cand, kg, ip_metric,
-                 old_d=None):
+def _rerank_rows(dataset, x_sq_all, qf, old, cand, kg, ip_metric):
     """Exact rerank of [old | cand] ids for one chunk of self-queries —
     the ONE copy of the duplicate-mask + rerank body (duplicates keep
-    their FIRST occurrence via the stable double-argsort, so ``old``
-    entries win ties).  ``old_d`` (optional) carries already-exact keys
-    for ``old`` so only ``cand`` is gathered/scored — the refinement
-    rounds' half-gather path.  Gathered rows cast to bf16 AFTER the
-    gather — a full bf16 dataset copy is a ~2 GB transient at deep
-    scale.  Returns (ids (chunk, kg), keys (chunk, kg))."""
+    their FIRST occurrence via :func:`matrix_ops.row_duplicate_mask`,
+    so ``old`` entries win ties).  Callers that already hold exact
+    sorted keys for ``old`` should use the bitonic-merge path in
+    :func:`_merge_refine_chunked` instead.  Gathered rows cast to bf16
+    AFTER the gather — a full bf16 dataset copy is a ~2 GB transient at
+    deep scale.  Returns (ids (chunk, kg), keys (chunk, kg))."""
     c = jnp.concatenate([old, cand], axis=1)
     valid = c >= 0
     safe = jnp.where(valid, c, 0)
     dup = matrix_ops.row_duplicate_mask(c)
-    gathered = safe if old_d is None else safe[:, old.shape[1]:]
-    rows = dataset[gathered].astype(jnp.bfloat16)
+    rows = dataset[safe].astype(jnp.bfloat16)
     ip = jnp.einsum("qd,qmd->qm", qf.astype(jnp.bfloat16), rows,
                     preferred_element_type=jnp.float32)
-    d = -ip if ip_metric else x_sq_all[gathered] - 2.0 * ip
-    if old_d is not None:
-        d = jnp.concatenate([old_d, d], axis=1)
+    d = -ip if ip_metric else x_sq_all[safe] - 2.0 * ip
     d = jnp.where(valid & ~dup, d, jnp.inf)
     nd, pos = jax.lax.top_k(-d, kg)
     return jnp.take_along_axis(c, pos, axis=1), -nd
@@ -947,12 +973,13 @@ def _reverse_edges(fwd, n, rev_cap):
     """
     half = fwd.shape[1]
     # rank-major edge order is a transpose, not a sort; the single stable
-    # argsort by dst then yields (dst asc, rank asc) order
+    # key-val sort by dst then yields (dst asc, rank asc) order.
+    # sort_key_val carries src through the sort directly — the earlier
+    # argsort + two 129M-element payload gathers were ~5 s of the 1M
+    # build on their own.
     dst = fwd.T.ravel()
     src = jnp.tile(jnp.arange(n, dtype=jnp.int32), half)
-    o = jnp.argsort(dst, stable=True)
-    dsts = dst[o]
-    srcs = src[o]
+    dsts, srcs = jax.lax.sort_key_val(dst, src, is_stable=True)
     e = dsts.shape[0]
     nodes = jnp.arange(n, dtype=dsts.dtype)
     starts = jnp.searchsorted(dsts, nodes)                   # (n,)
