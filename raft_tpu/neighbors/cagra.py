@@ -424,12 +424,16 @@ _REV_SRC_CAP = 48
 def _reverse_edges_auto(knn, n, rev_cap):
     """Reverse edges from the top-``_REV_SRC_CAP`` forward columns —
     device path, or the host counting-sort fallback when the edge-list
-    sort transients would not fit next to the deep-scale carriers."""
-    knn = knn[:, :min(knn.shape[1], _REV_SRC_CAP)]
-    kg = knn.shape[1]
+    sort transients would not fit next to the deep-scale carriers.
+    The width cap is applied per path: slicing on device BEFORE the
+    host transfer materializes a second lane-padded (n, 128) copy
+    (n*512 B — 5 GB at 10M), which is exactly the transient the host
+    path exists to avoid."""
+    kg = min(knn.shape[1], _REV_SRC_CAP)
     if n * kg <= _REV_HOST_EDGES:
-        return _reverse_edges(knn, n, rev_cap)
-    return jnp.asarray(_reverse_edges_host(np.asarray(knn), n, rev_cap))
+        return _reverse_edges(knn[:, :kg], n, rev_cap)
+    return jnp.asarray(_reverse_edges_host(np.asarray(knn)[:, :kg], n,
+                                           rev_cap))
 
 
 @functools.partial(jax.jit, static_argnames=("kg", "ip_metric", "chunk",
@@ -951,8 +955,16 @@ def _detour_order(knn_graph, block=256):
     nb = blocks.shape[0]
     nb_pad = ((nb + cpb - 1) // cpb) * cpb
     blocks = jnp.pad(blocks, ((0, nb_pad - nb), (0, 0), (0, 0)))
-    out = [_detour_chunk(knn_graph, blocks[s:s + cpb], block=block)
-           for s in range(0, nb_pad, cpb)]
+    out = []
+    for ci, s in enumerate(range(0, nb_pad, cpb)):
+        out.append(_detour_chunk(knn_graph, blocks[s:s + cpb],
+                                 block=block))
+        if n >= _DEEP_SCALE_ROWS and ci % 8 == 7:
+            # pace the dispatch queue at deep scale: hundreds of
+            # enqueued sort-heavy dispatches have crashed the remote
+            # TPU worker; a tiny readback every few chunks bounds the
+            # in-flight queue without serializing every dispatch
+            np.asarray(out[-1][0, 0])
     out = jnp.concatenate(out, axis=0) if len(out) > 1 else out[0]
     return out.reshape(nb_pad * block, deg)[:n]
 
