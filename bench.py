@@ -69,6 +69,25 @@ def _ground_truth(res, db, queries):
     return np.asarray(gt_i)
 
 
+def _print_stage_breakdown(harness: str, index) -> None:
+    """Emit the per-stage build breakdown attached by
+    ``observability.build_scope`` (one JSON line beside the headline).
+    Collection is enabled only around the build — the timed QPS loops
+    run with it off so the stage fences cannot skew search timings."""
+    from raft_tpu import observability as obs
+
+    rep = obs.build_report(index)
+    if rep is None:
+        return
+    print(json.dumps({"stage_breakdown": {
+        "harness": harness,
+        "total_s": round(rep["total_s"], 3),
+        "stages": {name: round(t["total_s"], 3)
+                   for name, t in sorted(rep["stages"].items())},
+        "counters": rep["counters"],
+    }}), flush=True)
+
+
 def bench_ivf_pq(res, db, queries, gt_i=None) -> dict:
     from raft_tpu.neighbors import ivf_pq
 
@@ -76,12 +95,16 @@ def bench_ivf_pq(res, db, queries, gt_i=None) -> dict:
     if gt_i is None:
         gt_i = _ground_truth(res, db, queries)
 
+    from raft_tpu import observability as obs
+
     params = ivf_pq.IndexParams(n_lists=N_LISTS, pq_dim=PQ_DIM,
                                 kmeans_n_iters=20)
     t0 = time.perf_counter()
-    index = ivf_pq.build(res, params, db)
-    index.list_codes.block_until_ready()
+    with obs.collecting():
+        index = ivf_pq.build(res, params, db)
+        index.list_codes.block_until_ready()
     build_s = time.perf_counter() - t0
+    _print_stage_breakdown("ivf_pq", index)
 
     from raft_tpu.neighbors.refine import refine as refine_fn
 
@@ -153,11 +176,17 @@ def bench_cagra(res, db, queries, gt_i=None) -> dict:
     build_s = time.perf_counter() - t0
     # second build on the warm process: the steady-state number a
     # serving deployment rebuilding its index actually sees (the cold
-    # number above includes one-time XLA compiles)
+    # number above includes one-time XLA compiles).  Stage collection
+    # runs on this build only — the per-stage fences land on boundaries
+    # the warm build already host-syncs, so the headline stays honest.
+    from raft_tpu import observability as obs
+
     t0 = time.perf_counter()
-    index = cagra.build(res, cagra.IndexParams(graph_degree=64), db)
-    np.asarray(index.graph[0, 0])
+    with obs.collecting():
+        index = cagra.build(res, cagra.IndexParams(graph_degree=64), db)
+        np.asarray(index.graph[0, 0])
     build_warm_s = time.perf_counter() - t0
+    _print_stage_breakdown("cagra", index)
 
     best = None
     points = []

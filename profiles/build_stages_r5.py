@@ -1,6 +1,13 @@
-"""Warm per-stage breakdown of the clustered CAGRA build at 1M
-(mirrors _build_knn_graph_clustered with forced syncs between stages;
-second run reported so compiles are excluded)."""
+"""Warm per-stage breakdown of the clustered CAGRA build at 1M.
+
+Round-5 version hand-replicated _build_knn_graph_clustered with forced
+syncs between stages; now the build itself is instrumented
+(raft_tpu.observability stages fence at every stage boundary when
+collection is on), so this just runs the REAL build twice under
+``obs.collecting()`` and prints each build's attached stage report —
+second run reported warm so compiles are excluded (run 0 also carries
+the ``xla.*`` compile timers captured via jax.monitoring).
+"""
 
 import json
 import sys
@@ -18,8 +25,7 @@ def main():
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     import jax.numpy as jnp
     from raft_tpu import DeviceResources
-    from raft_tpu.cluster import kmeans_balanced
-    from raft_tpu.distance.types import DistanceType
+    from raft_tpu import observability as obs
     from raft_tpu.neighbors import cagra
 
     n, dim, latent = 1_000_000, 128, 16
@@ -32,92 +38,28 @@ def main():
     db.block_until_ready()
     res = DeviceResources(seed=0)
     p = cagra.IndexParams(graph_degree=64)
-    kg = 129
-    xf = db.astype(jnp.float32)
 
     for run in range(2):
+        obs.reset()
         t_all = time.perf_counter()
-        t0 = time.perf_counter()
-        n_lists = max(min(n // 64, 4 * int(np.sqrt(n))), 8)
-        bal = kmeans_balanced.KMeansBalancedParams(
-            n_iters=10, metric=DistanceType.L2Expanded)
-        n_train = min(n, max(n_lists * 8, max(65536, n // 10)))
-        trainset = xf[::max(n // n_train, 1)][:n_train]
-        centers = kmeans_balanced.fit(res, bal, trainset, n_lists)
-        labels = kmeans_balanced.predict(res, bal, xf, centers)
-        sizes = jax.ops.segment_sum(jnp.ones(n, jnp.int32), labels,
-                                    num_segments=n_lists)
-        cap = max(-(-int(jnp.max(sizes)) // 8) * 8, 8)
-        t_km = time.perf_counter() - t0
-
-        t0 = time.perf_counter()
-        C = max(int(p.build_refine_rate * kg), kg)
-        pdim, vecs = cagra._build_pdim(db, p.metric, kg, C)
-        np.asarray(vecs[0, 0])
-        t_calib = time.perf_counter() - t0
-
-        t0 = time.perf_counter()
-        proj = (vecs[:, dim - pdim:] if pdim < dim
-                else jnp.eye(dim, dtype=jnp.float32))
-        P_proj, P_sq, P_id = cagra._build_layout(
-            xf, xf @ proj, labels, n_lists, cap)
-        nbrs = cagra._center_neighbors(centers, 33, False)
-        np.asarray(P_id[0, 0])
-        t_layout = time.perf_counter() - t0
-
-        mean = max(n / n_lists, 1.0)
-        t = min(n_lists, max(p.build_n_probes,
-                             -(-p.build_candidates // int(mean))))
-        nbrs = cagra._center_neighbors(centers, t, False)
-        t0 = time.perf_counter()
-        LB = max(1, min(8, (256 << 20) // max(cap * t * cap * 4, 1)))
-        CH = cagra._SCAN_LISTS_PER_DISPATCH
-        n_pad = -(-n_lists // (LB * CH)) * (LB * CH) \
-            if n_lists > LB * CH else -(-n_lists // LB) * LB
-        ids = np.minimum(np.arange(n_pad, dtype=np.int32), n_lists - 1)
-        knn = jnp.full((n, kg), -1, jnp.int32)
-        for s in range(0, n_pad, LB * CH):
-            cid = jnp.asarray(ids[s:s + LB * CH])
-            out_c = cagra._scan_chunk(P_proj, P_sq, P_id, nbrs, cid,
-                                      cap, kg, False, LB)
-            rows = P_id[cid].reshape(-1)
-            rows = jnp.where(rows >= 0, rows, n)
-            knn = knn.at[rows].set(out_c.reshape(-1, kg), mode="drop")
-        np.asarray(knn[0, 0])
-        t_scan = time.perf_counter() - t0
-
-        t0 = time.perf_counter()
-        rev = cagra._reverse_edges(knn, n, kg)
-        knn, knn_d = cagra._merge_refine_chunked(xf, knn, rev, kg, False,
-                                                 with_d=True)
-        np.asarray(knn[0, 0])
-        t_rev = time.perf_counter() - t0
-
-        walk_times = []
-        for r in range(2):
-            t0 = time.perf_counter()
-            knn, knn_d = cagra._graph_refine_round(res, db, knn, kg,
-                                                   p.metric, pdim, 8,
-                                                   knn_d=knn_d)
-            np.asarray(knn[0, 0])
-            walk_times.append(round(time.perf_counter() - t0, 1))
-
-        t0 = time.perf_counter()
-        ids2 = jnp.arange(n, dtype=knn.dtype)[:, None]
-        order = jnp.argsort(knn == ids2, axis=1, stable=True)
-        knn_ns = jnp.take_along_axis(knn, order, axis=1)[:, :128]
-        graph = cagra.prune(res, knn_ns.astype(jnp.int32), 64)
-        np.asarray(graph[0, 0])
-        t_prune = time.perf_counter() - t0
-
+        with obs.collecting():
+            index = cagra.build(res, p, db)
+            np.asarray(index.graph[0, 0])
+        total_s = time.perf_counter() - t_all
+        rep = obs.build_report(index)
+        snap = obs.snapshot()
         print(json.dumps({
-            "run": run, "pdim": pdim, "t": t, "cap": cap, "LB": LB,
-            "kmeans_s": round(t_km, 1), "calib_s": round(t_calib, 1),
-            "layout_s": round(t_layout, 1), "scan_s": round(t_scan, 1),
-            "revmerge_s": round(t_rev, 1), "walk_s": walk_times,
-            "prune_s": round(t_prune, 1),
-            "total_s": round(time.perf_counter() - t_all, 1)}),
-            flush=True)
+            "run": run,
+            "total_s": round(total_s, 1),
+            "stages": {name: {"count": t["count"],
+                              "total_s": round(t["total_s"], 1)}
+                       for name, t in sorted(rep["stages"].items())},
+            "counters": rep["counters"],
+            # run 0 only: XLA compile time captured via jax.monitoring
+            "xla_compile_s": round(sum(
+                t["total_s"] for name, t in snap["timers"].items()
+                if name.startswith("xla.")), 1),
+        }), flush=True)
 
 
 if __name__ == "__main__":

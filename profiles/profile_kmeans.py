@@ -1,9 +1,11 @@
 """Profile the k-means Lloyd loop at the bench workload (1M x 128, k=1024).
 
 Run on the real chip:  python profiles/profile_kmeans.py
-Prints fit timing and writes a trace under profiles/kmeans_trace.
+Prints fit timing (wall clock + the observability ``kmeans.fit`` timer
+and iteration counter) and writes a trace under profiles/kmeans_trace.
 """
 
+import json
 import sys
 import time
 
@@ -16,6 +18,7 @@ def main():
     sys.path.insert(0, ".")
     import bench
     from raft_tpu import DeviceResources
+    from raft_tpu import observability as obs
     from raft_tpu.cluster import kmeans
     from raft_tpu.cluster.kmeans_types import InitMethod, KMeansParams
 
@@ -28,11 +31,14 @@ def main():
                           init=InitMethod.Random)
     c, _, _ = kmeans.fit(res, params, db)     # warm
     np.asarray(c)
+    obs.reset()
     t0 = time.perf_counter()
-    c, inertia, n_iter = kmeans.fit(res, params, db)
-    np.asarray(c)
+    with obs.collecting():
+        c, inertia, n_iter = kmeans.fit(res, params, db)
+        np.asarray(c)
     dt = time.perf_counter() - t0
     print(f"fit: {dt*1000:.0f} ms  ({20/dt:.1f} iter/s)")
+    print(json.dumps(obs.snapshot(), default=str), flush=True)
 
     with jax.profiler.trace("profiles/kmeans_trace"):
         c, inertia, n_iter = kmeans.fit(res, params, db)

@@ -34,6 +34,7 @@ from raft_tpu.cluster.kmeans_types import InitMethod, KMeansParams
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.core.tracing import range as named_range
+from raft_tpu import observability as obs
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn
 from raft_tpu.distance.pairwise import pairwise_distance
 from raft_tpu.distance.types import DistanceType
@@ -283,21 +284,29 @@ def fit(
         best = None
         # Array init is deterministic — restarts would be bit-identical.
         n_init = 1 if params.init == InitMethod.Array else max(1, params.n_init)
-        for restart in range(n_init):
-            key = jax.random.fold_in(jax.random.key(params.seed), restart)
-            if params.init == InitMethod.Array:
-                expects(centroids is not None,
-                        "InitMethod.Array requires centroids")
-                c0 = jnp.asarray(centroids, X.dtype)
-            elif params.init == InitMethod.Random:
-                c0 = init_random(res, X, params.n_clusters, key=key)
-            else:
-                c0 = init_plus_plus(res, X, params.n_clusters, key=key)
-            c, inertia, n_iter, _ = _lloyd(
-                X, c0, w, jnp.float32(params.tol), params.n_clusters,
-                params.max_iter, params.metric, use_fused=use_fused)
-            if best is None or float(inertia) < float(best[1]):
-                best = (c, inertia, n_iter)
+        # the Lloyd loop is one fused while_loop, so per-iteration timing is
+        # not observable; the stage records the whole fit and the iteration
+        # count comes from the loop carry afterwards
+        with obs.stage("kmeans.fit") as st:
+            for restart in range(n_init):
+                key = jax.random.fold_in(jax.random.key(params.seed), restart)
+                if params.init == InitMethod.Array:
+                    expects(centroids is not None,
+                            "InitMethod.Array requires centroids")
+                    c0 = jnp.asarray(centroids, X.dtype)
+                elif params.init == InitMethod.Random:
+                    c0 = init_random(res, X, params.n_clusters, key=key)
+                else:
+                    c0 = init_plus_plus(res, X, params.n_clusters, key=key)
+                c, inertia, n_iter, _ = _lloyd(
+                    X, c0, w, jnp.float32(params.tol), params.n_clusters,
+                    params.max_iter, params.metric, use_fused=use_fused)
+                if best is None or float(inertia) < float(best[1]):
+                    best = (c, inertia, n_iter)
+            st.fence(best[0])
+        if obs.enabled():
+            obs.registry().counter("kmeans.iterations").inc(int(best[2]))
+            obs.registry().counter("kmeans.restarts").inc(n_init)
         return best
 
 

@@ -31,6 +31,7 @@ from raft_tpu.cluster.kmeans_types import KMeansBalancedParams
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.core.tracing import range as named_range
+from raft_tpu import observability as obs
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn
 from raft_tpu.distance.types import DistanceType
 from raft_tpu.core.outputs import raw
@@ -249,7 +250,8 @@ def fit(
     auto-selects it for n_clusters >= _MESO_THRESHOLD (the reference's
     build_hierarchical path, detail/kmeans_balanced.cuh).
     """
-    with named_range("kmeans_balanced::fit"):
+    with named_range("kmeans_balanced::fit"), \
+            obs.stage("kmeans_balanced.fit") as st:
         X = ensure_array(X, "X")
         n, _ = X.shape
         expects(n_clusters <= n, "kmeans_balanced.fit: n_clusters > n_samples")
@@ -262,8 +264,10 @@ def fit(
         if hierarchical is None:
             hierarchical = n_clusters >= _MESO_THRESHOLD
         if hierarchical and n_clusters >= 4:
-            return _fit_hierarchical(X.astype(jnp.float32), n_clusters,
-                                     key, params.n_iters, params.metric)
+            centroids = _fit_hierarchical(X.astype(jnp.float32), n_clusters,
+                                          key, params.n_iters, params.metric)
+            st.fence(centroids)
+            return centroids
         # evenly-strided init over the (caller-shuffled) trainset — the
         # reference seeds from strided trainset rows.
         stride = max(n // n_clusters, 1)
@@ -277,6 +281,7 @@ def fit(
         centroids, _ = _balanced_loop(
             X, c0, key, n_clusters, params.n_iters, params.metric,
             use_fused=_fused_ok(n, X.shape[1], n_clusters, params.metric))
+        st.fence(centroids)
         return centroids
 
 

@@ -39,6 +39,27 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.core.error import expects
+from raft_tpu import observability as obs
+
+
+def _record_collective(op: str, x=None) -> None:
+    """Bump ``comms.<op>.calls`` / ``comms.<op>.bytes`` when collection is on.
+
+    Collectives run inside traced contexts (shard_map / pjit), so these
+    counters record *traced* calls — collectives in the program, with bytes
+    from the static shard shape — not per-step executions; a jit cache hit
+    re-runs the collective without re-tracing it."""
+    if not obs.enabled():
+        return
+    reg = obs.registry()
+    reg.counter(f"comms.{op}.calls").inc()
+    if x is not None:
+        try:
+            nbytes = int(x.size) * x.dtype.itemsize
+        except (AttributeError, TypeError):
+            nbytes = 0
+        if nbytes:
+            reg.counter(f"comms.{op}.bytes").inc(nbytes)
 
 
 class op_t:
@@ -95,6 +116,7 @@ class Comms:
     # -- collectives -------------------------------------------------------
     def allreduce(self, x, op: str = op_t.SUM):
         """Reference: comms.hpp allreduce → ncclAllReduce."""
+        _record_collective("allreduce", x)
         if op == op_t.SUM:
             return jax.lax.psum(x, self.axis_name)
         if op == op_t.MAX:
@@ -110,6 +132,7 @@ class Comms:
     def bcast(self, x, root: int = 0):
         """Broadcast root's value to all ranks (reference: bcast →
         ncclBroadcast): psum of the root-masked buffer."""
+        _record_collective("bcast", x)
         is_root = jax.lax.axis_index(self.axis_name) == root
         masked = jnp.where(is_root, x, jnp.zeros_like(x))
         return jax.lax.psum(masked, self.axis_name)
@@ -124,6 +147,7 @@ class Comms:
     def allgather(self, x):
         """Concatenate equal-size shards along a new leading axis
         (reference: allgather → ncclAllGather; callers reshape)."""
+        _record_collective("allgather", x)
         return jax.lax.all_gather(x, self.axis_name)
 
     def allgatherv(self, x, recvcounts: Sequence[int]):
@@ -131,6 +155,7 @@ class Comms:
         Easy' padding dance done for the caller): shards padded to
         max(recvcounts) on axis 0; returns (n_ranks, max_count, ...) plus the
         static counts for unpadding."""
+        _record_collective("allgatherv", x)
         counts = tuple(int(c) for c in recvcounts)
         pad_to = max(counts)
         pad = [(0, pad_to - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
@@ -149,6 +174,7 @@ class Comms:
         """Reference: reducescatter → ncclReduceScatter.  ``x`` is the
         full-size buffer on every rank; each rank gets its 1/n slice of the
         sum, scattered along axis 0."""
+        _record_collective("reducescatter", x)
         expects(op == op_t.SUM,
                 "reducescatter supports SUM (as XLA psum_scatter)")
         return jax.lax.psum_scatter(x, self.axis_name, tiled=True)
@@ -176,6 +202,7 @@ class Comms:
         p2p message, the honest XLA translation of dynamic routing.
         Two senders targeting one rank need two tags (one recv can only
         name one source); waitall() rejects unclaimed sends."""
+        _record_collective("isend", x)
         n = self.get_size()
         expects(isinstance(n, int), "isend needs a static axis size")
         dsts = []
@@ -273,6 +300,7 @@ class Comms:
         """Simultaneous send-to-dst / recv-from-src
         (reference: device_sendrecv).  Expressed as a ppermute: every rank
         declares its (src → this) edge; ranks not in any edge get zeros."""
+        _record_collective("device_sendrecv", x)
         n = self.get_size()
         expects(isinstance(n, int),
                 "device_sendrecv needs a static axis size")
@@ -286,6 +314,7 @@ class Comms:
     def device_send(self, x, dst_shift: int):
         """Shift-pattern send (reference: device_send; UCX tags replaced by
         a static ring/shift pattern — the idiomatic TPU p2p)."""
+        _record_collective("device_send", x)
         n = self.get_size()
         perm = [(r, (r + dst_shift) % n) for r in range(n)]
         return jax.lax.ppermute(x, self.axis_name, perm)
@@ -296,6 +325,7 @@ class Comms:
     def device_multicast_sendrecv(self, x, dsts: Sequence[int]):
         """Multicast (reference: device_multicast_sendrecv): gather-based —
         every rank sees every shard, selects its sources."""
+        _record_collective("multicast_sendrecv", x)
         return jax.lax.all_gather(x, self.axis_name)
 
     # -- split / sync ------------------------------------------------------
@@ -330,6 +360,7 @@ class Comms:
     def barrier(self):
         """Reference: barrier.  A psum of a scalar is a full barrier in the
         bulk-synchronous XLA model."""
+        _record_collective("barrier")
         jax.lax.psum(jnp.zeros((), jnp.int32), self.axis_name)
 
     def sync_stream(self) -> int:

@@ -36,6 +36,7 @@ from raft_tpu.core import serialize as ser
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.core.tracing import range as named_range
+from raft_tpu import observability as obs
 from raft_tpu.distance.types import DistanceType
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.utils.precision import get_matmul_precision
@@ -58,9 +59,20 @@ class IndexParams:
 
 @dataclasses.dataclass
 class SearchParams:
-    """Reference: ivf_flat_types.hpp:76 ``search_params``."""
+    """Reference: ivf_flat_types.hpp:76 ``search_params``.
+
+    ``coarse_recall_target`` / ``exact_coarse`` control the approx probe
+    ranking (``approx_max_k``) of :func:`_select_clusters`: the recall
+    target trades coarse ranking fidelity for speed, and ``exact_coarse``
+    forces ``lax.top_k``.  Probe selection also falls back to the exact
+    select on its own when ``n_probes`` is close to ``n_lists`` (the
+    approximation saves nothing when nearly every list is probed anyway).
+    Inherited by :class:`raft_tpu.neighbors.ivf_pq.SearchParams`.
+    """
 
     n_probes: int = 20
+    coarse_recall_target: float = 0.95
+    exact_coarse: bool = False
 
 
 @jax.tree_util.register_pytree_node_class
@@ -183,38 +195,41 @@ def build(res, params: IndexParams, dataset) -> Index:
     (``kmeans_trainset_fraction``, as detail/ivf_flat_build.cuh:336), then
     assigns and packs all rows.
     """
-    with named_range("ivf_flat::build"):
+    with named_range("ivf_flat::build"), \
+            obs.build_scope("ivf_flat.build") as rep:
         dataset = ensure_array(dataset, "dataset")
         expects(dataset.ndim == 2, "ivf_flat.build: 2-D dataset required")
         n, dim = dataset.shape
         expects(params.n_lists <= n, "ivf_flat.build: n_lists > n_rows")
 
-        n_train = max(params.n_lists,
-                      int(n * params.kmeans_trainset_fraction))
-        if n_train < n:
-            key = res.next_key()
-            sel = jax.random.choice(key, n, (n_train,), replace=False)
-            trainset = dataset[sel]
-        else:
-            trainset = dataset
-        bal = KMeansBalancedParams(n_iters=params.kmeans_n_iters,
-                                   metric=params.metric
-                                   if params.metric == DistanceType.InnerProduct
-                                   else DistanceType.L2Expanded)
-        centers = kmeans_balanced.fit(res, bal, trainset, params.n_lists)
-        # order lists along the centers' first principal component:
-        # spatially adjacent lists get adjacent ids, so a query's probes
-        # cluster into few super-tiles (the small-cap scan regime —
-        # see search()'s super-tile dedupe)
-        cf = centers.astype(jnp.float32)
-        # mean-center before the gram: off-origin data (e.g. all-positive
-        # SIFT features) would otherwise put the mean direction in the
-        # top eigenvector and make the projections ~constant
-        cc = cf - jnp.mean(cf, axis=0, keepdims=True)
-        _, cvecs = jnp.linalg.eigh(
-            jax.lax.dot_general(cc, cc, (((0,), (0,)), ((), ())),
-                                preferred_element_type=jnp.float32))
-        centers = centers[jnp.argsort(cc @ cvecs[:, -1])]
+        with obs.stage("ivf_flat.build.kmeans") as st:
+            n_train = max(params.n_lists,
+                          int(n * params.kmeans_trainset_fraction))
+            if n_train < n:
+                key = res.next_key()
+                sel = jax.random.choice(key, n, (n_train,), replace=False)
+                trainset = dataset[sel]
+            else:
+                trainset = dataset
+            bal = KMeansBalancedParams(n_iters=params.kmeans_n_iters,
+                                       metric=params.metric
+                                       if params.metric == DistanceType.InnerProduct
+                                       else DistanceType.L2Expanded)
+            centers = kmeans_balanced.fit(res, bal, trainset, params.n_lists)
+            # order lists along the centers' first principal component:
+            # spatially adjacent lists get adjacent ids, so a query's probes
+            # cluster into few super-tiles (the small-cap scan regime —
+            # see search()'s super-tile dedupe)
+            cf = centers.astype(jnp.float32)
+            # mean-center before the gram: off-origin data (e.g. all-positive
+            # SIFT features) would otherwise put the mean direction in the
+            # top eigenvector and make the projections ~constant
+            cc = cf - jnp.mean(cf, axis=0, keepdims=True)
+            _, cvecs = jnp.linalg.eigh(
+                jax.lax.dot_general(cc, cc, (((0,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32))
+            centers = centers[jnp.argsort(cc @ cvecs[:, -1])]
+            st.fence(centers)
 
         index = Index(centers=centers,
                       list_data=jnp.zeros((params.n_lists, _LIST_ALIGN, dim),
@@ -227,7 +242,7 @@ def build(res, params: IndexParams, dataset) -> Index:
         if params.add_data_on_build:
             index = extend(res, index, dataset,
                            jnp.arange(n, dtype=jnp.int32))
-        return index
+        return rep.attach(index)
 
 
 def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
@@ -250,27 +265,31 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
         else:
             new_indices = ensure_array(new_indices, "new_indices")
 
-        bal = KMeansBalancedParams(metric=index.metric
-                                   if index.metric == DistanceType.InnerProduct
-                                   else DistanceType.L2Expanded)
-        new_labels = kmeans_balanced.predict(res, bal, new_vectors,
-                                             index.centers)
-        new_counts = jax.ops.segment_sum(
-            jnp.ones(n_new, jnp.int32), new_labels,
-            num_segments=index.n_lists)
-        needed = index.list_sizes + new_counts
+        with obs.stage("ivf_flat.extend.assign") as st:
+            bal = KMeansBalancedParams(metric=index.metric
+                                       if index.metric == DistanceType.InnerProduct
+                                       else DistanceType.L2Expanded)
+            new_labels = kmeans_balanced.predict(res, bal, new_vectors,
+                                                 index.centers)
+            new_counts = jax.ops.segment_sum(
+                jnp.ones(n_new, jnp.int32), new_labels,
+                num_segments=index.n_lists)
+            needed = index.list_sizes + new_counts
+            st.fence(new_labels)
 
         # one host sync over an (n_lists,) reduction decides the path — the
         # only data-dependent choice (capacity is a static shape)
         if int(jnp.max(needed)) <= index.capacity:
-            bufs, rows = [index.list_data], [new_vectors]
-            if index.list_data_sq is not None:
-                bufs.append(index.list_data_sq)
-                rows.append(jnp.sum(
-                    new_vectors.astype(jnp.float32) ** 2, axis=-1))
-            new_bufs, list_idx, sizes = _append_lists_multi(
-                tuple(bufs), tuple(rows), index.list_indices,
-                index.list_sizes, new_labels, new_indices)
+            with obs.stage("ivf_flat.extend.pack") as st:
+                bufs, rows = [index.list_data], [new_vectors]
+                if index.list_data_sq is not None:
+                    bufs.append(index.list_data_sq)
+                    rows.append(jnp.sum(
+                        new_vectors.astype(jnp.float32) ** 2, axis=-1))
+                new_bufs, list_idx, sizes = _append_lists_multi(
+                    tuple(bufs), tuple(rows), index.list_indices,
+                    index.list_sizes, new_labels, new_indices)
+                st.fence(new_bufs)
             list_data = new_bufs[0]
             data_sq = new_bufs[1] if len(new_bufs) > 1 else None
             centers = index.centers
@@ -310,8 +329,10 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
 
         capacity = _round_up(max(int(jnp.max(needed)), _LIST_ALIGN),
                              _LIST_ALIGN)
-        list_data, list_idx, sizes = _pack_lists(
-            all_vecs, all_labels, all_ids, index.n_lists, capacity)
+        with obs.stage("ivf_flat.extend.pack") as st:
+            list_data, list_idx, sizes = _pack_lists(
+                all_vecs, all_labels, all_ids, index.n_lists, capacity)
+            st.fence(list_data)
 
         centers = index.centers
         if index.adaptive_centers:
@@ -332,16 +353,18 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
                      adaptive_centers=index.adaptive_centers)
 
 
-@functools.partial(jax.jit, static_argnames=("k", "n_probes", "metric"))
+@functools.partial(jax.jit, static_argnames=("k", "n_probes", "metric",
+                                             "recall_target", "exact"))
 def _search_impl(centers, list_data, list_indices, queries, k, n_probes,
-                 metric):
+                 metric, recall_target=0.95, exact=False):
     nq = queries.shape[0]
     qf = queries.astype(jnp.float32)
     cf = centers.astype(jnp.float32)
     ip_metric = metric == DistanceType.InnerProduct
 
     # ---- coarse: pick n_probes lists per query (select_clusters analogue) --
-    probes = _select_clusters(centers, queries, n_probes, metric)
+    probes = _select_clusters(centers, queries, n_probes, metric,
+                              recall_target=recall_target, exact=exact)
 
     # ---- fine: scan probed lists, hierarchical select --------------------
     # per-probe local top-k inside the scan + ONE final select over the
@@ -396,8 +419,10 @@ def super_tile_factor(cap: int, n_lists: int, n_probes: int
     return F, n_lists
 
 
-@functools.partial(jax.jit, static_argnames=("n_probes", "metric"))
-def _select_clusters(centers, queries, n_probes, metric):
+@functools.partial(jax.jit, static_argnames=("n_probes", "metric",
+                                             "recall_target", "exact"))
+def _select_clusters(centers, queries, n_probes, metric,
+                     recall_target=0.95, exact=False):
     """Coarse top-``n_probes`` ranking (the select_clusters analogue).
 
     ``approx_max_k`` instead of ``top_k``: probe selection needs a good
@@ -405,7 +430,13 @@ def _select_clusters(centers, queries, n_probes, metric):
     reduction measured 1.8x faster at (5000, 16384) with a 99.3%
     probe-set overlap (the ~0.7% swapped probes are the marginal ones,
     far below the recall noise floor).  On CPU it lowers to the exact
-    select, so test assertions are unaffected."""
+    select, so test assertions are unaffected.
+
+    ``recall_target`` / ``exact`` come from ``SearchParams``
+    (coarse_recall_target / exact_coarse).  When ``n_probes`` is within
+    1/8 of ``n_lists`` the approx reduction is bypassed for ``lax.top_k``:
+    its oversampled partial reduction degenerates to a full select there,
+    so approx would cost the overlap loss for no speedup."""
     qf = queries.astype(jnp.float32)
     cf = centers.astype(jnp.float32)
     q_dot_c = jax.lax.dot_general(qf, cf, (((1,), (1,)), ((), ())),
@@ -416,7 +447,12 @@ def _select_clusters(centers, queries, n_probes, metric):
     else:
         c_sq = jnp.sum(cf * cf, axis=1)
         score = 2.0 * q_dot_c - c_sq[None, :]
-    _, probes = jax.lax.approx_max_k(score, n_probes, recall_target=0.95)
+    n_lists = centers.shape[0]
+    if exact or n_probes >= n_lists - (n_lists // 8):
+        _, probes = jax.lax.top_k(score, n_probes)
+    else:
+        _, probes = jax.lax.approx_max_k(score, n_probes,
+                                         recall_target=recall_target)
     return probes
 
 
@@ -516,15 +552,21 @@ def search(res, params: SearchParams, index: Index, queries, k: int
         from raft_tpu.neighbors import grouped
 
         n_probes = min(params.n_probes, index.n_lists)
+        coarse_rt = getattr(params, "coarse_recall_target", 0.95)
+        exact_coarse = getattr(params, "exact_coarse", False)
         if (isinstance(queries, jax.core.Tracer)
                 or isinstance(index.centers, jax.core.Tracer)):
             # queries or the Index pytree traced by an outer jit/vmap:
             # use the fully traceable probe-order scan
             return _search_impl(index.centers, index.list_data,
                                 index.list_indices, queries, k, n_probes,
-                                index.metric)
-        probes = _select_clusters(index.centers, queries, n_probes,
-                                  index.metric)
+                                index.metric, recall_target=coarse_rt,
+                                exact=exact_coarse)
+        with obs.stage("ivf_flat.search.coarse") as st:
+            probes = _select_clusters(index.centers, queries, n_probes,
+                                      index.metric, recall_target=coarse_rt,
+                                      exact=exact_coarse)
+            st.fence(probes)
         # the fused kernel's one-hot id contraction is f32 — require
         # every actual candidate id (incl. user-supplied extend ids)
         # to be f32-exact, not just the row count
@@ -575,12 +617,14 @@ def search(res, params: SearchParams, index: Index, queries, k: int
                                         list_data_sq=dsq_eff,
                                         use_pallas=use_pallas)
 
-        out = dispatch(n_groups)
-        needed = grouped.commit_groups(index, gkey, pending)
-        if needed:
-            # probe distribution shifted past the cached group count:
-            # re-dispatch at the true size so no pair is dropped
-            out = dispatch(needed)
+        with obs.stage("ivf_flat.search.scan") as st:
+            out = dispatch(n_groups)
+            needed = grouped.commit_groups(index, gkey, pending)
+            if needed:
+                # probe distribution shifted past the cached group count:
+                # re-dispatch at the true size so no pair is dropped
+                out = dispatch(needed)
+            st.fence(out)
         return out
 
 

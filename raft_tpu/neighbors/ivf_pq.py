@@ -48,6 +48,7 @@ from raft_tpu.core import serialize as ser
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.core.tracing import range as named_range
+from raft_tpu import observability as obs
 from raft_tpu.distance.types import DistanceType
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.neighbors.ivf_flat import (_append_lists_multi, _pack_lists,
@@ -88,6 +89,12 @@ class SearchParams:
     """Reference: ivf_pq_types.hpp:110 ``search_params``."""
 
     n_probes: int = 20
+    # coarse probe ranking controls, inherited from IVF-Flat (ONE copy of
+    # the rank arithmetic, ivf_flat._select_clusters): the approx_max_k
+    # recall target, and an exact lax.top_k override.  The exact select is
+    # also auto-chosen when n_probes is close to n_lists.
+    coarse_recall_target: float = 0.95
+    exact_coarse: bool = False
     # lut_dtype applies to the LUT formulation only (fp32 | bf16, the fp8
     # analogue); the reconstruction path stores bf16 residuals and always
     # accumulates fp32 (internal_distance_dtype's contract).
@@ -344,7 +351,8 @@ def _encode(codebooks, resid, codebook_kind, labels=None):
 
 def build(res, params: IndexParams, dataset) -> Index:
     """Build an IVF-PQ index (reference: ivf_pq.cuh:224)."""
-    with named_range("ivf_pq::build"):
+    with named_range("ivf_pq::build"), \
+            obs.build_scope("ivf_pq.build") as rep:
         dataset = ensure_array(dataset, "dataset")
         expects(dataset.ndim == 2, "ivf_pq.build: 2-D dataset required")
         n, dim = dataset.shape
@@ -359,35 +367,40 @@ def build(res, params: IndexParams, dataset) -> Index:
                                   rot_dim != dim, seed=7)
 
         # ---- coarse quantizer (rotated space) --------------------------
-        n_train = max(params.n_lists,
-                      int(n * params.kmeans_trainset_fraction))
-        if n_train < n:
-            sel = jax.random.choice(res.next_key(), n, (n_train,),
-                                    replace=False)
-            trainset = dataset[sel]
-        else:
-            trainset = dataset
-        train_rot = trainset.astype(jnp.float32) @ rotation
-        bal = KMeansBalancedParams(n_iters=params.kmeans_n_iters)
-        centers = kmeans_balanced.fit(res, bal, train_rot, params.n_lists)
+        with obs.stage("ivf_pq.build.kmeans") as st:
+            n_train = max(params.n_lists,
+                          int(n * params.kmeans_trainset_fraction))
+            if n_train < n:
+                sel = jax.random.choice(res.next_key(), n, (n_train,),
+                                        replace=False)
+                trainset = dataset[sel]
+            else:
+                trainset = dataset
+            train_rot = trainset.astype(jnp.float32) @ rotation
+            bal = KMeansBalancedParams(n_iters=params.kmeans_n_iters)
+            centers = kmeans_balanced.fit(res, bal, train_rot,
+                                          params.n_lists)
+            st.fence(centers)
 
         # ---- codebooks over residuals ----------------------------------
-        labels_t = kmeans_balanced.predict(res, bal, train_rot, centers)
-        resid = _subspace_split(train_rot - centers[labels_t], pq_dim)
-        book = 1 << params.pq_bits
-        if params.codebook_kind == CodebookKind.PER_SUBSPACE:
-            keys = jax.random.split(res.next_key(), pq_dim)
-            codebooks = _train_books_per_subspace(
-                jnp.transpose(resid, (1, 0, 2)), keys, book,
-                params.kmeans_n_iters)
-        else:
-            # per-cluster: one book per coarse list over all its residual
-            # subvectors (train_per_cluster, ivf_pq_build.cuh:417)
-            flat = resid.reshape(-1, rot_dim // pq_dim)
-            flat_labels = jnp.repeat(labels_t, pq_dim)
-            codebooks = _train_books_per_cluster(
-                res, flat, flat_labels, params.n_lists, book,
-                params.kmeans_n_iters)
+        with obs.stage("ivf_pq.build.codebooks") as st:
+            labels_t = kmeans_balanced.predict(res, bal, train_rot, centers)
+            resid = _subspace_split(train_rot - centers[labels_t], pq_dim)
+            book = 1 << params.pq_bits
+            if params.codebook_kind == CodebookKind.PER_SUBSPACE:
+                keys = jax.random.split(res.next_key(), pq_dim)
+                codebooks = _train_books_per_subspace(
+                    jnp.transpose(resid, (1, 0, 2)), keys, book,
+                    params.kmeans_n_iters)
+            else:
+                # per-cluster: one book per coarse list over all its residual
+                # subvectors (train_per_cluster, ivf_pq_build.cuh:417)
+                flat = resid.reshape(-1, rot_dim // pq_dim)
+                flat_labels = jnp.repeat(labels_t, pq_dim)
+                codebooks = _train_books_per_cluster(
+                    res, flat, flat_labels, params.n_lists, book,
+                    params.kmeans_n_iters)
+            st.fence(codebooks)
 
         index = Index(
             centers=centers, codebooks=codebooks,
@@ -404,8 +417,10 @@ def build(res, params: IndexParams, dataset) -> Index:
             index = extend(res, index, dataset,
                            jnp.arange(n, dtype=jnp.int32))
         if params.cache_reconstructions and index.list_recon is None:
-            index = _with_recon(res, index)
-        return index
+            with obs.stage("ivf_pq.build.recon_cache") as st:
+                index = _with_recon(res, index)
+                st.fence(index.list_recon)
+        return rep.attach(index)
 
 
 def _train_books_per_cluster(res, flat, flat_labels, n_lists, book, n_iters):
@@ -462,29 +477,32 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
         # several copies of the dataset and OOM a single chip; per-chunk
         # the peak extra memory is O(chunk * rot_dim)
         chunk = 1 << 20
-        codes_parts, label_parts, recon_parts = [], [], []
-        for s0 in range(0, n_new, chunk):
-            v = new_vectors[s0:s0 + chunk]
-            rot_c = v.astype(jnp.float32) @ index.rotation
-            lab_c = kmeans_balanced.predict(res, bal, rot_c, index.centers)
-            resid_c = _subspace_split(rot_c - index.centers[lab_c],
-                                      index.pq_dim)
-            cu = _encode(index.codebooks, resid_c, index.codebook_kind,
-                         lab_c)
+        with obs.stage("ivf_pq.extend.encode") as st:
+            codes_parts, label_parts, recon_parts = [], [], []
+            for s0 in range(0, n_new, chunk):
+                v = new_vectors[s0:s0 + chunk]
+                rot_c = v.astype(jnp.float32) @ index.rotation
+                lab_c = kmeans_balanced.predict(res, bal, rot_c,
+                                                index.centers)
+                resid_c = _subspace_split(rot_c - index.centers[lab_c],
+                                          index.pq_dim)
+                cu = _encode(index.codebooks, resid_c, index.codebook_kind,
+                             lab_c)
+                if index.list_recon is not None:
+                    recon_parts.append(_decode_rows(index.codebooks, cu,
+                                                    lab_c,
+                                                    index.codebook_kind))
+                codes_parts.append(_pack_codes(cu, index.pq_bits))
+                label_parts.append(lab_c)
+            codes = (jnp.concatenate(codes_parts)
+                     if len(codes_parts) > 1 else codes_parts[0])
+            labels = (jnp.concatenate(label_parts)
+                      if len(label_parts) > 1 else label_parts[0])
+            recon_rows = None
             if index.list_recon is not None:
-                recon_parts.append(_decode_rows(index.codebooks, cu,
-                                                lab_c,
-                                                index.codebook_kind))
-            codes_parts.append(_pack_codes(cu, index.pq_bits))
-            label_parts.append(lab_c)
-        codes = (jnp.concatenate(codes_parts)
-                 if len(codes_parts) > 1 else codes_parts[0])
-        labels = (jnp.concatenate(label_parts)
-                  if len(label_parts) > 1 else label_parts[0])
-        recon_rows = None
-        if index.list_recon is not None:
-            recon_rows = (jnp.concatenate(recon_parts)
-                          if len(recon_parts) > 1 else recon_parts[0])
+                recon_rows = (jnp.concatenate(recon_parts)
+                              if len(recon_parts) > 1 else recon_parts[0])
+            st.fence(codes, labels)
 
         new_counts = jax.ops.segment_sum(
             jnp.ones(n_new, jnp.int32), labels,
@@ -493,20 +511,22 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
         # fast path: headroom in every touched list — O(n_new) scatter-append
         # (one (n_lists,)-reduction host sync decides; see ivf_flat.extend)
         if int(jnp.max(needed)) <= index.capacity:
-            bufs, rows = [index.list_codes], [codes]
-            if index.list_recon is not None:
-                # the new rows' decoded residuals (+ norms, computed in
-                # the encode chunks above) append into the caches at the
-                # same slots, in the same scatter pass
-                bufs.append(index.list_recon)
-                rows.append(recon_rows)
-                if index.list_recon_sq is not None:
-                    bufs.append(index.list_recon_sq)
-                    rows.append(jnp.sum(
-                        recon_rows.astype(jnp.float32) ** 2, axis=-1))
-            new_bufs, list_idx, sizes = _append_lists_multi(
-                tuple(bufs), tuple(rows), index.list_indices,
-                index.list_sizes, labels, new_indices)
+            with obs.stage("ivf_pq.extend.pack") as st:
+                bufs, rows = [index.list_codes], [codes]
+                if index.list_recon is not None:
+                    # the new rows' decoded residuals (+ norms, computed in
+                    # the encode chunks above) append into the caches at the
+                    # same slots, in the same scatter pass
+                    bufs.append(index.list_recon)
+                    rows.append(recon_rows)
+                    if index.list_recon_sq is not None:
+                        bufs.append(index.list_recon_sq)
+                        rows.append(jnp.sum(
+                            recon_rows.astype(jnp.float32) ** 2, axis=-1))
+                new_bufs, list_idx, sizes = _append_lists_multi(
+                    tuple(bufs), tuple(rows), index.list_indices,
+                    index.list_sizes, labels, new_indices)
+                st.fence(new_bufs)
             out = Index(
                 centers=index.centers, codebooks=index.codebooks,
                 list_codes=new_bufs[0], list_indices=list_idx,
@@ -532,8 +552,10 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
 
         capacity = _round_up(max(int(jnp.max(needed)), _LIST_ALIGN),
                              _LIST_ALIGN)
-        list_codes, list_idx, sizes = _pack_lists(
-            all_codes, all_labels, all_ids, index.n_lists, capacity)
+        with obs.stage("ivf_pq.extend.pack") as st:
+            list_codes, list_idx, sizes = _pack_lists(
+                all_codes, all_labels, all_ids, index.n_lists, capacity)
+            st.fence(list_codes)
 
         out = Index(
             centers=index.centers, codebooks=index.codebooks,
@@ -714,15 +736,18 @@ def _search_impl_recon(centers, list_recon, list_indices, rotation, queries,
                    DistanceType.L2SqrtUnexpanded), select_k)
 
 
-@functools.partial(jax.jit, static_argnames=("n_probes", "metric"))
-def _select_clusters(centers, rotation, queries, n_probes, metric):
+@functools.partial(jax.jit, static_argnames=("n_probes", "metric",
+                                             "recall_target", "exact"))
+def _select_clusters(centers, rotation, queries, n_probes, metric,
+                     recall_target=0.95, exact=False):
     """Coarse top-``n_probes`` ranking (ivf_pq_search.cuh:133
     ``select_clusters``): rotate queries, then the IVF-Flat ranking —
     ONE copy of the rank arithmetic serves both index types."""
     from raft_tpu.neighbors import ivf_flat as _flat
 
     qrot = queries.astype(jnp.float32) @ rotation
-    return _flat._select_clusters(centers, qrot, n_probes, metric)
+    return _flat._select_clusters(centers, qrot, n_probes, metric,
+                                  recall_target=recall_target, exact=exact)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "metric", "n_groups",
@@ -810,10 +835,11 @@ def _search_impl_recon_grouped(centers, list_recon, list_recon_sq,
 # ---------------------------------------------------------------------------
 
 @functools.partial(jax.jit, static_argnames=(
-    "k", "n_probes", "metric", "codebook_kind", "lut_dtype", "pq_bits"))
+    "k", "n_probes", "metric", "codebook_kind", "lut_dtype", "pq_bits",
+    "coarse_recall_target", "exact_coarse"))
 def _search_impl(centers, codebooks, list_codes, list_indices, rotation,
                  queries, k, n_probes, metric, codebook_kind, lut_dtype,
-                 pq_bits=8):
+                 pq_bits=8, coarse_recall_target=0.95, exact_coarse=False):
     nq = queries.shape[0]
     qrot = queries.astype(jnp.float32) @ rotation       # (q, rot_dim)
     cf = centers.astype(jnp.float32)
@@ -823,7 +849,9 @@ def _search_impl(centers, codebooks, list_codes, list_indices, rotation,
     ip_metric = metric == DistanceType.InnerProduct
 
     # ---- select_clusters (ivf_pq_search.cuh:133): coarse top-n_probes ----
-    probes = _select_clusters(centers, rotation, queries, n_probes, metric)
+    probes = _select_clusters(centers, rotation, queries, n_probes, metric,
+                              recall_target=coarse_recall_target,
+                              exact=exact_coarse)
     q_dot_c = jax.lax.dot_general(qrot, cf, (((1,), (1,)), ((), ())),
                                   precision=get_matmul_precision(),
                                   preferred_element_type=jnp.float32)
@@ -907,6 +935,8 @@ def search(res, params: SearchParams, index: Index, queries, k: int
         expects(queries.ndim == 2 and queries.shape[1] == index.dim,
                 "ivf_pq.search: query dim mismatch")
         n_probes = min(params.n_probes, index.n_lists)
+        coarse_rt = getattr(params, "coarse_recall_target", 0.95)
+        exact_coarse = getattr(params, "exact_coarse", False)
         use_recon = (params.use_reconstruction
                      if params.use_reconstruction is not None
                      else index.list_recon is not None)
@@ -936,8 +966,12 @@ def search(res, params: SearchParams, index: Index, queries, k: int
                     list_recon_sq=index.list_recon_sq)
             if index.list_recon_sq is None:
                 index.list_recon_sq = _recon_sq(index.list_recon)
-            probes = _select_clusters(index.centers, index.rotation,
-                                      queries, n_probes, index.metric)
+            with obs.stage("ivf_pq.search.coarse") as st:
+                probes = _select_clusters(index.centers, index.rotation,
+                                          queries, n_probes, index.metric,
+                                          recall_target=coarse_rt,
+                                          exact=exact_coarse)
+                st.fence(probes)
             # group count is data-dependent; cached_groups avoids a
             # per-batch host sync (measured ~125 ms over the remote tunnel)
             gkey = (queries.shape[0], n_probes)
@@ -963,18 +997,27 @@ def search(res, params: SearchParams, index: Index, queries, k: int
                     index.list_indices, index.rotation, queries, probes, k,
                     index.metric, ng, block, use_pallas=use_pallas)
 
-            out = dispatch(n_groups)
-            needed = grouped.commit_groups(index, gkey, pending)
-            if needed:
-                # probe distribution shifted past the cached group count:
-                # re-dispatch at the true size so no pair is dropped
-                out = dispatch(needed)
+            with obs.stage("ivf_pq.search.scan") as st:
+                out = dispatch(n_groups)
+                needed = grouped.commit_groups(index, gkey, pending)
+                if needed:
+                    # probe distribution shifted past the cached group
+                    # count: re-dispatch at the true size so no pair is
+                    # dropped
+                    out = dispatch(needed)
+                st.fence(out)
             return out
-        return _search_impl(index.centers, index.codebooks, index.list_codes,
-                            index.list_indices, index.rotation, queries, k,
-                            n_probes, index.metric, index.codebook_kind,
-                            jnp.dtype(params.lut_dtype).name,
-                            pq_bits=index.pq_bits)
+        with obs.stage("ivf_pq.search.scan") as st:
+            out = _search_impl(index.centers, index.codebooks,
+                               index.list_codes, index.list_indices,
+                               index.rotation, queries, k, n_probes,
+                               index.metric, index.codebook_kind,
+                               jnp.dtype(params.lut_dtype).name,
+                               pq_bits=index.pq_bits,
+                               coarse_recall_target=coarse_rt,
+                               exact_coarse=exact_coarse)
+            st.fence(out)
+        return out
 
 
 # ---------------------------------------------------------------------------

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.core.tracing import range as named_range
+from raft_tpu import observability as obs
 from raft_tpu.distance.types import DistanceType
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.utils.precision import get_matmul_precision
@@ -86,4 +87,7 @@ def refine(
                            DistanceType.L2SqrtUnexpanded,
                            DistanceType.InnerProduct),
                 "refine: L2 / InnerProduct metrics only (as the reference)")
-        return _refine_impl(dataset, queries, candidates, k, metric)
+        with obs.stage("refine") as st:
+            out = _refine_impl(dataset, queries, candidates, k, metric)
+            st.fence(out)
+        return out

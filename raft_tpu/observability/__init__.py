@@ -1,0 +1,60 @@
+"""raft_tpu.observability — stage-level metrics, tracing, and exporters.
+
+The aggregation layer on top of ``core/tracing`` (the NVTX-range analogue):
+a process-global :class:`MetricsRegistry` of counters / gauges / timers, a
+:func:`stage` context manager that times algorithm phases under the same
+labels the TPU profiler sees, XLA compile-event tracking, per-build
+:func:`build_report` breakdowns, and JSON / Prometheus exporters.
+
+Contract: collection is **off by default**.  While off, instrumented library
+code performs no timing and — the part that matters for QPS — **no
+``block_until_ready`` fences**; ``stage`` yields a shared no-op handle.
+Turn it on with :func:`enable` or scoped via ``with collecting(): ...``.
+
+Quick tour::
+
+    from raft_tpu import observability as obs
+
+    with obs.collecting():
+        index = cagra.build(res, params, dataset)
+    print(obs.build_report(index)["stages"])   # per-stage seconds
+    print(obs.to_prometheus())                 # scrape-ready text
+"""
+
+from raft_tpu.observability.registry import (
+    Counter,
+    Gauge,
+    MetricsRegistry,
+    Timer,
+    collecting,
+    disable,
+    enable,
+    enabled,
+    registry,
+    reset,
+    snapshot,
+)
+from raft_tpu.observability.stage import fence, stage
+from raft_tpu.observability.export import to_json, to_prometheus
+from raft_tpu.observability.report import BuildReport, build_report, build_scope
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "MetricsRegistry",
+    "Timer",
+    "BuildReport",
+    "build_report",
+    "build_scope",
+    "collecting",
+    "disable",
+    "enable",
+    "enabled",
+    "fence",
+    "registry",
+    "reset",
+    "snapshot",
+    "stage",
+    "to_json",
+    "to_prometheus",
+]

@@ -1,0 +1,198 @@
+"""Metrics registry — counters, gauges, timers.
+
+The aggregation layer the reference lacks an exact analogue for: NVTX ranges
+(core/nvtx.hpp) annotate but never aggregate, so raft's bench harness
+re-derives stage costs from profiler dumps.  Here the registry *is* the
+aggregate: ``stage(...)`` (see stage.py) records wall time per label, library
+code bumps counters (comms bytes, kmeans iterations, XLA compiles), and the
+exporters (export.py) serialize a snapshot to JSON / Prometheus text.
+
+Collection is **off by default** and globally gated: when disabled, the
+instrumentation in the library degenerates to a handful of predicate checks
+(no timing, no device fences, no named scopes beyond the ones that already
+existed).  Enable with :func:`enable` / the :func:`collecting` context
+manager.
+
+Thread-safety: metric mutation is guarded by a per-registry lock — stages can
+close on worker threads (e.g. host callbacks, jax.monitoring listeners).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+import threading
+from typing import Dict, Iterator, Optional
+
+
+class Counter:
+    """Monotonic counter (e.g. ``comms.allreduce.calls``, ``xla.compiles``)."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str, lock: threading.RLock) -> None:
+        self.name = name
+        self._value = 0
+        self._lock = lock
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+
+class Gauge:
+    """Last-write-wins scalar (e.g. ``cagra.build.pdim``)."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str, lock: threading.RLock) -> None:
+        self.name = name
+        self._value = 0.0
+        self._lock = lock
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Timer:
+    """Duration accumulator: count / total / min / max / last, in seconds."""
+
+    __slots__ = ("name", "count", "total", "min", "max", "last", "_lock")
+
+    def __init__(self, name: str, lock: threading.RLock) -> None:
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.min = math.inf
+        self.max = 0.0
+        self.last = 0.0
+        self._lock = lock
+
+    def record(self, seconds: float) -> None:
+        seconds = float(seconds)
+        with self._lock:
+            self.count += 1
+            self.total += seconds
+            self.min = min(self.min, seconds)
+            self.max = max(self.max, seconds)
+            self.last = seconds
+
+    def as_dict(self) -> Dict[str, float]:
+        return {
+            "count": self.count,
+            "total_s": self.total,
+            "min_s": self.min if self.count else 0.0,
+            "max_s": self.max,
+            "last_s": self.last,
+        }
+
+
+class MetricsRegistry:
+    """Named metric store with get-or-create accessors and snapshot/reset."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._timers: Dict[str, Timer] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            m = self._counters.get(name)
+            if m is None:
+                m = self._counters[name] = Counter(name, self._lock)
+            return m
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            m = self._gauges.get(name)
+            if m is None:
+                m = self._gauges[name] = Gauge(name, self._lock)
+            return m
+
+    def timer(self, name: str) -> Timer:
+        with self._lock:
+            m = self._timers.get(name)
+            if m is None:
+                m = self._timers[name] = Timer(name, self._lock)
+            return m
+
+    def snapshot(self) -> Dict[str, Dict]:
+        """Point-in-time copy: plain dicts, safe to mutate / serialize."""
+        with self._lock:
+            return {
+                "counters": {n: c.value for n, c in self._counters.items()},
+                "gauges": {n: g.value for n, g in self._gauges.items()},
+                "timers": {n: t.as_dict() for n, t in self._timers.items()},
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._timers.clear()
+
+
+# ---------------------------------------------------------------------------
+# global default registry + collection gate
+
+_REGISTRY = MetricsRegistry()
+_ENABLED = False
+
+
+def registry() -> MetricsRegistry:
+    """The process-global default registry."""
+    return _REGISTRY
+
+
+def enabled() -> bool:
+    """Whether collection is on.  Instrumented call sites check this before
+    doing any work; False (the default) means zero fences and zero timing."""
+    return _ENABLED
+
+
+def enable() -> None:
+    global _ENABLED
+    _ENABLED = True
+    # installed lazily so `import raft_tpu` never registers global listeners
+    from raft_tpu.observability.stage import _install_compile_listener
+    _install_compile_listener()
+
+
+def disable() -> None:
+    global _ENABLED
+    _ENABLED = False
+
+
+@contextlib.contextmanager
+def collecting(reg: Optional[MetricsRegistry] = None) -> Iterator[MetricsRegistry]:
+    """Enable collection for the body, restoring the previous state after.
+
+    Yields the registry metrics are recorded into (the global one — per-call
+    registries compose via snapshot diffs, see report.py)."""
+    prev = _ENABLED
+    enable()
+    try:
+        yield reg if reg is not None else _REGISTRY
+    finally:
+        if not prev:
+            disable()
+
+
+def snapshot() -> Dict[str, Dict]:
+    """Snapshot of the global registry."""
+    return _REGISTRY.snapshot()
+
+
+def reset() -> None:
+    """Reset the global registry (collection gate is unaffected)."""
+    _REGISTRY.reset()

@@ -394,3 +394,57 @@ class TestSearchTableFormat:
         assert fmt is not None and fmt[1] is True
         monkeypatch.setattr(cagra, "_WALK_TABLE_MAX_BYTES", 1)
         assert cagra._search_table_format(index, pdim) is None
+
+
+class TestMergeRefineDebugChecks:
+    """_merge_refine_chunked fast-path precondition (first sorted by key
+    and dup-free) — validated host-side when the debug flag is on."""
+
+    def _inputs(self):
+        rng = np.random.default_rng(5)
+        n, dim, kg = 32, 8, 4
+        xf = jnp.asarray(rng.normal(size=(n, dim)).astype(np.float32))
+        first = jnp.tile(jnp.arange(kg, dtype=jnp.int32), (n, 1))
+        first_d = jnp.tile(jnp.arange(kg, dtype=jnp.float32), (n, 1))
+        second = jnp.asarray(
+            rng.integers(0, n, size=(n, kg)).astype(np.int32))
+        return xf, first, first_d, second, kg
+
+    def test_valid_inputs_pass(self, monkeypatch):
+        monkeypatch.setattr(cagra, "_DEBUG_CHECKS", True)
+        xf, first, first_d, second, kg = self._inputs()
+        out, _ = cagra._merge_refine_chunked(
+            xf, first, second, kg, False, chunk=32, first_d=first_d,
+            with_d=True)
+        assert out.shape == (32, kg)
+
+    def test_unsorted_first_d_raises(self, monkeypatch):
+        from raft_tpu import RaftError
+        monkeypatch.setattr(cagra, "_DEBUG_CHECKS", True)
+        xf, first, first_d, second, kg = self._inputs()
+        bad = first_d.at[3, 0].set(99.0)       # row 3 now decreasing
+        with pytest.raises(RaftError, match="non-decreasing"):
+            cagra._merge_refine_chunked(xf, first, second, kg, False,
+                                        chunk=32, first_d=bad,
+                                        with_d=True)
+
+    def test_duplicate_first_raises(self, monkeypatch):
+        from raft_tpu import RaftError
+        monkeypatch.setattr(cagra, "_DEBUG_CHECKS", True)
+        xf, first, first_d, second, kg = self._inputs()
+        bad = first.at[0, 1].set(0)            # id 0 twice in row 0
+        with pytest.raises(RaftError, match="duplicate-free"):
+            cagra._merge_refine_chunked(xf, bad, second, kg, False,
+                                        chunk=32, first_d=first_d,
+                                        with_d=True)
+
+    def test_checks_off_by_default(self):
+        assert not cagra._DEBUG_CHECKS
+        xf, first, first_d, second, kg = self._inputs()
+        bad = first_d.at[3, 0].set(99.0)
+        # with the flag off a violating input is not validated (the
+        # jitted fast path runs unchecked, as in production)
+        out, _ = cagra._merge_refine_chunked(xf, first, second, kg,
+                                             False, chunk=32,
+                                             first_d=bad, with_d=True)
+        assert out.shape == (32, kg)

@@ -151,3 +151,18 @@ class Test2DGrid:
             assert (res == 24.0).all()
         finally:
             s.destroy()
+
+
+@pytest.mark.skipif(not hasattr(jax, "shard_map"),
+                    reason="jax.shard_map unavailable in this jax")
+def test_collective_counters(session):
+    # observability wiring: collectives record call/byte counters at
+    # trace time (the self-test retraces per call: fresh closures)
+    from raft_tpu import observability as obs
+    obs.reset()
+    with obs.collecting():
+        assert self_test.perform_test_comms_allreduce(session)
+    snap = obs.snapshot()
+    obs.reset()
+    assert snap["counters"].get("comms.allreduce.calls", 0) >= 1
+    assert snap["counters"].get("comms.allreduce.bytes", 0) >= 4

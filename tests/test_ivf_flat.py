@@ -350,3 +350,45 @@ class TestSuperTileScan:
             gt_ids = cand[order]
             tie_ok = np.abs(d1[q] - d[order]) < 1e-4
             assert ((i1[q] == gt_ids) | tie_ok).all()
+
+
+class TestCoarseSelection:
+    """SearchParams.coarse_recall_target / exact_coarse: the coarse
+    probe's approx_max_k knobs (previously hardcoded at 0.95)."""
+
+    def test_params_fields(self):
+        sp = ivf_flat.SearchParams(n_probes=8, coarse_recall_target=0.9,
+                                   exact_coarse=True)
+        assert sp.coarse_recall_target == 0.9
+        assert sp.exact_coarse
+
+    def test_exact_coarse_full_probe_recall(self, res, dataset):
+        db, q = dataset
+        index = ivf_flat.build(
+            res, ivf_flat.IndexParams(n_lists=32, kmeans_n_iters=10), db)
+        _, truth = naive_knn(db, q, 10)
+        sp = ivf_flat.SearchParams(n_probes=32, exact_coarse=True)
+        _, i = ivf_flat.search(res, sp, index, q, 10)
+        assert recall(np.asarray(i), truth) >= 0.99
+
+    def test_near_full_probe_falls_back_to_exact(self, res, dataset):
+        db, q = dataset
+        index = ivf_flat.build(
+            res, ivf_flat.IndexParams(n_lists=32, kmeans_n_iters=10), db)
+        qj = jnp.asarray(q)
+        # n_probes=30 >= 32 - 32//8 = 28: approx path auto-falls back to
+        # lax.top_k, so it must agree exactly with exact=True
+        auto = ivf_flat._select_clusters(index.centers, qj, 30,
+                                         index.metric)
+        exact = ivf_flat._select_clusters(index.centers, qj, 30,
+                                          index.metric, exact=True)
+        np.testing.assert_array_equal(np.asarray(auto), np.asarray(exact))
+
+    def test_recall_target_threaded(self, res, dataset):
+        db, q = dataset
+        index = ivf_flat.build(
+            res, ivf_flat.IndexParams(n_lists=32, kmeans_n_iters=10), db)
+        _, truth = naive_knn(db, q, 10)
+        sp = ivf_flat.SearchParams(n_probes=16, coarse_recall_target=0.99)
+        _, i = ivf_flat.search(res, sp, index, q, 10)
+        assert recall(np.asarray(i), truth) >= 0.9

@@ -94,3 +94,47 @@ class TestOps:
             m + v[None, :], rtol=1e-6)
         out = np.asarray(matrix.zero_small_values(jnp.asarray(m), 0.5))
         assert np.all((np.abs(out) >= 0.5) | (out == 0))
+
+
+class TestRowDuplicateMask:
+    def test_first_occurrence_wins(self):
+        m = jnp.asarray([[3, 1, 3, 2, 1]])
+        out = np.asarray(matrix.row_duplicate_mask(m))
+        # later repeats flagged; the first occurrence of each value kept
+        np.testing.assert_array_equal(out, [[False, False, True, False,
+                                             True]])
+
+    def test_ties_keep_exactly_one(self):
+        m = jnp.asarray([[5, 5, 5, 5]])
+        out = np.asarray(matrix.row_duplicate_mask(m))
+        np.testing.assert_array_equal(out, [[False, True, True, True]])
+
+    def test_all_equal_rows(self):
+        m = jnp.full((3, 6), 7, jnp.int32)
+        out = np.asarray(matrix.row_duplicate_mask(m))
+        assert not out[:, 0].any()          # one survivor per row
+        assert out[:, 1:].all()
+
+    def test_single_column(self):
+        m = jnp.asarray([[1], [1], [2]])
+        out = np.asarray(matrix.row_duplicate_mask(m))
+        assert not out.any()                # nothing to duplicate
+
+    def test_no_duplicates(self):
+        m = jnp.asarray([[4, 2, 9, 1]])
+        assert not np.asarray(matrix.row_duplicate_mask(m)).any()
+
+    def test_rows_independent(self):
+        m = jnp.asarray([[1, 2, 3], [1, 1, 3]])
+        out = np.asarray(matrix.row_duplicate_mask(m))
+        np.testing.assert_array_equal(
+            out, [[False, False, False], [False, True, False]])
+
+    def test_matches_numpy_reference(self):
+        x = RNG.integers(0, 8, size=(32, 24)).astype(np.int32)
+        out = np.asarray(matrix.row_duplicate_mask(jnp.asarray(x)))
+        for r in range(x.shape[0]):
+            seen = set()
+            for c in range(x.shape[1]):
+                assert out[r, c] == (x[r, c] in seen)
+                seen.add(x[r, c])
