@@ -38,28 +38,33 @@ from typing import Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.core import compat
 from raft_tpu.core.error import expects
 from raft_tpu import observability as obs
+from raft_tpu.resilience import faults
 
 
 def _record_collective(op: str, x=None) -> None:
-    """Bump ``comms.<op>.calls`` / ``comms.<op>.bytes`` when collection is on.
+    """Bump ``comms.<op>.calls`` / ``comms.<op>.bytes`` when collection is
+    on, then give the fault harness its shot at ``comms.<op>``.
 
     Collectives run inside traced contexts (shard_map / pjit), so these
     counters record *traced* calls — collectives in the program, with bytes
     from the static shard shape — not per-step executions; a jit cache hit
-    re-runs the collective without re-tracing it."""
-    if not obs.enabled():
-        return
-    reg = obs.registry()
-    reg.counter(f"comms.{op}.calls").inc()
-    if x is not None:
-        try:
-            nbytes = int(x.size) * x.dtype.itemsize
-        except (AttributeError, TypeError):
-            nbytes = 0
-        if nbytes:
-            reg.counter(f"comms.{op}.bytes").inc(nbytes)
+    re-runs the collective without re-tracing it.  Injected faults at
+    ``comms.*`` sites fire under the same trace-time contract (documented
+    in resilience/faults.py)."""
+    if obs.enabled():
+        reg = obs.registry()
+        reg.counter(f"comms.{op}.calls").inc()
+        if x is not None:
+            try:
+                nbytes = int(x.size) * x.dtype.itemsize
+            except (AttributeError, TypeError):
+                nbytes = 0
+            if nbytes:
+                reg.counter(f"comms.{op}.bytes").inc(nbytes)
+    faults.maybe_fail(f"comms.{op}")
 
 
 class op_t:
@@ -107,16 +112,17 @@ class Comms:
         """Number of ranks on the axis (reference: get_size)."""
         if self._size is not None:
             return self._size
-        return jax.lax.axis_size(self.axis_name)
+        return compat.axis_size(self.axis_name)
 
     def get_rank(self):
         """This shard's rank (reference: get_rank) — traced value."""
         return jax.lax.axis_index(self.axis_name)
 
     # -- collectives -------------------------------------------------------
-    def allreduce(self, x, op: str = op_t.SUM):
-        """Reference: comms.hpp allreduce → ncclAllReduce."""
-        _record_collective("allreduce", x)
+    def _reduce_dispatch(self, x, op: str):
+        """Shared lowering for allreduce/reduce (recorded by the callers
+        under their own names, before dispatch — every branch, PROD
+        included)."""
         if op == op_t.SUM:
             return jax.lax.psum(x, self.axis_name)
         if op == op_t.MAX:
@@ -128,6 +134,11 @@ class Comms:
             # all_gather + product (small payloads expected for PROD)
             return jnp.prod(jax.lax.all_gather(x, self.axis_name), axis=0)
         raise ValueError(f"unknown reduce op {op!r}")
+
+    def allreduce(self, x, op: str = op_t.SUM):
+        """Reference: comms.hpp allreduce → ncclAllReduce."""
+        _record_collective("allreduce", x)
+        return self._reduce_dispatch(x, op)
 
     def bcast(self, x, root: int = 0):
         """Broadcast root's value to all ranks (reference: bcast →
@@ -141,8 +152,10 @@ class Comms:
         """Reduce to root (reference: reduce → ncclReduce).  XLA collectives
         are bulk-synchronous: every rank computes the result; the reference
         contract only *guarantees* it at root, so returning it everywhere is
-        a superset."""
-        return self.allreduce(x, op)
+        a superset.  Recorded under its OWN counter name (not aliased to
+        allreduce) so per-op traffic attribution stays truthful."""
+        _record_collective("reduce", x)
+        return self._reduce_dispatch(x, op)
 
     def allgather(self, x):
         """Concatenate equal-size shards along a new leading axis
@@ -150,25 +163,32 @@ class Comms:
         _record_collective("allgather", x)
         return jax.lax.all_gather(x, self.axis_name)
 
-    def allgatherv(self, x, recvcounts: Sequence[int]):
-        """Ragged allgather (reference: allgatherv, 'MPI Does Not Make it
-        Easy' padding dance done for the caller): shards padded to
-        max(recvcounts) on axis 0; returns (n_ranks, max_count, ...) plus the
-        static counts for unpadding."""
-        _record_collective("allgatherv", x)
+    def _allgatherv_dispatch(self, x, recvcounts: Sequence[int]):
         counts = tuple(int(c) for c in recvcounts)
         pad_to = max(counts)
         pad = [(0, pad_to - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
         gathered = jax.lax.all_gather(jnp.pad(x, pad), self.axis_name)
         return gathered, counts
 
+    def allgatherv(self, x, recvcounts: Sequence[int]):
+        """Ragged allgather (reference: allgatherv, 'MPI Does Not Make it
+        Easy' padding dance done for the caller): shards padded to
+        max(recvcounts) on axis 0; returns (n_ranks, max_count, ...) plus the
+        static counts for unpadding."""
+        _record_collective("allgatherv", x)
+        return self._allgatherv_dispatch(x, recvcounts)
+
     def gather(self, x, root: int = 0):
         """Gather to root (reference: gather).  All ranks receive (superset
-        of the root-only contract)."""
+        of the root-only contract).  Own counter name, not an allgather
+        alias."""
+        _record_collective("gather", x)
         return jax.lax.all_gather(x, self.axis_name)
 
     def gatherv(self, x, recvcounts: Sequence[int], root: int = 0):
-        return self.allgatherv(x, recvcounts)
+        """Ragged gather-to-root (reference: gatherv); own counter name."""
+        _record_collective("gatherv", x)
+        return self._allgatherv_dispatch(x, recvcounts)
 
     def reducescatter(self, x, op: str = op_t.SUM):
         """Reference: reducescatter → ncclReduceScatter.  ``x`` is the

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu.core.compat import shard_map
 from raft_tpu.comms.comms import Comms, op_t
 from raft_tpu.comms.session import CommsSession
 
@@ -26,7 +27,7 @@ P = jax.sharding.PartitionSpec
 
 def _run(session: CommsSession, fn, *args):
     mesh = session.mesh
-    shard = jax.shard_map(fn, mesh=mesh, in_specs=P(),
+    shard = shard_map(fn, mesh=mesh, in_specs=P(),
                           out_specs=P(session.axis_name), check_vma=False)
     return jax.jit(shard)(*args)
 
@@ -158,7 +159,7 @@ def perform_test_comm_split(session: CommsSession) -> bool:
         ok = row_sum == ri * col.get_size()
         return (b * ok)[None]
 
-    shard = jax.shard_map(body, mesh=mesh2, in_specs=P(),
+    shard = shard_map(body, mesh=mesh2, in_specs=P(),
                           out_specs=P(("row", "col")), check_vma=False)
     res = np.asarray(jax.jit(shard)())
     return bool((res == n).all())

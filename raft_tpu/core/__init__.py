@@ -24,6 +24,7 @@ from raft_tpu.core.mdarray import (  # noqa: F401
     col_major,
 )
 from raft_tpu.core.serialize import (  # noqa: F401
+    CorruptIndexError,
     serialize_mdspan,
     deserialize_mdspan,
     serialize_scalar,

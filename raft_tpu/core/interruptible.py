@@ -78,9 +78,15 @@ class interruptible:
         """Block on device work, raising if cancelled (reference: :synchronize :78).
 
         With arrays given, blocks until those are ready; otherwise drains all
-        dispatched work.
+        dispatched work.  Also a named fault-injection site
+        (``interruptible.synchronize``) so preemption mid-build is scriptable
+        in tests (resilience/faults.py).
         """
         cls.yield_no_wait()
+        # lazy import: core must stay importable without the resilience
+        # package initialized (faults itself imports core.error)
+        from raft_tpu.resilience import faults
+        faults.maybe_fail("interruptible.synchronize")
         if arrays:
             for a in arrays:
                 a.block_until_ready()

@@ -1,4 +1,4 @@
-"""numpy-format array serialization.
+"""numpy-format array serialization, hardened.
 
 Counterpart of the reference's mdspan (de)serializer that writes the numpy
 ``.npy`` wire format to iostreams (cpp/include/raft/core/serialize.hpp:34-124,
@@ -8,33 +8,96 @@ neighbors/detail/ivf_pq_serialize.cuh.
 
 We use :func:`numpy.lib.format.write_array` which emits the identical format
 (the reference hand-rolls the same header), plus scalar helpers.
+
+Hardening (PR 2, resilience):
+
+- every reader detects **short reads** (EOF mid-record) and raises
+  :class:`CorruptIndexError` with byte offsets instead of the opaque
+  ``np.frombuffer`` failure a truncated stream used to produce;
+- index serializers wrap their whole payload in a **versioned envelope**
+  (magic ``RTIE``, format version, payload length, CRC32 — the analogue
+  of the reference's kSerializationVersion header, plus the integrity
+  check it lacks): a torn or bit-flipped index file raises
+  :class:`CorruptIndexError`, never loads as garbage arrays.  The CRC
+  is computed only at save/load; search paths never touch it.
 """
 
 from __future__ import annotations
 
+import io
 import struct
+import zlib
 from typing import BinaryIO
 
 import jax
 import numpy as np
 from numpy.lib import format as npy_format
 
+from raft_tpu.resilience import faults as _faults
+
 _SCALAR_MAGIC = b"RTSC"
+
+_ENVELOPE_MAGIC = b"RTIE"
+_ENVELOPE_VERSION = 1
+# magic | u16 envelope version | u64 payload bytes | u32 crc32(payload)
+_ENVELOPE_HEADER = struct.Struct("<4sHQI")
+
+
+class CorruptIndexError(ValueError):
+    """A serialized index/checkpoint stream is truncated or corrupted
+    (bad magic, short read, CRC mismatch).  Subclasses ``ValueError`` so
+    pre-hardening callers that caught ValueError keep working."""
+
+
+def _tell(stream: BinaryIO) -> int:
+    try:
+        return stream.tell()
+    except (OSError, AttributeError):
+        return -1
+
+
+def _offset(off: int) -> str:
+    return f"at byte offset {off}" if off >= 0 else "at unknown offset"
+
+
+def _read_exact(stream: BinaryIO, n: int, what: str) -> bytes:
+    """Read exactly ``n`` bytes or raise :class:`CorruptIndexError`
+    naming the record and offsets (short-read detection)."""
+    off = _tell(stream)
+    data = stream.read(n)
+    if data is None or len(data) != n:
+        got = 0 if data is None else len(data)
+        raise CorruptIndexError(
+            f"corrupt stream: short read of {what} {_offset(off)} "
+            f"(wanted {n} bytes, got {got})")
+    return data
 
 
 def serialize_mdspan(res, stream: BinaryIO, arr) -> None:
     """Write an array in ``.npy`` format (reference: serialize.hpp:34-67)."""
+    _faults.maybe_fail("serialize.write")
     np_arr = np.asarray(jax.device_get(arr) if isinstance(arr, jax.Array) else arr)
     npy_format.write_array(stream, np_arr, allow_pickle=False)
 
 
 def deserialize_mdspan(res, stream: BinaryIO) -> np.ndarray:
-    """Read an array in ``.npy`` format (reference: serialize.hpp:81-124)."""
-    return npy_format.read_array(stream, allow_pickle=False)
+    """Read an array in ``.npy`` format (reference: serialize.hpp:81-124).
+
+    Truncated headers or data regions raise :class:`CorruptIndexError`
+    with the record's start offset."""
+    _faults.maybe_fail("serialize.read")
+    off = _tell(stream)
+    try:
+        return npy_format.read_array(stream, allow_pickle=False)
+    except (ValueError, OSError, EOFError, struct.error) as e:
+        raise CorruptIndexError(
+            f"corrupt stream: bad/truncated array record starting "
+            f"{_offset(off)}: {e}") from e
 
 
 def serialize_scalar(res, stream: BinaryIO, value) -> None:
     """Write one scalar with a dtype tag (reference: serialize_scalar)."""
+    _faults.maybe_fail("serialize.write")
     arr = np.asarray(value)
     dt = arr.dtype.str.encode()
     stream.write(_SCALAR_MAGIC)
@@ -44,9 +107,82 @@ def serialize_scalar(res, stream: BinaryIO, value) -> None:
 
 
 def deserialize_scalar(res, stream: BinaryIO):
-    magic = stream.read(4)
+    _faults.maybe_fail("serialize.read")
+    off = _tell(stream)
+    magic = _read_exact(stream, 4, "scalar magic")
     if magic != _SCALAR_MAGIC:
-        raise ValueError("corrupt scalar stream (bad magic)")
-    (n,) = struct.unpack("<B", stream.read(1))
-    dtype = np.dtype(stream.read(n).decode())
-    return np.frombuffer(stream.read(dtype.itemsize), dtype=dtype)[0]
+        raise CorruptIndexError(
+            f"corrupt scalar stream: bad magic {magic!r} {_offset(off)}")
+    (n,) = struct.unpack("<B", _read_exact(stream, 1, "scalar dtype length"))
+    try:
+        dtype = np.dtype(_read_exact(stream, n, "scalar dtype tag").decode())
+    except (TypeError, ValueError, UnicodeDecodeError) as e:
+        raise CorruptIndexError(
+            f"corrupt scalar stream: bad dtype tag {_offset(off)}: "
+            f"{e}") from e
+    payload = _read_exact(stream, dtype.itemsize,
+                          f"scalar payload ({dtype.str})")
+    return np.frombuffer(payload, dtype=dtype)[0]
+
+
+# ---------------------------------------------------------------------------
+# versioned integrity envelope (index serializers + build checkpoints)
+# ---------------------------------------------------------------------------
+
+def write_envelope(stream: BinaryIO, payload: bytes) -> None:
+    """Wrap ``payload`` with magic + format version + length + CRC32."""
+    _faults.maybe_fail("serialize.write")
+    stream.write(_ENVELOPE_HEADER.pack(_ENVELOPE_MAGIC, _ENVELOPE_VERSION,
+                                       len(payload),
+                                       zlib.crc32(payload) & 0xFFFFFFFF))
+    stream.write(payload)
+
+
+def read_envelope(stream: BinaryIO) -> bytes:
+    """Read and verify an envelope; returns the payload bytes.
+
+    Bad magic / unknown version / short payload / CRC mismatch all raise
+    :class:`CorruptIndexError` — a corrupted index is *rejected*, never
+    silently loaded as wrong arrays."""
+    _faults.maybe_fail("serialize.read")
+    off = _tell(stream)
+    header = _read_exact(stream, _ENVELOPE_HEADER.size, "envelope header")
+    magic, version, length, crc = _ENVELOPE_HEADER.unpack(header)
+    if magic != _ENVELOPE_MAGIC:
+        raise CorruptIndexError(
+            f"corrupt stream: bad envelope magic {magic!r} {_offset(off)} "
+            "(not a raft_tpu index/checkpoint, or written by a "
+            "pre-envelope version)")
+    if version != _ENVELOPE_VERSION:
+        raise CorruptIndexError(
+            f"unsupported envelope version {version} {_offset(off)} "
+            f"(expected {_ENVELOPE_VERSION})")
+    payload = _read_exact(stream, length, f"envelope payload ({length} B)")
+    actual = zlib.crc32(payload) & 0xFFFFFFFF
+    if actual != crc:
+        raise CorruptIndexError(
+            f"corrupt stream: payload CRC mismatch {_offset(off)} "
+            f"(stored {crc:#010x}, computed {actual:#010x})")
+    return payload
+
+
+class enveloped_writer:
+    """``with enveloped_writer(stream) as body:`` — serialize records into
+    ``body``; one CRC-sealed envelope is emitted on clean exit."""
+
+    def __init__(self, stream: BinaryIO) -> None:
+        self._stream = stream
+        self._body = io.BytesIO()
+
+    def __enter__(self) -> BinaryIO:
+        return self._body
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            write_envelope(self._stream, self._body.getvalue())
+
+
+def open_envelope(stream: BinaryIO) -> BinaryIO:
+    """Verify the envelope at ``stream`` and return the payload as a
+    readable buffer for record-level deserializers."""
+    return io.BytesIO(read_envelope(stream))

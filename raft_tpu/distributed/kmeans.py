@@ -26,6 +26,7 @@ import numpy as np
 from raft_tpu.cluster.kmeans import init_plus_plus
 from raft_tpu.cluster.kmeans_types import InitMethod, KMeansParams
 from raft_tpu.comms.comms import Comms, op_t
+from raft_tpu.core.compat import shard_map
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.core.tracing import range as named_range
@@ -40,7 +41,7 @@ P = jax.sharding.PartitionSpec
 def _dist_lloyd(X, centroids0, tol, n_clusters, max_iter, axis_name, mesh):
     comms = Comms(axis_name=axis_name)
 
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=(P(axis_name, None), P()),
                        out_specs=(P(), P(), P()),
                        check_vma=False)
@@ -128,7 +129,7 @@ def predict(handle, params: KMeansParams, X, centroids) -> jax.Array:
     X = jax.device_put(
         X, jax.sharding.NamedSharding(mesh, P(comms.axis_name, None)))
 
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=(P(comms.axis_name, None), P()),
                        out_specs=P(comms.axis_name),
                        check_vma=False)

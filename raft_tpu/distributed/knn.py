@@ -18,6 +18,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.core.compat import shard_map
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.core.tracing import range as named_range
@@ -31,7 +32,7 @@ P = jax.sharding.PartitionSpec
 @functools.partial(jax.jit, static_argnames=("k", "metric", "axis_name",
                                              "mesh"))
 def _dist_knn(db, queries, k, metric, axis_name, mesh):
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=(P(axis_name, None), P()),
                        out_specs=(P(), P()),
                        check_vma=False)

@@ -1116,15 +1116,41 @@ def prune(res, knn_graph, graph_degree: int) -> jax.Array:
         return out
 
 
-def build(res, params: IndexParams, dataset) -> Index:
+def build(res, params: IndexParams, dataset, *,
+          checkpoint=None, resume: bool = False) -> Index:
     """Full CAGRA build (reference: cagra.cuh ``build`` = build_knn_graph +
-    prune)."""
+    prune).
+
+    ``checkpoint`` (a directory path or
+    :class:`~raft_tpu.resilience.CheckpointManager`) persists the two
+    build stages (intermediate kNN graph, pruned graph) atomically right
+    before their ``interruptible`` sync points; ``resume=True`` loads
+    completed stages instead of recomputing.  The build consumes no
+    ``res`` key draws, so a resumed build is bit-identical for free.
+    """
+    from raft_tpu.core.interruptible import interruptible
+    from raft_tpu.resilience import as_manager
+    ckpt = as_manager(checkpoint)
     dataset = ensure_array(dataset, "dataset")
     with obs.build_scope("cagra.build") as rep:
-        knn = build_knn_graph(res, dataset,
-                              params.intermediate_graph_degree,
-                              params=params)
-        graph = prune(res, knn, params.graph_degree)
+        if resume and ckpt is not None and ckpt.has("knn_graph"):
+            knn = jnp.asarray(ckpt.load("knn_graph")["knn"])
+        else:
+            knn = build_knn_graph(res, dataset,
+                                  params.intermediate_graph_degree,
+                                  params=params)
+            if ckpt is not None:
+                ckpt.save("knn_graph", {"knn": np.asarray(knn)})
+        # cancellation point: stage state is durable before a pending
+        # cancel() can raise
+        interruptible.synchronize(knn)
+        if resume and ckpt is not None and ckpt.has("graph"):
+            graph = jnp.asarray(ckpt.load("graph")["graph"])
+        else:
+            graph = prune(res, knn, params.graph_degree)
+            if ckpt is not None:
+                ckpt.save("graph", {"graph": np.asarray(graph)})
+        interruptible.synchronize(graph)
         index = Index(dataset=dataset, graph=graph, metric=params.metric)
     return rep.attach(index)
 
@@ -1901,19 +1927,39 @@ _SERIALIZATION_VERSION = 1
 
 
 def serialize(res, stream: BinaryIO, index: Index) -> None:
-    ser.serialize_scalar(res, stream, np.int32(_SERIALIZATION_VERSION))
-    ser.serialize_scalar(res, stream, np.int32(index.metric))
-    ser.serialize_mdspan(res, stream, index.dataset)
-    ser.serialize_mdspan(res, stream, index.graph)
+    """CRC32-enveloped versioned dump (reference: cagra_serialize.cuh)."""
+    with ser.enveloped_writer(stream) as body:
+        ser.serialize_scalar(res, body, np.int32(_SERIALIZATION_VERSION))
+        ser.serialize_scalar(res, body, np.int32(index.metric))
+        ser.serialize_mdspan(res, body, index.dataset)
+        ser.serialize_mdspan(res, body, index.graph)
 
 
 def deserialize(res, stream: BinaryIO) -> Index:
-    version = int(ser.deserialize_scalar(res, stream))
+    """Truncated / bit-flipped streams raise
+    :class:`~raft_tpu.core.serialize.CorruptIndexError`."""
+    body = ser.open_envelope(stream)
+    version = int(ser.deserialize_scalar(res, body))
     if version != _SERIALIZATION_VERSION:
         raise ValueError(
             f"cagra serialization version mismatch: got {version}, "
             f"expected {_SERIALIZATION_VERSION}")
-    metric = int(ser.deserialize_scalar(res, stream))
-    dataset = jnp.asarray(ser.deserialize_mdspan(res, stream))
-    graph = jnp.asarray(ser.deserialize_mdspan(res, stream))
+    metric = int(ser.deserialize_scalar(res, body))
+    dataset = jnp.asarray(ser.deserialize_mdspan(res, body))
+    graph = jnp.asarray(ser.deserialize_mdspan(res, body))
     return Index(dataset=dataset, graph=graph, metric=metric)
+
+
+def save(res, filename: str, index: Index, *, retry_policy=None,
+         deadline=None) -> None:
+    """Atomic file dump (tmp + fsync + rename) with transient-IO retry."""
+    from raft_tpu.resilience import save_index
+    save_index("cagra.save", lambda b: serialize(res, b, index),
+               filename, retry_policy, deadline)
+
+
+def load(res, filename: str, *, retry_policy=None, deadline=None) -> Index:
+    """File-load overload; transient IO retries, corruption fails fast."""
+    from raft_tpu.resilience import load_index
+    return load_index("cagra.load", lambda b: deserialize(res, b),
+                      filename, retry_policy, deadline)

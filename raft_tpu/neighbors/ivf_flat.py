@@ -636,23 +636,46 @@ _SERIALIZATION_VERSION = 1
 
 
 def serialize(res, stream: BinaryIO, index: Index) -> None:
-    """Versioned index dump (reference: detail/ivf_flat_serialize.cuh)."""
-    ser.serialize_scalar(res, stream, np.int32(_SERIALIZATION_VERSION))
-    ser.serialize_scalar(res, stream, np.int32(index.metric))
-    ser.serialize_scalar(res, stream, np.int32(index.adaptive_centers))
-    for arr in (index.centers, index.list_data, index.list_indices,
-                index.list_sizes):
-        ser.serialize_mdspan(res, stream, arr)
+    """Versioned index dump (reference: detail/ivf_flat_serialize.cuh),
+    wrapped in the CRC32 integrity envelope (core/serialize)."""
+    with ser.enveloped_writer(stream) as body:
+        ser.serialize_scalar(res, body, np.int32(_SERIALIZATION_VERSION))
+        ser.serialize_scalar(res, body, np.int32(index.metric))
+        ser.serialize_scalar(res, body, np.int32(index.adaptive_centers))
+        for arr in (index.centers, index.list_data, index.list_indices,
+                    index.list_sizes):
+            ser.serialize_mdspan(res, body, arr)
 
 
 def deserialize(res, stream: BinaryIO) -> Index:
-    version = int(ser.deserialize_scalar(res, stream))
+    """Truncated / bit-flipped streams raise
+    :class:`~raft_tpu.core.serialize.CorruptIndexError` (CRC-checked
+    envelope), never load as garbage arrays."""
+    body = ser.open_envelope(stream)
+    version = int(ser.deserialize_scalar(res, body))
     if version != _SERIALIZATION_VERSION:
         raise ValueError(
             f"ivf_flat serialization version mismatch: got {version}, "
             f"expected {_SERIALIZATION_VERSION}")  # reference hard-fails too
-    metric = int(ser.deserialize_scalar(res, stream))
-    adaptive = bool(ser.deserialize_scalar(res, stream))
-    arrays = [jnp.asarray(ser.deserialize_mdspan(res, stream))
+    metric = int(ser.deserialize_scalar(res, body))
+    adaptive = bool(ser.deserialize_scalar(res, body))
+    arrays = [jnp.asarray(ser.deserialize_mdspan(res, body))
               for _ in range(4)]
     return Index(*arrays, metric=metric, adaptive_centers=adaptive)
+
+
+def save(res, filename: str, index: Index, *, retry_policy=None,
+         deadline=None) -> None:
+    """Atomic file dump (tmp + fsync + rename) with transient-IO retry —
+    the filename overload of the reference's serialize, hardened."""
+    from raft_tpu.resilience import _save_index
+    _save_index("ivf_flat.save", lambda b: serialize(res, b, index),
+                filename, retry_policy, deadline)
+
+
+def load(res, filename: str, *, retry_policy=None, deadline=None) -> Index:
+    """File-load overload; transient IO errors retry, corruption raises
+    :class:`~raft_tpu.core.serialize.CorruptIndexError` immediately."""
+    from raft_tpu.resilience import _load_index
+    return _load_index("ivf_flat.load", lambda b: deserialize(res, b),
+                       filename, retry_policy, deadline)

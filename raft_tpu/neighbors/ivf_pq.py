@@ -46,6 +46,7 @@ from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_types import KMeansBalancedParams
 from raft_tpu.core import serialize as ser
 from raft_tpu.core.error import expects
+from raft_tpu.core.interruptible import interruptible
 from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.core.tracing import range as named_range
 from raft_tpu import observability as obs
@@ -349,8 +350,20 @@ def _encode(codebooks, resid, codebook_kind, labels=None):
     return out.reshape(n_pad, -1)[:n]
 
 
-def build(res, params: IndexParams, dataset) -> Index:
-    """Build an IVF-PQ index (reference: ivf_pq.cuh:224)."""
+def build(res, params: IndexParams, dataset, *,
+          checkpoint=None, resume: bool = False) -> Index:
+    """Build an IVF-PQ index (reference: ivf_pq.cuh:224).
+
+    ``checkpoint`` (a directory path or
+    :class:`~raft_tpu.resilience.CheckpointManager`) persists each build
+    stage's carry atomically right before its ``interruptible``
+    sync point; with ``resume=True`` completed stages are loaded instead
+    of recomputed.  Skipped stages still burn the same ``res.next_key()``
+    draws they would have consumed, so a resumed build is bit-identical
+    to an uninterrupted one.
+    """
+    from raft_tpu.resilience import as_manager
+    ckpt = as_manager(checkpoint)
     with named_range("ivf_pq::build"), \
             obs.build_scope("ivf_pq.build") as rep:
         dataset = ensure_array(dataset, "dataset")
@@ -378,28 +391,54 @@ def build(res, params: IndexParams, dataset) -> Index:
                 trainset = dataset
             train_rot = trainset.astype(jnp.float32) @ rotation
             bal = KMeansBalancedParams(n_iters=params.kmeans_n_iters)
-            centers = kmeans_balanced.fit(res, bal, train_rot,
-                                          params.n_lists)
+            if resume and ckpt is not None and ckpt.has("kmeans"):
+                # skip the fit but burn its key draw: the downstream key
+                # stream must match an uninterrupted build bit-for-bit
+                res.next_key()
+                centers = jnp.asarray(ckpt.load("kmeans")["centers"])
+            else:
+                centers = kmeans_balanced.fit(res, bal, train_rot,
+                                              params.n_lists)
+                if ckpt is not None:
+                    ckpt.save("kmeans", {"centers": np.asarray(centers)})
+            # cancellation point: the stage above is durable before a
+            # pending cancel() can raise
+            interruptible.synchronize(centers)
             st.fence(centers)
 
         # ---- codebooks over residuals ----------------------------------
         with obs.stage("ivf_pq.build.codebooks") as st:
-            labels_t = kmeans_balanced.predict(res, bal, train_rot, centers)
-            resid = _subspace_split(train_rot - centers[labels_t], pq_dim)
             book = 1 << params.pq_bits
-            if params.codebook_kind == CodebookKind.PER_SUBSPACE:
-                keys = jax.random.split(res.next_key(), pq_dim)
-                codebooks = _train_books_per_subspace(
-                    jnp.transpose(resid, (1, 0, 2)), keys, book,
-                    params.kmeans_n_iters)
+            if resume and ckpt is not None and ckpt.has("codebooks"):
+                # burn this stage's key draws (1 per-subspace, 2
+                # per-cluster) for the same reason as above
+                res.next_key()
+                if params.codebook_kind != CodebookKind.PER_SUBSPACE:
+                    res.next_key()
+                codebooks = jnp.asarray(ckpt.load("codebooks")["codebooks"])
             else:
-                # per-cluster: one book per coarse list over all its residual
-                # subvectors (train_per_cluster, ivf_pq_build.cuh:417)
-                flat = resid.reshape(-1, rot_dim // pq_dim)
-                flat_labels = jnp.repeat(labels_t, pq_dim)
-                codebooks = _train_books_per_cluster(
-                    res, flat, flat_labels, params.n_lists, book,
-                    params.kmeans_n_iters)
+                labels_t = kmeans_balanced.predict(res, bal, train_rot,
+                                                   centers)
+                resid = _subspace_split(train_rot - centers[labels_t],
+                                        pq_dim)
+                if params.codebook_kind == CodebookKind.PER_SUBSPACE:
+                    keys = jax.random.split(res.next_key(), pq_dim)
+                    codebooks = _train_books_per_subspace(
+                        jnp.transpose(resid, (1, 0, 2)), keys, book,
+                        params.kmeans_n_iters)
+                else:
+                    # per-cluster: one book per coarse list over all its
+                    # residual subvectors (train_per_cluster,
+                    # ivf_pq_build.cuh:417)
+                    flat = resid.reshape(-1, rot_dim // pq_dim)
+                    flat_labels = jnp.repeat(labels_t, pq_dim)
+                    codebooks = _train_books_per_cluster(
+                        res, flat, flat_labels, params.n_lists, book,
+                        params.kmeans_n_iters)
+                if ckpt is not None:
+                    ckpt.save("codebooks",
+                              {"codebooks": np.asarray(codebooks)})
+            interruptible.synchronize(codebooks)
             st.fence(codebooks)
 
         index = Index(
@@ -1029,28 +1068,33 @@ _SERIALIZATION_VERSION = 2
 
 
 def serialize(res, stream: BinaryIO, index: Index) -> None:
-    ser.serialize_scalar(res, stream, np.int32(_SERIALIZATION_VERSION))
-    ser.serialize_scalar(res, stream, np.int32(index.metric))
-    ser.serialize_scalar(res, stream, np.int32(index.codebook_kind))
-    ser.serialize_scalar(res, stream, np.int32(index.pq_bits))
-    ser.serialize_scalar(res, stream, np.int32(index.pq_dim))
-    for arr in (index.centers, index.codebooks, index.list_codes,
-                index.list_indices, index.list_sizes, index.rotation):
-        ser.serialize_mdspan(res, stream, arr)
+    """CRC32-enveloped versioned dump (reference: ivf_pq_serialize.cuh)."""
+    with ser.enveloped_writer(stream) as body:
+        ser.serialize_scalar(res, body, np.int32(_SERIALIZATION_VERSION))
+        ser.serialize_scalar(res, body, np.int32(index.metric))
+        ser.serialize_scalar(res, body, np.int32(index.codebook_kind))
+        ser.serialize_scalar(res, body, np.int32(index.pq_bits))
+        ser.serialize_scalar(res, body, np.int32(index.pq_dim))
+        for arr in (index.centers, index.codebooks, index.list_codes,
+                    index.list_indices, index.list_sizes, index.rotation):
+            ser.serialize_mdspan(res, body, arr)
 
 
 def deserialize(res, stream: BinaryIO, *,
                 cache_reconstructions: bool = True) -> Index:
-    version = int(ser.deserialize_scalar(res, stream))
+    """Truncated / bit-flipped streams raise
+    :class:`~raft_tpu.core.serialize.CorruptIndexError`."""
+    body = ser.open_envelope(stream)
+    version = int(ser.deserialize_scalar(res, body))
     if version != _SERIALIZATION_VERSION:
         raise ValueError(
             f"ivf_pq serialization version mismatch: got {version}, "
             f"expected {_SERIALIZATION_VERSION}")
-    metric = int(ser.deserialize_scalar(res, stream))
-    kind = int(ser.deserialize_scalar(res, stream))
-    pq_bits = int(ser.deserialize_scalar(res, stream))
-    pq_dim = int(ser.deserialize_scalar(res, stream))
-    arrays = [jnp.asarray(ser.deserialize_mdspan(res, stream))
+    metric = int(ser.deserialize_scalar(res, body))
+    kind = int(ser.deserialize_scalar(res, body))
+    pq_bits = int(ser.deserialize_scalar(res, body))
+    pq_dim = int(ser.deserialize_scalar(res, body))
+    arrays = [jnp.asarray(ser.deserialize_mdspan(res, body))
               for _ in range(6)]
     index = Index(*arrays, metric=metric, codebook_kind=kind,
                   pq_bits=pq_bits, pq_dim_=pq_dim)
@@ -1060,3 +1104,22 @@ def deserialize(res, stream: BinaryIO, *,
     if cache_reconstructions:
         index = _with_recon(res, index)
     return index
+
+
+def save(res, filename: str, index: Index, *, retry_policy=None,
+         deadline=None) -> None:
+    """Atomic file dump (tmp + fsync + rename) with transient-IO retry."""
+    from raft_tpu.resilience import save_index
+    save_index("ivf_pq.save", lambda b: serialize(res, b, index),
+               filename, retry_policy, deadline)
+
+
+def load(res, filename: str, *, cache_reconstructions: bool = True,
+         retry_policy=None, deadline=None) -> Index:
+    """File-load overload; transient IO retries, corruption fails fast."""
+    from raft_tpu.resilience import load_index
+    return load_index(
+        "ivf_pq.load",
+        lambda b: deserialize(
+            res, b, cache_reconstructions=cache_reconstructions),
+        filename, retry_policy, deadline)

@@ -1,0 +1,76 @@
+"""raft_tpu.resilience — fault injection, retries/deadlines, checkpoints.
+
+The failure-handling layer (PR 2) on top of PR 1's observability: the
+reference ships ``raft::interruptible`` and versioned serializers
+because cancellation and corrupt indexes are the first things that
+break at scale; this package adds the rest of the survival kit for a
+preemptible TPU fleet:
+
+- :mod:`~raft_tpu.resilience.faults` — deterministic, seed-pinned fault
+  injection at named sites (comms collectives, distributed search,
+  sync points, stream IO) so every failure path below is testable;
+- :mod:`~raft_tpu.resilience.retry` — jittered-backoff retries and
+  :class:`Deadline` budgets on distributed entry points and index IO,
+  counted as ``resilience.retry.*`` / ``resilience.giveup.*``;
+- :mod:`~raft_tpu.resilience.checkpoint` — atomic (tmp+fsync+rename)
+  build-stage persistence powering ``build(..., resume=True)``.
+
+Hardened serialization (CRC32 envelopes, short-read detection,
+:class:`~raft_tpu.core.serialize.CorruptIndexError`) lives in
+:mod:`raft_tpu.core.serialize`; degraded-mode sharded search lives in
+:mod:`raft_tpu.distributed.ann`.
+"""
+
+from raft_tpu.resilience.faults import (  # noqa: F401
+    FaultInjected,
+    FaultPlan,
+    FaultSpec,
+    TransientFault,
+    failed_shards,
+    inject,
+    is_active,
+    maybe_fail,
+)
+from raft_tpu.resilience.retry import (  # noqa: F401
+    DEFAULT_POLICY,
+    Deadline,
+    DeadlineExceededError,
+    RetryPolicy,
+    retry_call,
+    retryable,
+)
+from raft_tpu.resilience.checkpoint import (  # noqa: F401
+    CheckpointManager,
+    as_manager,
+    atomic_write,
+)
+from raft_tpu.resilience.io import (  # noqa: F401
+    load_index,
+    save_index,
+)
+
+# short internal aliases used by the neighbors save/load overloads
+_save_index = save_index
+_load_index = load_index
+
+__all__ = [
+    "CheckpointManager",
+    "DEFAULT_POLICY",
+    "Deadline",
+    "DeadlineExceededError",
+    "FaultInjected",
+    "FaultPlan",
+    "FaultSpec",
+    "RetryPolicy",
+    "TransientFault",
+    "as_manager",
+    "atomic_write",
+    "failed_shards",
+    "inject",
+    "is_active",
+    "load_index",
+    "maybe_fail",
+    "retry_call",
+    "retryable",
+    "save_index",
+]

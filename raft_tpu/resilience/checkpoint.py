@@ -1,0 +1,167 @@
+"""Checkpointed index builds: atomic stage persistence + resume.
+
+TPU fleets preempt routinely; a CAGRA/IVF-PQ build measured in minutes
+must not restart from scratch.  Builds persist their loop-carry state
+(kmeans centers, graph-so-far, PQ codebooks) at their
+``interruptible.synchronize`` points through a
+:class:`CheckpointManager`; ``build(..., checkpoint=dir, resume=True)``
+then restarts from the last completed stage.
+
+File layout (documented contract, docs/api.md "Resilience")::
+
+    <dir>/MANIFEST.json      # {"stages": [name, ...]} in completion order
+    <dir>/<stage>.ckpt       # one CRC32 envelope (core/serialize) wrapping
+                             # count + (name, array) records
+
+Atomicity: every file is written to ``<name>.tmp.<pid>``, flushed,
+``fsync``'d, and ``os.replace``'d into place (POSIX-atomic), then the
+directory entry is fsync'd — a crash mid-save leaves either the old
+file or the new one, never a torn one.  The MANIFEST is rewritten
+(same protocol) only *after* its stage file landed, so a stage listed
+in the manifest is always readable.  Payloads ride the same CRC32
+envelope as index serialization: a torn/bit-flipped checkpoint raises
+:class:`~raft_tpu.core.serialize.CorruptIndexError` at load and the
+stage is rebuilt instead of poisoning the resumed index.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Union
+
+import numpy as np
+
+from raft_tpu.core import serialize as ser
+from raft_tpu.core.error import expects
+from raft_tpu.resilience import faults
+
+
+def _fsync_dir(path: str) -> None:
+    # direntry durability (rename alone is atomic but not durable)
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # platforms/filesystems without dir fds
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def atomic_write(path: str, data: bytes) -> None:
+    """tmp + flush + fsync + rename: the file at ``path`` is either the
+    previous version or all of ``data`` — never a prefix."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path) or ".")
+
+
+class CheckpointManager:
+    """Directory of atomically-written, CRC-enveloped build stages."""
+
+    _MANIFEST = "MANIFEST.json"
+
+    def __init__(self, path: str) -> None:
+        self.path = str(path)
+        os.makedirs(self.path, exist_ok=True)
+        self._stages: List[str] = self._read_manifest()
+
+    # -- manifest ----------------------------------------------------------
+    def _read_manifest(self) -> List[str]:
+        p = os.path.join(self.path, self._MANIFEST)
+        if not os.path.exists(p):
+            return []
+        try:
+            with open(p, "r") as f:
+                doc = json.load(f)
+            return list(doc.get("stages", []))
+        except (OSError, ValueError):
+            # torn manifest (should be impossible given atomic_write;
+            # treat as empty rather than failing the resumed build)
+            return []
+
+    def _write_manifest(self) -> None:
+        doc = json.dumps({"stages": self._stages}).encode()
+        atomic_write(os.path.join(self.path, self._MANIFEST), doc)
+
+    @property
+    def completed(self) -> List[str]:
+        """Stage names in completion order."""
+        return list(self._stages)
+
+    def has(self, stage: str) -> bool:
+        return (stage in self._stages
+                and os.path.exists(self._file(stage)))
+
+    def _file(self, stage: str) -> str:
+        expects("/" not in stage and stage not in ("", ".", ".."),
+                f"checkpoint: bad stage name {stage!r}")
+        return os.path.join(self.path, f"{stage}.ckpt")
+
+    # -- stage IO ----------------------------------------------------------
+    def save(self, stage: str, arrays: Dict[str, np.ndarray]) -> None:
+        """Persist one stage's named arrays atomically; the stage enters
+        the manifest only after its file is durable."""
+        faults.maybe_fail("checkpoint.save")
+        import io
+
+        body = io.BytesIO()
+        ser.serialize_scalar(None, body, np.int32(len(arrays)))
+        for name, arr in arrays.items():
+            ser.serialize_mdspan(None, body, np.asarray(name))
+            ser.serialize_mdspan(None, body, np.asarray(arr))
+        out = io.BytesIO()
+        ser.write_envelope(out, body.getvalue())
+        atomic_write(self._file(stage), out.getvalue())
+        if stage in self._stages:
+            self._stages.remove(stage)
+        self._stages.append(stage)
+        self._write_manifest()
+        _count("resilience.checkpoint.save")
+
+    def load(self, stage: str) -> Dict[str, np.ndarray]:
+        """Restore one stage; raises
+        :class:`~raft_tpu.core.serialize.CorruptIndexError` on a torn or
+        bit-flipped file (callers rebuild the stage instead)."""
+        faults.maybe_fail("checkpoint.load")
+        import io
+
+        with open(self._file(stage), "rb") as f:
+            payload = ser.read_envelope(f)
+        body = io.BytesIO(payload)
+        n = int(ser.deserialize_scalar(None, body))
+        out: Dict[str, np.ndarray] = {}
+        for _ in range(n):
+            name = str(ser.deserialize_mdspan(None, body))
+            out[name] = ser.deserialize_mdspan(None, body)
+        _count("resilience.checkpoint.load")
+        return out
+
+    def clear(self) -> None:
+        """Drop all stages (a completed build retires its checkpoints)."""
+        for s in list(self._stages):
+            try:
+                os.remove(self._file(s))
+            except OSError:
+                pass
+        self._stages = []
+        self._write_manifest()
+
+
+def as_manager(checkpoint: Optional[Union[str, CheckpointManager]]
+               ) -> Optional[CheckpointManager]:
+    """Builds accept a path or a manager; normalize (None passes through)."""
+    if checkpoint is None or isinstance(checkpoint, CheckpointManager):
+        return checkpoint
+    return CheckpointManager(str(checkpoint))
+
+
+def _count(name: str) -> None:
+    from raft_tpu import observability as obs
+    if obs.enabled():
+        obs.registry().counter(name).inc()

@@ -1,0 +1,229 @@
+"""Deterministic fault injection at named library sites.
+
+The failure-handling analogue of the reference's test-only CUDA error
+stubs: production RAFT is hardened against transient NCCL / IO failures
+by the surrounding service; raft_tpu bakes the seam into the library so
+failure paths are *testable on a laptop*.  Library code calls
+:func:`maybe_fail(site)` at well-known points; with no plan active the
+call is a single ``None`` check (zero allocation, zero locking) — the
+hot search path pays nothing.
+
+Named sites (see docs/api.md "Resilience"):
+
+======================================  ====================================
+site                                    where it fires
+======================================  ====================================
+``comms.<op>``                          each collective in
+                                        :mod:`raft_tpu.comms.comms`
+                                        (``allreduce``, ``reduce``,
+                                        ``bcast``, ``allgather``,
+                                        ``allgatherv``, ``gather``,
+                                        ``gatherv``, ``reducescatter``,
+                                        ``barrier``, ``isend``) — fires at
+                                        *trace* time (collectives are
+                                        traced-context calls; a jit cache
+                                        hit does not re-enter the site)
+``distributed.ann.search`` /            host-side, once per distributed
+``distributed.ann.build`` (+ ``_flat``  search/build call, before dispatch
+/ ``_cagra`` variants)
+``interruptible.synchronize``           every ``interruptible.synchronize``
+                                        host sync point
+``serialize.write`` /                   every record written/read by
+``serialize.read``                      :mod:`raft_tpu.core.serialize`
+``checkpoint.save`` /                   every :class:`CheckpointManager`
+``checkpoint.load``                     stage persisted / restored
+======================================  ====================================
+
+Scripting is explicit and deterministic::
+
+    plan = (FaultPlan(seed=7)
+            .at("comms.allreduce", times=1, exc=TransientFault)
+            .fail_shards(1))          # shard 1's leaves are "lost"
+    with plan.active():
+        ...   # first traced allreduce raises TransientFault; shard 1
+              # is reported failed by faults.failed_shards(n)
+
+``times`` bounds how often a spec fires, ``after`` skips the first N
+matching calls ("fail the 2nd synchronize"), and ``p`` draws from the
+plan's seeded RNG (``RAFT_TPU_FAULT_SEED`` pins the default seed) so a
+probabilistic schedule replays identically.  Fired injections bump
+``resilience.fault.injected.<site>`` in the observability registry when
+collection is enabled.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import random
+import threading
+from typing import Callable, Iterator, List, Optional, Tuple
+
+from raft_tpu.core.error import RaftError
+
+_SEED_ENV = "RAFT_TPU_FAULT_SEED"
+
+
+class FaultInjected(RaftError):
+    """Base class for injected failures (never raised organically)."""
+
+
+class TransientFault(FaultInjected):
+    """An injected failure that retry wrappers treat as retryable —
+    the scripted analogue of a flaky collective / flaky filesystem."""
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One scripted failure: fire at ``site`` up to ``times`` times
+    (None = unbounded), skipping the first ``after`` matching calls,
+    each firing gated by probability ``p`` from the plan's seeded RNG.
+    ``exc`` is an exception class or zero/one-arg factory."""
+
+    site: str
+    times: Optional[int] = 1
+    exc: Callable[..., BaseException] = TransientFault
+    after: int = 0
+    p: float = 1.0
+    _seen: int = 0
+    _fired: int = 0
+
+    def matches(self, site: str) -> bool:
+        return self.site == site
+
+    @property
+    def fired(self) -> int:
+        """How many times this spec has raised (for test assertions)."""
+        return self._fired
+
+
+class FaultPlan:
+    """An ordered set of :class:`FaultSpec` plus failed-shard flags,
+    activated via :meth:`active` (or :func:`inject`).  Thread-safe:
+    sites may be hit from worker threads (host callbacks, build
+    threads)."""
+
+    def __init__(self, seed: Optional[int] = None) -> None:
+        if seed is None:
+            seed = int(os.environ.get(_SEED_ENV, "0"))
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._specs: List[FaultSpec] = []
+        self._failed_shards: set = set()
+        self._lock = threading.Lock()
+
+    # -- scripting ---------------------------------------------------------
+    def at(self, site: str, *, times: Optional[int] = 1,
+           exc: Callable[..., BaseException] = TransientFault,
+           after: int = 0, p: float = 1.0) -> "FaultPlan":
+        """Script a failure at ``site``; returns self for chaining."""
+        self._specs.append(FaultSpec(site=site, times=times, exc=exc,
+                                     after=after, p=p))
+        return self
+
+    def fail_shards(self, *shards: int) -> "FaultPlan":
+        """Flag distributed-index shards as failed: degraded search
+        (``distributed.ann``) drops them and reports them in the status
+        vector instead of crashing the query."""
+        self._failed_shards.update(int(s) for s in shards)
+        return self
+
+    @property
+    def specs(self) -> Tuple[FaultSpec, ...]:
+        return tuple(self._specs)
+
+    # -- evaluation --------------------------------------------------------
+    def _check(self, site: str) -> None:
+        with self._lock:
+            for spec in self._specs:
+                if not spec.matches(site):
+                    continue
+                if spec.times is not None and spec._fired >= spec.times:
+                    continue
+                spec._seen += 1
+                if spec._seen <= spec.after:
+                    continue
+                if spec.p < 1.0 and self._rng.random() >= spec.p:
+                    continue
+                spec._fired += 1
+                _count(site)
+                try:
+                    raise spec.exc(f"injected fault at {site!r}")
+                except TypeError:
+                    raise spec.exc()  # zero-arg factories
+
+    @contextlib.contextmanager
+    def active(self) -> Iterator["FaultPlan"]:
+        """Install this plan for the body (plans nest LIFO)."""
+        token = _push(self)
+        try:
+            yield self
+        finally:
+            _pop(token)
+
+
+# ---------------------------------------------------------------------------
+# active-plan stack.  A plain module global (not a ContextVar): sites are
+# hit from worker threads the test's context never propagates to, and the
+# whole point is that the *process* is under a scripted failure regime.
+
+_ACTIVE: Optional[FaultPlan] = None
+_STACK: List[FaultPlan] = []
+_STATE_LOCK = threading.Lock()
+
+
+def _push(plan: FaultPlan) -> int:
+    global _ACTIVE
+    with _STATE_LOCK:
+        _STACK.append(plan)
+        _ACTIVE = plan
+        return len(_STACK) - 1
+
+
+def _pop(token: int) -> None:
+    global _ACTIVE
+    with _STATE_LOCK:
+        del _STACK[token:]
+        _ACTIVE = _STACK[-1] if _STACK else None
+
+
+def _count(site: str) -> None:
+    from raft_tpu import observability as obs
+    if obs.enabled():
+        obs.registry().counter(f"resilience.fault.injected.{site}").inc()
+
+
+@contextlib.contextmanager
+def inject(*args, seed: Optional[int] = None, **at_kwargs) -> Iterator[FaultPlan]:
+    """Shorthand: ``with inject("comms.allreduce", times=1): ...``
+    activates a one-spec plan (or an empty plan with no site, useful to
+    scope :meth:`FaultPlan.fail_shards` set on the yielded plan)."""
+    plan = FaultPlan(seed=seed)
+    if args:
+        plan.at(args[0], **at_kwargs)
+    with plan.active():
+        yield plan
+
+
+def is_active() -> bool:
+    return _ACTIVE is not None
+
+
+def maybe_fail(site: str) -> None:
+    """The library-side hook: raise if the active plan scripts a failure
+    here.  **No plan active → a single attribute load + None check.**"""
+    plan = _ACTIVE
+    if plan is None:
+        return
+    plan._check(site)
+
+
+def failed_shards(n_shards: int) -> Tuple[int, ...]:
+    """Shards the active plan flags failed, clipped to ``range(n_shards)``
+    (empty when no plan is active)."""
+    plan = _ACTIVE
+    if plan is None:
+        return ()
+    return tuple(sorted(s for s in plan._failed_shards
+                        if 0 <= s < n_shards))

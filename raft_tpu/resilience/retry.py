@@ -1,0 +1,178 @@
+"""Retry / backoff / deadline wrappers.
+
+Counterpart of the failure-handling the reference leaves to its callers
+(raft-dask resubmits tasks; NCCL aborts bubble to the service layer):
+here retries are a library primitive so distributed entry points and
+index IO survive transient faults.
+
+- :class:`RetryPolicy` — jittered exponential backoff; only exceptions
+  in ``retryable`` are retried (``TransientFault`` and ``OSError`` by
+  default — logic errors and corruption are deterministic and must not
+  be retried).
+- :class:`Deadline` — a wall-clock budget threaded through retries:
+  the sleep before an attempt never overshoots the budget, and an
+  expired deadline raises :class:`DeadlineExceededError` instead of
+  starting another attempt.
+- :func:`retry_call` — run a thunk under a policy + deadline, bumping
+  ``resilience.retry.<site>`` per re-attempt and
+  ``resilience.giveup.<site>`` when attempts/deadline are exhausted
+  (observability registry, collection-gated like every other counter).
+
+This module is the ONE place in the library allowed to sleep: CI rejects
+bare ``time.sleep`` anywhere else under ``raft_tpu/`` (the same style of
+guard that keeps raw ``time.perf_counter`` out of library code).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Callable, Optional, Tuple, Type, TypeVar
+
+from raft_tpu.core.error import RaftError
+from raft_tpu.resilience.faults import TransientFault
+
+T = TypeVar("T")
+
+
+class DeadlineExceededError(RaftError):
+    """The operation's time budget ran out (attempts may remain)."""
+
+
+class Deadline:
+    """A monotonic wall-clock budget.
+
+    ``Deadline(5.0)`` expires 5 s after construction; pass it through
+    nested calls so one budget bounds the whole operation (build +
+    retries + IO), the way the reference's stream-ordered work is
+    bounded by the caller's stream lifetime.  ``Deadline(None)`` never
+    expires (the default everywhere).
+    """
+
+    __slots__ = ("_expires_at", "_clock")
+
+    def __init__(self, seconds: Optional[float],
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self._clock = clock
+        self._expires_at = (None if seconds is None
+                            else clock() + float(seconds))
+
+    @classmethod
+    def unlimited(cls) -> "Deadline":
+        return cls(None)
+
+    def remaining(self) -> float:
+        """Seconds left (``inf`` for unlimited, clamped at 0.0)."""
+        if self._expires_at is None:
+            return float("inf")
+        return max(0.0, self._expires_at - self._clock())
+
+    @property
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def check(self, what: str = "operation") -> None:
+        """Raise :class:`DeadlineExceededError` if the budget is spent."""
+        if self.expired:
+            raise DeadlineExceededError(
+                f"deadline exceeded before {what}")
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Jittered exponential backoff.
+
+    Attempt ``i`` (1-based) sleeps ``base_delay * multiplier**(i-1)``
+    capped at ``max_delay``, scaled by a uniform jitter in
+    ``[1 - jitter, 1 + jitter]`` — full determinism when the caller
+    passes a seeded ``rng``.  ``max_attempts`` counts *total* attempts
+    (1 = no retry)."""
+
+    max_attempts: int = 3
+    base_delay: float = 0.05
+    max_delay: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.5
+    retryable: Tuple[Type[BaseException], ...] = (TransientFault, OSError)
+    # deterministic failures inside otherwise-retryable families: a
+    # missing file will still be missing on attempt 2
+    non_retryable: Tuple[Type[BaseException], ...] = (FileNotFoundError,)
+
+    def delay(self, attempt: int, rng: Optional[random.Random] = None
+              ) -> float:
+        """Backoff before attempt ``attempt + 1`` (attempt is 1-based)."""
+        d = min(self.max_delay,
+                self.base_delay * self.multiplier ** (attempt - 1))
+        if self.jitter:
+            u = (rng.random() if rng is not None else random.random())
+            d *= 1.0 + self.jitter * (2.0 * u - 1.0)
+        return max(d, 0.0)
+
+    def is_retryable(self, exc: BaseException) -> bool:
+        return (isinstance(exc, self.retryable)
+                and not isinstance(exc, self.non_retryable))
+
+
+DEFAULT_POLICY = RetryPolicy()
+
+# test seam: monkeypatch to a no-op to run backoff schedules instantly
+_sleep = time.sleep
+
+
+def retry_call(fn: Callable[..., T], *args,
+               site: str,
+               policy: Optional[RetryPolicy] = None,
+               deadline: Optional[Deadline] = None,
+               rng: Optional[random.Random] = None,
+               **kwargs) -> T:
+    """Call ``fn(*args, **kwargs)`` under ``policy`` + ``deadline``.
+
+    Per re-attempt: ``resilience.retry.<site>`` +1.  On giving up
+    (attempts exhausted, non-retryable error, or deadline expiry):
+    ``resilience.giveup.<site>`` +1 and the last error (or
+    :class:`DeadlineExceededError`) propagates.
+    """
+    policy = policy or DEFAULT_POLICY
+    deadline = deadline or Deadline.unlimited()
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            deadline.check(f"{site} attempt {attempt}")
+        except DeadlineExceededError:
+            _count(f"resilience.giveup.{site}")
+            raise
+        try:
+            return fn(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001 - classified below
+            if (not policy.is_retryable(e)
+                    or attempt >= policy.max_attempts):
+                _count(f"resilience.giveup.{site}")
+                raise
+            pause = min(policy.delay(attempt, rng), deadline.remaining())
+            _count(f"resilience.retry.{site}")
+            if pause > 0.0:
+                _sleep(pause)
+
+
+def retryable(site: str, *, policy: Optional[RetryPolicy] = None):
+    """Decorator form of :func:`retry_call`; the wrapped function gains
+    optional ``retry_policy=`` / ``deadline=`` keyword-only arguments."""
+    def wrap(fn: Callable[..., T]) -> Callable[..., T]:
+        def inner(*args, retry_policy: Optional[RetryPolicy] = None,
+                  deadline: Optional[Deadline] = None, **kwargs) -> T:
+            return retry_call(fn, *args, site=site,
+                              policy=retry_policy or policy,
+                              deadline=deadline, **kwargs)
+        inner.__name__ = fn.__name__
+        inner.__doc__ = fn.__doc__
+        inner.__wrapped__ = fn
+        return inner
+    return wrap
+
+
+def _count(name: str) -> None:
+    from raft_tpu import observability as obs
+    if obs.enabled():
+        obs.registry().counter(name).inc()

@@ -29,6 +29,8 @@ jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
+from raft_tpu.core.compat import shard_map  # noqa: E402
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from raft_tpu.comms.comms import op_t  # noqa: E402
@@ -53,7 +55,7 @@ def replicated(fn):
     """jit(shard_map) with replicated output — every process can read
     its local copy (multi-controller: np.asarray on a sharded global
     array is not allowed)."""
-    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=(),
+    return jax.jit(shard_map(fn, mesh=mesh, in_specs=(),
                                  out_specs=P(), check_vma=False))
 
 
@@ -155,7 +157,7 @@ def _grid():
     return jnp.stack([a, b, g])[None]
 
 
-out = jax.jit(jax.shard_map(_grid, mesh=s2.mesh, in_specs=(),
+out = jax.jit(shard_map(_grid, mesh=s2.mesh, in_specs=(),
                             out_specs=P(), check_vma=False))()
 a, b, g = np.asarray(out.addressable_data(0)).ravel()
 cols = n_dev // 2

@@ -5,6 +5,7 @@ analogue, SURVEY.md §4) and run the C++-side-style collective self-checks
 """
 
 import jax
+from raft_tpu.core.compat import shard_map
 import numpy as np
 import pytest
 
@@ -98,7 +99,7 @@ def test_isend_many_to_one_fallback(session):
         (got,) = comms.waitall(reqs)
         return got[None]
 
-    shard = jax.shard_map(body, mesh=session.mesh, in_specs=P(),
+    shard = shard_map(body, mesh=session.mesh, in_specs=P(),
                           out_specs=P(session.axis_name), check_vma=False)
     res = np.asarray(jax.jit(shard)())
     expected = np.asarray([r - 1 if r % 2 == 1 else 0.0
@@ -144,7 +145,7 @@ class Test2DGrid:
                                   .astype(jnp.float32))
                 return (a * 10 + b + jnp.sum(g) * 0)[None]
 
-            shard = jax.shard_map(body, mesh=s.mesh, in_specs=P(),
+            shard = shard_map(body, mesh=s.mesh, in_specs=P(),
                                   out_specs=P(("row", "col")),
                                   check_vma=False)
             res = np.asarray(jax.jit(shard)())
@@ -153,8 +154,6 @@ class Test2DGrid:
             s.destroy()
 
 
-@pytest.mark.skipif(not hasattr(jax, "shard_map"),
-                    reason="jax.shard_map unavailable in this jax")
 def test_collective_counters(session):
     # observability wiring: collectives record call/byte counters at
     # trace time (the self-test retraces per call: fresh closures)
@@ -166,3 +165,52 @@ def test_collective_counters(session):
     obs.reset()
     assert snap["counters"].get("comms.allreduce.calls", 0) >= 1
     assert snap["counters"].get("comms.allreduce.bytes", 0) >= 4
+
+
+def test_reduce_gather_record_own_counters(session):
+    # reduce/gather share lowering with allreduce/allgather but must be
+    # attributed under their OWN names (recorded before dispatch) —
+    # PROD included
+    import jax.numpy as jnp
+    from raft_tpu import observability as obs
+    from raft_tpu.comms import Comms
+    from raft_tpu.comms.comms import op_t
+    P = jax.sharding.PartitionSpec
+
+    def body():
+        c = Comms(session.axis_name)
+        r = c.reduce(jnp.ones((), jnp.float32), op=op_t.PROD)
+        g = c.gather(jax.lax.axis_index(session.axis_name)
+                     .astype(jnp.float32))
+        return (r + jnp.sum(g))[None]
+
+    obs.reset()
+    with obs.collecting():
+        fn = shard_map(body, mesh=session.mesh, in_specs=(),
+                       out_specs=P(session.axis_name), check_vma=False)
+        np.asarray(jax.jit(fn)())
+    snap = obs.snapshot()["counters"]
+    obs.reset()
+    assert snap.get("comms.reduce.calls", 0) == 1
+    assert snap.get("comms.gather.calls", 0) == 1
+    assert "comms.allreduce.calls" not in snap
+    assert "comms.allgather.calls" not in snap
+
+
+def test_comms_fault_site_fires_at_trace(session):
+    # resilience: a scripted comms.allreduce fault raises at trace time
+    from raft_tpu.resilience import TransientFault, inject
+    import jax.numpy as jnp
+    from raft_tpu.comms import Comms
+    P = jax.sharding.PartitionSpec
+
+    def body():
+        return Comms(session.axis_name).allreduce(
+            jnp.ones((), jnp.float32))[None]
+
+    with inject("comms.allreduce", times=1):
+        with pytest.raises(TransientFault):
+            fn = shard_map(body, mesh=session.mesh, in_specs=(),
+                           out_specs=P(session.axis_name),
+                           check_vma=False)
+            jax.jit(fn)()

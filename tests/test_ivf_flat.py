@@ -252,17 +252,31 @@ class TestIvfFlat:
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
         np.testing.assert_allclose(np.asarray(d1), np.asarray(d2))
 
-    def test_version_mismatch_fails(self, res, dataset):
+    def test_version_mismatch_fails(self, res, dataset, monkeypatch):
+        db, _ = dataset
+        params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=2)
+        index = ivf_flat.build(res, params, db)
+        buf = io.BytesIO()
+        # a *well-formed* stream from a future format version must be
+        # rejected by the version check, not the CRC
+        monkeypatch.setattr(ivf_flat, "_SERIALIZATION_VERSION", 99)
+        ivf_flat.serialize(res, buf, index)
+        monkeypatch.undo()
+        buf.seek(0)
+        with pytest.raises(ValueError, match="version"):
+            ivf_flat.deserialize(res, buf)
+
+    def test_corrupt_payload_fails(self, res, dataset):
+        from raft_tpu.core.serialize import CorruptIndexError
         db, _ = dataset
         params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=2)
         index = ivf_flat.build(res, params, db)
         buf = io.BytesIO()
         ivf_flat.serialize(res, buf, index)
         raw = bytearray(buf.getvalue())
-        # corrupt the version scalar payload (after 4-byte magic + 1 len +
-        # dtype str '<i4')
-        raw[8] = 99
-        with pytest.raises(ValueError, match="version"):
+        # flip one payload byte: the envelope CRC must catch it
+        raw[len(raw) // 2] ^= 0xFF
+        with pytest.raises(CorruptIndexError):
             ivf_flat.deserialize(res, io.BytesIO(bytes(raw)))
 
 

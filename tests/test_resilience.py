@@ -1,0 +1,653 @@
+"""Resilience subsystem tests: deterministic fault injection, retry /
+deadline wrappers, checkpointed builds with resume, hardened (CRC
+enveloped) serialization, and degraded-mode distributed search.
+"""
+
+import io
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from raft_tpu import observability as obs
+from raft_tpu.core.interruptible import InterruptedException, interruptible
+from raft_tpu.core import serialize as ser
+from raft_tpu.core.serialize import CorruptIndexError
+from raft_tpu.resilience import (
+    CheckpointManager,
+    Deadline,
+    DeadlineExceededError,
+    FaultPlan,
+    RetryPolicy,
+    TransientFault,
+    atomic_write,
+    faults,
+    inject,
+    retry_call,
+)
+from raft_tpu.resilience import retry as retry_mod
+
+
+@pytest.fixture(autouse=True)
+def _no_sleep(monkeypatch):
+    # run every backoff schedule instantly; delays are asserted, not slept
+    monkeypatch.setattr(retry_mod, "_sleep", lambda s: None)
+
+
+@pytest.fixture
+def fresh_res():
+    from raft_tpu import DeviceResources
+    return lambda: DeviceResources(seed=42)
+
+
+# ---------------------------------------------------------------------------
+# fault injection
+# ---------------------------------------------------------------------------
+
+class TestFaults:
+    def test_inactive_is_noop(self):
+        assert not faults.is_active()
+        faults.maybe_fail("comms.allreduce")  # no plan: must not raise
+
+    def test_times_bounds_firing(self):
+        plan = FaultPlan(seed=0).at("site.a", times=2)
+        with plan.active():
+            for _ in range(2):
+                with pytest.raises(TransientFault):
+                    faults.maybe_fail("site.a")
+            faults.maybe_fail("site.a")  # budget spent
+        assert plan.specs[0].fired == 2
+
+    def test_after_skips_leading_calls(self):
+        plan = FaultPlan(seed=0).at("site.b", times=1, after=2)
+        with plan.active():
+            faults.maybe_fail("site.b")
+            faults.maybe_fail("site.b")
+            with pytest.raises(TransientFault):
+                faults.maybe_fail("site.b")
+
+    def test_probability_is_seed_deterministic(self):
+        def run(seed):
+            hits = []
+            plan = FaultPlan(seed=seed).at("site.c", times=None, p=0.5)
+            with plan.active():
+                for i in range(32):
+                    try:
+                        faults.maybe_fail("site.c")
+                        hits.append(0)
+                    except TransientFault:
+                        hits.append(1)
+            return hits
+
+        a, b = run(123), run(123)
+        assert a == b
+        assert 0 < sum(a) < 32
+
+    def test_seed_env_pins_default(self, monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_FAULT_SEED", "777")
+        assert FaultPlan().seed == 777
+
+    def test_custom_exception(self):
+        with inject("site.d", exc=InterruptedException):
+            with pytest.raises(InterruptedException):
+                faults.maybe_fail("site.d")
+
+    def test_nested_plans_are_lifo(self):
+        outer = FaultPlan(seed=0).at("site.e")
+        inner = FaultPlan(seed=0).at("site.f")
+        with outer.active():
+            with inner.active():
+                faults.maybe_fail("site.e")  # outer shadowed
+                with pytest.raises(TransientFault):
+                    faults.maybe_fail("site.f")
+            with pytest.raises(TransientFault):
+                faults.maybe_fail("site.e")
+        assert not faults.is_active()
+
+    def test_injection_counter(self):
+        obs.reset()
+        with obs.collecting():
+            with inject("site.g"):
+                with pytest.raises(TransientFault):
+                    faults.maybe_fail("site.g")
+        c = obs.snapshot()["counters"]
+        assert c.get("resilience.fault.injected.site.g") == 1
+
+    def test_failed_shards_clipped(self):
+        plan = FaultPlan(seed=0).fail_shards(1, 5, 99, -3)
+        with plan.active():
+            assert faults.failed_shards(8) == (1, 5)
+        assert faults.failed_shards(8) == ()
+
+
+# ---------------------------------------------------------------------------
+# retry / deadline
+# ---------------------------------------------------------------------------
+
+class TestRetry:
+    def test_recovers_after_transient(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise TransientFault("flaky")
+            return "ok"
+
+        obs.reset()
+        with obs.collecting():
+            out = retry_call(flaky, site="t.recover",
+                             policy=RetryPolicy(max_attempts=3))
+        assert out == "ok" and len(calls) == 3
+        c = obs.snapshot()["counters"]
+        assert c.get("resilience.retry.t.recover") == 2
+        assert "resilience.giveup.t.recover" not in c
+
+    def test_exhaustion_raises_and_counts_giveup(self):
+        def always():
+            raise TransientFault("always")
+
+        obs.reset()
+        with obs.collecting():
+            with pytest.raises(TransientFault):
+                retry_call(always, site="t.exhaust",
+                           policy=RetryPolicy(max_attempts=3))
+        c = obs.snapshot()["counters"]
+        assert c.get("resilience.retry.t.exhaust") == 2
+        assert c.get("resilience.giveup.t.exhaust") == 1
+
+    def test_non_retryable_fails_fast(self):
+        calls = []
+
+        def logic_error():
+            calls.append(1)
+            raise ValueError("deterministic")
+
+        with pytest.raises(ValueError):
+            retry_call(logic_error, site="t.logic")
+        assert len(calls) == 1
+
+    def test_file_not_found_not_retried(self):
+        # FileNotFoundError is OSError but deterministic: listed
+        # non-retryable so it is not pointlessly re-attempted
+        calls = []
+
+        def missing():
+            calls.append(1)
+            raise FileNotFoundError("/nope")
+
+        with pytest.raises(FileNotFoundError):
+            retry_call(missing, site="t.missing")
+        assert len(calls) == 1
+
+    def test_deadline_expiry(self):
+        t = {"now": 0.0}
+        dl = Deadline(10.0, clock=lambda: t["now"])
+        assert dl.remaining() == 10.0
+        t["now"] = 11.0
+        assert dl.expired
+        with pytest.raises(DeadlineExceededError):
+            dl.check("op")
+
+    def test_deadline_stops_retries(self):
+        t = {"now": 0.0}
+
+        def always():
+            t["now"] += 6.0  # each attempt burns 6 "seconds"
+            raise TransientFault("slow")
+
+        obs.reset()
+        with obs.collecting():
+            with pytest.raises(DeadlineExceededError):
+                retry_call(always, site="t.deadline",
+                           policy=RetryPolicy(max_attempts=100),
+                           deadline=Deadline(10.0, clock=lambda: t["now"]))
+        c = obs.snapshot()["counters"]
+        assert c.get("resilience.giveup.t.deadline") == 1
+
+    def test_unlimited_deadline(self):
+        dl = Deadline.unlimited()
+        assert dl.remaining() == float("inf") and not dl.expired
+
+    def test_backoff_schedule_and_jitter_determinism(self):
+        import random
+        pol = RetryPolicy(base_delay=0.1, multiplier=2.0, max_delay=1.0,
+                          jitter=0.0)
+        assert pol.delay(1) == pytest.approx(0.1)
+        assert pol.delay(2) == pytest.approx(0.2)
+        assert pol.delay(10) == pytest.approx(1.0)  # capped
+        jit = RetryPolicy(base_delay=0.1, jitter=0.5)
+        a = [jit.delay(i, random.Random(5)) for i in range(1, 4)]
+        b = [jit.delay(i, random.Random(5)) for i in range(1, 4)]
+        assert a == b
+
+    def test_retryable_decorator(self):
+        from raft_tpu.resilience import retryable
+        calls = []
+
+        @retryable("t.deco")
+        def flaky(x):
+            calls.append(1)
+            if len(calls) < 2:
+                raise TransientFault("once")
+            return x + 1
+
+        assert flaky(41, retry_policy=RetryPolicy(max_attempts=2)) == 42
+        assert len(calls) == 2
+
+
+# ---------------------------------------------------------------------------
+# serialization hardening: short reads, envelope CRC
+# ---------------------------------------------------------------------------
+
+class TestSerializationHardening:
+    def test_scalar_roundtrip(self):
+        buf = io.BytesIO()
+        ser.serialize_scalar(None, buf, np.int32(42))
+        buf.seek(0)
+        assert int(ser.deserialize_scalar(None, buf)) == 42
+
+    def test_scalar_short_read_reports_offsets(self):
+        buf = io.BytesIO()
+        ser.serialize_scalar(None, buf, np.int64(7))
+        raw = buf.getvalue()
+        with pytest.raises(CorruptIndexError, match="byte"):
+            ser.deserialize_scalar(None, io.BytesIO(raw[:-3]))
+
+    def test_scalar_bad_magic(self):
+        with pytest.raises(CorruptIndexError):
+            ser.deserialize_scalar(None, io.BytesIO(b"XXXX\x03<i4" + b"\0" * 4))
+
+    def test_scalar_empty_stream(self):
+        with pytest.raises(CorruptIndexError):
+            ser.deserialize_scalar(None, io.BytesIO(b""))
+
+    def test_mdspan_truncation(self):
+        buf = io.BytesIO()
+        ser.serialize_mdspan(None, buf, np.arange(100, dtype=np.float32))
+        raw = buf.getvalue()
+        with pytest.raises(CorruptIndexError):
+            ser.deserialize_mdspan(None, io.BytesIO(raw[: len(raw) // 2]))
+
+    def test_envelope_roundtrip(self):
+        payload = os.urandom(300)
+        buf = io.BytesIO()
+        ser.write_envelope(buf, payload)
+        buf.seek(0)
+        assert ser.read_envelope(buf) == payload
+
+    def test_envelope_property_random_mutations(self):
+        # property test: any single-byte flip or truncation of an
+        # enveloped stream must raise CorruptIndexError — never load
+        rng = np.random.default_rng(1234)
+        for trial in range(50):
+            payload = rng.integers(0, 256,
+                                   int(rng.integers(1, 512))).astype(
+                                       np.uint8).tobytes()
+            buf = io.BytesIO()
+            ser.write_envelope(buf, payload)
+            raw = bytearray(buf.getvalue())
+            for _ in range(3):
+                mutated = bytearray(raw)
+                pos = int(rng.integers(0, len(mutated)))
+                old = mutated[pos]
+                mutated[pos] = old ^ int(rng.integers(1, 256))
+                with pytest.raises(CorruptIndexError):
+                    ser.read_envelope(io.BytesIO(bytes(mutated)))
+            cut = int(rng.integers(0, len(raw)))
+            with pytest.raises(CorruptIndexError):
+                ser.read_envelope(io.BytesIO(bytes(raw[:cut])))
+
+    def test_envelope_version_gate(self):
+        buf = io.BytesIO()
+        ser.write_envelope(buf, b"abc")
+        raw = bytearray(buf.getvalue())
+        raw[4] = 99  # format version (little-endian u16 low byte)
+        with pytest.raises(CorruptIndexError, match="version"):
+            ser.read_envelope(io.BytesIO(bytes(raw)))
+
+    def test_serialize_write_fault_site(self):
+        with inject("serialize.write"):
+            with pytest.raises(TransientFault):
+                ser.serialize_scalar(None, io.BytesIO(), np.int32(1))
+
+
+# ---------------------------------------------------------------------------
+# corruption round-trips per index type (S4)
+# ---------------------------------------------------------------------------
+
+def _build_small(kind, res):
+    rng = np.random.default_rng(3)
+    db = rng.standard_normal((256, 16), dtype=np.float32)
+    if kind == "ivf_flat":
+        from raft_tpu.neighbors import ivf_flat as m
+        idx = m.build(res, m.IndexParams(n_lists=8, kmeans_n_iters=2), db)
+    elif kind == "ivf_pq":
+        from raft_tpu.neighbors import ivf_pq as m
+        idx = m.build(res, m.IndexParams(n_lists=8, kmeans_n_iters=2,
+                                         pq_dim=4), db)
+    else:
+        from raft_tpu.neighbors import cagra as m
+        idx = m.build(res, m.IndexParams(intermediate_graph_degree=16,
+                                         graph_degree=8), db)
+    return m, idx
+
+
+@pytest.mark.parametrize("kind", ["ivf_flat", "ivf_pq", "cagra"])
+class TestIndexCorruptionRoundTrip:
+    def test_corruption_always_detected(self, kind, res):
+        m, idx = _build_small(kind, res)
+        buf = io.BytesIO()
+        m.serialize(res, buf, idx)
+        raw = buf.getvalue()
+        # clean load still works
+        m.deserialize(res, io.BytesIO(raw))
+        rng = np.random.default_rng(99)
+        for _ in range(8):
+            mutated = bytearray(raw)
+            pos = int(rng.integers(0, len(mutated)))
+            mutated[pos] ^= int(rng.integers(1, 256))
+            with pytest.raises(CorruptIndexError):
+                m.deserialize(res, io.BytesIO(bytes(mutated)))
+        for frac in (0.0, 0.3, 0.9):
+            cut = int(len(raw) * frac)
+            with pytest.raises(CorruptIndexError):
+                m.deserialize(res, io.BytesIO(raw[:cut]))
+
+    def test_save_load_file_overloads(self, kind, res, tmp_path):
+        m, idx = _build_small(kind, res)
+        path = str(tmp_path / f"{kind}.idx")
+        m.save(res, path, idx)
+        m.load(res, path)
+        # no torn tmp files left behind by the atomic protocol
+        assert [f for f in os.listdir(tmp_path) if ".tmp." in f] == []
+
+    def test_save_retries_transient_write_fault(self, kind, res, tmp_path):
+        m, idx = _build_small(kind, res)
+        path = str(tmp_path / f"{kind}_retry.idx")
+        obs.reset()
+        with obs.collecting():
+            with inject("serialize.write", times=1):
+                m.save(res, path, idx)
+        c = obs.snapshot()["counters"]
+        assert c.get(f"resilience.retry.{kind}.save") == 1
+        m.load(res, path)  # payload landed whole despite the fault
+
+    def test_load_missing_file_fails_fast(self, kind, res, tmp_path):
+        m, _ = _build_small(kind, res)
+        obs.reset()
+        with obs.collecting():
+            with pytest.raises(FileNotFoundError):
+                m.load(res, str(tmp_path / "absent.idx"))
+        c = obs.snapshot()["counters"]
+        assert f"resilience.retry.{kind}.load" not in c
+        assert c.get(f"resilience.giveup.{kind}.load") == 1
+
+
+# ---------------------------------------------------------------------------
+# checkpoints
+# ---------------------------------------------------------------------------
+
+class TestCheckpoint:
+    def test_roundtrip_and_manifest_order(self, tmp_path):
+        ck = CheckpointManager(str(tmp_path / "ck"))
+        a = np.arange(12, dtype=np.float32).reshape(3, 4)
+        b = np.array([1, -2, 3], dtype=np.int32)
+        ck.save("one", {"a": a})
+        ck.save("two", {"a": a, "b": b})
+        assert ck.completed == ["one", "two"]
+        got = ck.load("two")
+        np.testing.assert_array_equal(got["a"], a)
+        np.testing.assert_array_equal(got["b"], b)
+        # a re-opened manager sees the same durable state
+        ck2 = CheckpointManager(str(tmp_path / "ck"))
+        assert ck2.has("one") and ck2.has("two")
+
+    def test_clear(self, tmp_path):
+        ck = CheckpointManager(str(tmp_path / "ck"))
+        ck.save("s", {"x": np.zeros(2)})
+        ck.clear()
+        assert not ck.has("s") and ck.completed == []
+
+    def test_corrupt_stage_raises(self, tmp_path):
+        ck = CheckpointManager(str(tmp_path / "ck"))
+        ck.save("s", {"x": np.arange(64, dtype=np.float64)})
+        p = os.path.join(ck.path, "s.ckpt")
+        raw = bytearray(open(p, "rb").read())
+        raw[len(raw) // 2] ^= 0x40
+        open(p, "wb").write(bytes(raw))
+        with pytest.raises(CorruptIndexError):
+            ck.load("s")
+
+    def test_atomic_write_replaces(self, tmp_path):
+        p = str(tmp_path / "f.bin")
+        atomic_write(p, b"v1")
+        atomic_write(p, b"v2-longer")
+        assert open(p, "rb").read() == b"v2-longer"
+        assert [f for f in os.listdir(tmp_path) if ".tmp." in f] == []
+
+    def test_save_fault_site(self, tmp_path):
+        ck = CheckpointManager(str(tmp_path / "ck"))
+        with inject("checkpoint.save"):
+            with pytest.raises(TransientFault):
+                ck.save("s", {"x": np.zeros(1)})
+        assert not ck.has("s")
+
+
+# ---------------------------------------------------------------------------
+# checkpointed builds: interruption + resume (S3 + acceptance)
+# ---------------------------------------------------------------------------
+
+class TestInterruptAndResume:
+    def test_ivf_pq_injected_interrupt_then_resume(self, fresh_res,
+                                                   tmp_path):
+        from raft_tpu.neighbors import ivf_pq
+        rng = np.random.default_rng(0)
+        db = rng.standard_normal((512, 32), dtype=np.float32)
+        p = ivf_pq.IndexParams(n_lists=16, kmeans_n_iters=2, pq_dim=8)
+        ref = ivf_pq.build(fresh_res(), p, db)
+
+        ckdir = str(tmp_path / "pq")
+        # kill the build at its first sync point — AFTER the kmeans
+        # stage checkpoint is durable (save happens before synchronize)
+        with inject("interruptible.synchronize", times=1,
+                    exc=InterruptedException):
+            with pytest.raises(InterruptedException):
+                ivf_pq.build(fresh_res(), p, db, checkpoint=ckdir)
+        ck = CheckpointManager(ckdir)
+        assert ck.completed == ["kmeans"]
+
+        obs.reset()
+        with obs.collecting():
+            resumed = ivf_pq.build(fresh_res(), p, db, checkpoint=ckdir,
+                                   resume=True)
+        c = obs.snapshot()["counters"]
+        # completed stage loaded once, NOT recomputed; only the
+        # remaining stage checkpointed
+        assert c.get("resilience.checkpoint.load") == 1
+        assert c.get("resilience.checkpoint.save") == 1
+        for leaf in ("centers", "codebooks", "list_codes", "list_indices",
+                     "list_sizes"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ref, leaf)),
+                np.asarray(getattr(resumed, leaf)), err_msg=leaf)
+
+    def test_cagra_thread_cancel_then_resume(self, fresh_res, tmp_path):
+        from raft_tpu.neighbors import cagra
+        rng = np.random.default_rng(0)
+        db = rng.standard_normal((256, 16), dtype=np.float32)
+        p = cagra.IndexParams(intermediate_graph_degree=16, graph_degree=8)
+        ref = cagra.build(fresh_res(), p, db)
+
+        ckdir = str(tmp_path / "cg")
+        box = {}
+        started, go = threading.Event(), threading.Event()
+
+        def worker():
+            box["tid"] = threading.get_ident()
+            started.set()
+            go.wait()
+            try:
+                cagra.build(fresh_res(), p, db, checkpoint=ckdir)
+                box["err"] = None
+            except InterruptedException as e:
+                box["err"] = e
+
+        t = threading.Thread(target=worker)
+        t.start()
+        started.wait()
+        # cancel from THIS thread before the build reaches its first
+        # sync point: deterministic interruption at that point
+        interruptible.get_token(box["tid"]).cancel()
+        go.set()
+        t.join(60)
+        assert isinstance(box["err"], InterruptedException)
+        ck = CheckpointManager(ckdir)
+        assert ck.completed == ["knn_graph"]
+
+        obs.reset()
+        with obs.collecting():
+            resumed = cagra.build(fresh_res(), p, db, checkpoint=ckdir,
+                                  resume=True)
+        timers = obs.snapshot()["timers"]
+        # the kNN stage was NOT redone (its stage timer never ran);
+        # pruning was
+        assert "cagra.build.knn_exact" not in timers
+        assert "cagra.build.prune" in timers
+        np.testing.assert_array_equal(np.asarray(ref.graph),
+                                      np.asarray(resumed.graph))
+
+    def test_resume_from_complete_checkpoint_is_bit_identical(
+            self, fresh_res, tmp_path):
+        from raft_tpu.neighbors import ivf_pq
+        rng = np.random.default_rng(0)
+        db = rng.standard_normal((512, 32), dtype=np.float32)
+        p = ivf_pq.IndexParams(n_lists=16, kmeans_n_iters=2, pq_dim=8)
+        ckdir = str(tmp_path / "pq_full")
+        full = ivf_pq.build(fresh_res(), p, db, checkpoint=ckdir)
+        resumed = ivf_pq.build(fresh_res(), p, db, checkpoint=ckdir,
+                               resume=True)
+        np.testing.assert_array_equal(np.asarray(full.list_codes),
+                                      np.asarray(resumed.list_codes))
+        np.testing.assert_array_equal(np.asarray(full.codebooks),
+                                      np.asarray(resumed.codebooks))
+
+
+# ---------------------------------------------------------------------------
+# distributed: retry-recovery acceptance + degraded search
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def session(mesh8):
+    from raft_tpu.comms import CommsSession
+    s = CommsSession(mesh=mesh8, axis_name="data").init()
+    yield s
+    s.destroy()
+
+
+@pytest.fixture
+def handle(session):
+    return session.worker_handle(seed=0)
+
+
+@pytest.fixture
+def dist_index(handle):
+    from raft_tpu.distributed import ann
+    from raft_tpu.neighbors import ivf_pq
+    rng = np.random.default_rng(0)
+    db = rng.standard_normal((1024, 32), dtype=np.float32)
+    q = rng.standard_normal((16, 32), dtype=np.float32)
+    p = ivf_pq.IndexParams(n_lists=8, kmeans_n_iters=2, pq_dim=8)
+    return ann, ivf_pq, ann.build(handle, p, db), q
+
+
+class TestDistributedResilience:
+    def test_transient_search_fault_retried_identically(self, handle,
+                                                        dist_index):
+        ann, ivf_pq, idx, q = dist_index
+        sp = ivf_pq.SearchParams(n_probes=4)
+        d0, i0 = ann.search(handle, sp, idx, q, 5)
+        obs.reset()
+        with obs.collecting():
+            with inject("distributed.ann.search", times=1,
+                        exc=TransientFault):
+                d1, i1 = ann.search(handle, sp, idx, q, 5)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_allclose(np.asarray(d0), np.asarray(d1))
+        c = obs.snapshot()["counters"]
+        assert c.get(
+            "resilience.fault.injected.distributed.ann.search") == 1
+        assert c.get("resilience.retry.distributed.ann.search") == 1
+        assert "resilience.giveup.distributed.ann.search" not in c
+
+    def test_degraded_search_masks_failed_shards(self, handle, dist_index):
+        ann, ivf_pq, idx, q = dist_index
+        sp = ivf_pq.SearchParams(n_probes=4)
+        per = 1024 // 8
+        with inject() as plan:
+            plan.fail_shards(1)
+            d, i, status = ann.search(handle, sp, idx, q, 5,
+                                      return_status=True)
+        assert list(np.asarray(status)) == [1, 0, 1, 1, 1, 1, 1, 1]
+        ids = np.asarray(i)
+        assert not ((ids >= per) & (ids < 2 * per)).any()
+
+    def test_degraded_search_explicit_flags(self, handle, dist_index):
+        ann, ivf_pq, idx, q = dist_index
+        sp = ivf_pq.SearchParams(n_probes=4)
+        d, i, status = ann.search(handle, sp, idx, q, 5,
+                                  failed_shards=[0, 7],
+                                  return_status=True)
+        assert list(np.asarray(status)) == [0, 1, 1, 1, 1, 1, 1, 0]
+
+    def test_all_shards_failed_is_fully_padded(self, handle, dist_index):
+        ann, ivf_pq, idx, q = dist_index
+        sp = ivf_pq.SearchParams(n_probes=4)
+        d, i, status = ann.search(handle, sp, idx, q, 5,
+                                  failed_shards=range(8),
+                                  return_status=True)
+        assert (np.asarray(i) == -1).all()
+        assert (np.asarray(status) == 0).all()
+
+    def test_search_deadline_gives_up(self, handle, dist_index):
+        ann, ivf_pq, idx, q = dist_index
+        sp = ivf_pq.SearchParams(n_probes=4)
+        with pytest.raises(DeadlineExceededError):
+            ann.search(handle, sp, idx, q, 5, deadline=Deadline(0.0))
+
+    def test_build_entry_retried(self, session):
+        from raft_tpu.distributed import ann
+        from raft_tpu.neighbors import ivf_pq
+        handle = session.worker_handle(seed=0)
+        rng = np.random.default_rng(1)
+        db = rng.standard_normal((512, 16), dtype=np.float32)
+        p = ivf_pq.IndexParams(n_lists=8, kmeans_n_iters=2, pq_dim=4)
+        obs.reset()
+        with obs.collecting():
+            with inject("distributed.ann.build", times=1,
+                        exc=TransientFault):
+                idx = ann.build(handle, p, db)
+        assert idx.size == 512
+        c = obs.snapshot()["counters"]
+        assert c.get("resilience.retry.distributed.ann.build") == 1
+
+
+# ---------------------------------------------------------------------------
+# zero-overhead contract
+# ---------------------------------------------------------------------------
+
+class TestZeroOverhead:
+    def test_no_plan_no_collection_records_nothing(self, res):
+        from raft_tpu.neighbors import ivf_flat
+        rng = np.random.default_rng(0)
+        db = rng.standard_normal((256, 16), dtype=np.float32)
+        idx = ivf_flat.build(res, ivf_flat.IndexParams(n_lists=8,
+                                                       kmeans_n_iters=2),
+                             db)
+        obs.reset()
+        assert not obs.enabled() and not faults.is_active()
+        ivf_flat.search(res, ivf_flat.SearchParams(n_probes=4), idx,
+                        db[:4], 5)
+        snap = obs.snapshot()
+        assert snap["counters"] == {} and snap["timers"] == {}
