@@ -115,35 +115,119 @@ def load_search_fn(stream: BinaryIO) -> Callable:
     return g
 
 
-def export_ivf_pq_search(res, index, n_probes: int, k: int,
-                         batch: int) -> io.BytesIO:
-    """Export the flagship IVF-PQ recon search at fixed (batch, k,
-    n_probes) into a self-contained artifact (reference analogue:
-    serialized index + the prebuilt search instantiation)."""
+def export_ivf_pq_search(res, index, n_probes: int, k: int, batch: int,
+                         *, scan_mode: str = "recon") -> io.BytesIO:
+    """Export the flagship IVF-PQ search at fixed (batch, k, n_probes)
+    into a self-contained artifact (reference analogue: serialized index
+    + the prebuilt search instantiation).
+
+    ``scan_mode`` picks which index representation rides in the
+    artifact:
+
+    - ``"recon"`` bakes the bf16 reconstruction cache and exports the
+      recon scan (2 bytes/dim/row in the artifact — the fastest live
+      formulation, also the largest file).
+    - ``"codes"`` / ``"lut"`` bake only the bit-packed PQ codes +
+      codebooks and export the portable LUT formulation over them
+      (~pq_bits/8 bytes per subspace per row — the compact deployment
+      shape).  The grouped Pallas code-scan kernel itself is a
+      runtime-dispatch path and is not serialized; the exported code
+      program computes the same quantized distances.
+    """
     from raft_tpu.neighbors import ivf_pq
 
-    expects(index.list_recon is not None,
-            "aot: index must carry the reconstruction cache")
+    expects(scan_mode in ("recon", "codes", "lut"),
+            "aot: scan_mode must be 'recon', 'codes' or 'lut'")
     metric = index.metric
-    if index.list_recon_sq is None:
-        index.list_recon_sq = ivf_pq._recon_sq(index.list_recon)
 
-    def fn(centers, list_recon, list_recon_sq, list_indices, rotation,
-           queries):
-        # the precomputed norms ride in the artifact — without them the
-        # exported program would recompute a full pass over the recon
-        # cache per batch (they are runtime inputs, not constants)
-        return ivf_pq._search_impl_recon(
-            centers, list_recon, list_indices, rotation, queries,
-            k=k, n_probes=n_probes, metric=metric,
-            list_recon_sq=list_recon_sq)
+    if scan_mode == "recon":
+        expects(index.list_recon is not None,
+                "aot: index must carry the reconstruction cache")
+        if index.list_recon_sq is None:
+            index.list_recon_sq = ivf_pq._recon_sq(index.list_recon)
+
+        def fn(centers, list_recon, list_recon_sq, list_indices, rotation,
+               queries):
+            # the precomputed norms ride in the artifact — without them
+            # the exported program would recompute a full pass over the
+            # recon cache per batch (they are runtime inputs, not
+            # constants)
+            return ivf_pq._search_impl_recon(
+                centers, list_recon, list_indices, rotation, queries,
+                k=k, n_probes=n_probes, metric=metric,
+                list_recon_sq=list_recon_sq)
+
+        arrays = (index.centers, index.list_recon, index.list_recon_sq,
+                  index.list_indices, index.rotation)
+    else:
+        codebook_kind = index.codebook_kind
+        pq_bits = index.pq_bits
+
+        def fn(centers, codebooks, list_codes, list_indices, rotation,
+               queries):
+            return ivf_pq._search_impl(
+                centers, codebooks, list_codes, list_indices, rotation,
+                queries, k=k, n_probes=n_probes, metric=metric,
+                codebook_kind=codebook_kind, lut_dtype=jax.numpy.float32,
+                pq_bits=pq_bits)
+
+        arrays = (index.centers, index.codebooks, index.list_codes,
+                  index.list_indices, index.rotation)
 
     example_q = jax.ShapeDtypeStruct((batch, index.dim),
                                      index.centers.dtype)
     buf = io.BytesIO()
-    save_search_fn(buf, fn,
-                   (index.centers, index.list_recon, index.list_recon_sq,
-                    index.list_indices, index.rotation), example_q)
+    save_search_fn(buf, fn, arrays, example_q)
+    buf.seek(0)
+    return buf
+
+
+def export_ivf_flat_search(res, index, n_probes: int, k: int,
+                           batch: int) -> io.BytesIO:
+    """Export the IVF-Flat search at fixed (batch, k, n_probes): raw
+    list vectors + exported scan program in one artifact (reference
+    analogue: the per-(T, IdxT, veclen) interleaved-scan instantiations
+    in cpp/src/neighbors/ivfflat_*)."""
+    from raft_tpu.neighbors import ivf_flat
+
+    metric = index.metric
+
+    def fn(centers, list_data, list_indices, queries):
+        return ivf_flat._search_impl(centers, list_data, list_indices,
+                                     queries, k=k, n_probes=n_probes,
+                                     metric=metric)
+
+    example_q = jax.ShapeDtypeStruct((batch, index.dim),
+                                     index.centers.dtype)
+    buf = io.BytesIO()
+    save_search_fn(buf, fn, (index.centers, index.list_data,
+                             index.list_indices), example_q)
+    buf.seek(0)
+    return buf
+
+
+def export_brute_force_knn(res, database, k: int, batch: int, *,
+                           metric=None, metric_arg: float = 2.0
+                           ) -> io.BytesIO:
+    """Export exact brute-force kNN over a fixed database at (batch, k):
+    the database rides in the artifact, queries stay the runtime input
+    (reference analogue: the brute_force_knn instantiation units)."""
+    from raft_tpu.distance.types import DistanceType
+    from raft_tpu.neighbors import brute_force
+
+    if metric is None:
+        metric = DistanceType.L2Unexpanded
+    database = jax.numpy.asarray(database)
+    tile = min(brute_force._TILE_N, database.shape[0])
+
+    def fn(db, queries):
+        return brute_force._knn_impl(db, queries, k, metric, metric_arg,
+                                     tile)
+
+    example_q = jax.ShapeDtypeStruct((batch, database.shape[1]),
+                                     database.dtype)
+    buf = io.BytesIO()
+    save_search_fn(buf, fn, (database,), example_q)
     buf.seek(0)
     return buf
 

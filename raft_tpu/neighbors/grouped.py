@@ -226,6 +226,24 @@ def scatter_packed(vals, ids, slot_pairs, P, select_min):
     return outd, outi
 
 
+def scan_traffic(rot: int, pq_dim: int = 0, pq_bits: int = 0) -> dict:
+    """Per-candidate-row HBM bytes each grouped scan mode streams.
+
+    Every mode reads the (int32) candidate-id row and an (f32) cached row
+    norm per candidate; what differs is the data payload — bf16
+    reconstructions (2 B/dim), int8 reconstructions (1 B/dim), or
+    lane-major packed codes (int32 words covering pq_dim*pq_bits bits).
+    Query/center/codebook traffic is per GROUP (128 pairs), not per row,
+    and amortizes out at scan scale; this model is what the round-6
+    decomposition profile and the docs' memory-traffic table report."""
+    base = 4 + 4                      # id row (int32) + row norm (f32)
+    out = {"recon": 2 * rot + base, "recon8": rot + base}
+    if pq_dim and pq_bits:
+        w_bytes = -(-pq_dim * pq_bits // 8)
+        out["codes"] = 4 * -(-w_bytes // 4) + base
+    return out
+
+
 def block_size(n_groups: int, *per_group_bytes: int,
                budget: int = 96 << 20, quantum: int = 16) -> int:
     """Groups per scan step such that the listed per-group transients stay
@@ -237,7 +255,7 @@ def block_size(n_groups: int, *per_group_bytes: int,
 
 
 def scan_and_scatter(group_list, slot_pairs, P, cap, k, select_min, block,
-                     select_k_fn, distance_block):
+                     select_k_fn, distance_block, kt=0):
     """Shared scan driver: for each block of groups, compute distances via
     ``distance_block(gl, slot) -> ((B, GROUP, cap) masked distances,
     (B, cap) candidate ids)`` and take each pair-row's local top-kt.
@@ -253,7 +271,9 @@ def scan_and_scatter(group_list, slot_pairs, P, cap, k, select_min, block,
     final scatter stays idempotent."""
     n_groups = group_list.shape[0]
     worst = jnp.inf if select_min else -jnp.inf
-    kt = min(k, cap)
+    # kt (SearchParams.per_probe_topk) narrows the per-pair keep-set below
+    # k; 0 keeps the exact-merge default
+    kt = min(kt or k, cap)
 
     n_blocks = -(-n_groups // block)
     block_starts = jnp.minimum(jnp.arange(n_blocks) * block,

@@ -106,7 +106,41 @@ class SearchParams:
     # test_recon_path_matches_lut_path); False forces the LUT formulation.
     # Indexes built with IndexParams.cache_reconstructions=False carry no
     # cache and use the LUT path automatically.
+    # DEPRECATED in favour of scan_mode (kept for compat: when set it
+    # overrides scan_mode with "recon"/"lut").
     use_reconstruction: Optional[bool] = None
+    # Which list-scan formulation serves the query batch:
+    #   "recon"  — bf16 reconstruction cache (2 B/dim/row HBM traffic);
+    #   "codes"  — compact-code Pallas kernel: bit-packed codes stream
+    #              from HBM (~pq_bits/8 B/subspace/row, ~4x less than
+    #              recon at the bench shape) and are decoded in-register
+    #              against the VMEM-resident codebook table (the TPU
+    #              analogue of the reference's shared-memory LUT scan,
+    #              ivf_pq_search.cuh:611); falls back to "lut" off-TPU or
+    #              for unsupported shapes (see pq_code_scan_pallas);
+    #   "recon8" — int8-quantized recon cache with per-list scale
+    #              (1 B/dim/row, in-register dequantization);
+    #   "lut"    — the XLA take_along_axis LUT formulation (traceable,
+    #              memory-lean; the AOT export path);
+    #   "auto"   — "recon" when the index carries the cache, else "codes"
+    #              when the kernel supports the index's static config,
+    #              else "lut".
+    scan_mode: str = "auto"
+    # Per-(query, probe) candidates kept by the grouped scans before the
+    # final merge (the kernel's kt).  0 -> k.  The grouped kernels are
+    # extraction-bound (~3.3 us per kept candidate per group, flat in list
+    # size — PERFORMANCE.md round 5), so at refine-heavy operating points
+    # a small value (e.g. 4 with refine_ratio>=2) trades a little
+    # pre-refine recall for a near-linear scan speedup.  The probe-order
+    # LUT formulation (and therefore any off-TPU fallback to it) has no
+    # per-pair keep-set and ignores this knob — the fallback errs toward
+    # MORE candidates, never fewer.
+    per_probe_topk: int = 0
+    # Opt-in packed-key top-kt extraction inside the codes/recon8 kernels:
+    # one cross-lane reduce per kept candidate instead of three, at the
+    # cost of truncating ~log2(capacity) distance mantissa bits (~2^-13
+    # relative at bench shapes; ordering-only effect, far below PQ noise).
+    packed_extract: bool = False
 
 
 @jax.tree_util.register_pytree_node_class
@@ -145,6 +179,23 @@ class Index:
     # over the recon cache out of every search call (it measurably fused
     # into the probe loop when computed in-call).
     list_recon_sq: Optional[jax.Array] = None
+    # Derived search-time cache for scan_mode="codes": the bit-packed
+    # codes re-laid out lane-major as (n_lists, Wi, capacity) int32 words
+    # (pq_code_scan_pallas.pack_code_lanes) so the Pallas kernel streams
+    # ~pq_dim*pq_bits/8 bytes/row, plus the per-row squared norms of the
+    # bf16 reconstructions (n_lists, capacity) f32 the distance
+    # decomposition needs.  Like list_recon these are derived from the
+    # codes (never serialized) and attach lazily on first codes-mode
+    # search.
+    list_code_lanes: Optional[jax.Array] = None
+    list_code_rsq: Optional[jax.Array] = None
+    # Derived search-time cache for scan_mode="recon8": the recon cache
+    # quantized to int8 with ONE f32 scale per list (lanes zero-padded to
+    # a 128 multiple for the kernel), plus squared norms of the
+    # DEQUANTIZED rows so kernel distances are self-consistent.
+    list_recon_i8: Optional[jax.Array] = None
+    list_recon_scale: Optional[jax.Array] = None
+    list_recon_i8_sq: Optional[jax.Array] = None
     # explicit because list_codes is bit-packed (its trailing axis is the
     # packed byte width, not pq_dim); 0 -> equal to the code width (the
     # pq_bits=8 layout where packing is the identity)
@@ -194,15 +245,21 @@ class Index:
     def tree_flatten(self):
         leaves = (self.centers, self.codebooks, self.list_codes,
                   self.list_indices, self.list_sizes, self.rotation,
-                  self.list_recon, self.list_recon_sq)
+                  self.list_recon, self.list_recon_sq,
+                  self.list_code_lanes, self.list_code_rsq,
+                  self.list_recon_i8, self.list_recon_scale,
+                  self.list_recon_i8_sq)
         return leaves, (self.metric, self.codebook_kind, self.pq_bits,
                         self.pq_dim_)
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
         return cls(*leaves[:6], list_recon=leaves[6],
-                   list_recon_sq=leaves[7], metric=aux[0],
-                   codebook_kind=aux[1], pq_bits=aux[2], pq_dim_=aux[3])
+                   list_recon_sq=leaves[7], list_code_lanes=leaves[8],
+                   list_code_rsq=leaves[9], list_recon_i8=leaves[10],
+                   list_recon_scale=leaves[11], list_recon_i8_sq=leaves[12],
+                   metric=aux[0], codebook_kind=aux[1], pq_bits=aux[2],
+                   pq_dim_=aux[3])
 
 
 # ---------------------------------------------------------------------------
@@ -700,6 +757,81 @@ def _with_recon(res, index: Index) -> Index:
     return index
 
 
+@functools.partial(jax.jit, static_argnames=("pq_dim", "pq_bits"))
+def _rsq_from_codes(codebooks, list_codes, pq_dim, pq_bits):
+    """Per-row ||recon||^2 (n_lists, cap) f32 straight from the packed
+    codes — Σ_j ||cb_bf16[j, code_j]||^2.  Subspaces occupy disjoint
+    coordinates of the concatenated reconstruction, so the per-subspace
+    norms sum exactly; squaring the *bf16-rounded* codebook keeps the
+    value identical to _recon_sq(list_recon) without materializing the
+    (n_lists, cap, rot_dim) cache (per-subspace codebooks only)."""
+    L, cap, W = list_codes.shape
+    mask = (1 << pq_bits) - 1
+    cb_sq = jnp.sum(
+        codebooks.astype(jnp.bfloat16).astype(jnp.float32) ** 2,
+        axis=-1)                                         # (pq_dim, book)
+
+    def step(acc, j):
+        bitpos = j * pq_bits
+        b0 = bitpos // 8
+        shift = bitpos % 8
+        lo = jnp.take(list_codes, b0, axis=-1).astype(jnp.int32)
+        hi = jnp.take(list_codes, jnp.minimum(b0 + 1, W - 1),
+                      axis=-1).astype(jnp.int32)
+        cj = ((lo | (hi << 8)) >> shift) & mask          # (L, cap)
+        return acc + cb_sq[j][cj], None
+
+    acc, _ = jax.lax.scan(step, jnp.zeros((L, cap), jnp.float32),
+                          jnp.arange(pq_dim))
+    return acc
+
+
+def _with_code_lanes(index: Index) -> Index:
+    """Attach the lane-major packed-code cache for the compact-code
+    kernel (plus the row norms its distance decomposition needs)."""
+    from raft_tpu.ops import pq_code_scan_pallas as pcs
+    index.list_code_lanes = pcs.pack_code_lanes(index.list_codes)
+    if index.list_recon_sq is not None:
+        index.list_code_rsq = index.list_recon_sq
+    else:
+        index.list_code_rsq = _rsq_from_codes(
+            index.codebooks, index.list_codes, index.pq_dim, index.pq_bits)
+    return index
+
+
+@functools.partial(jax.jit, static_argnames=("rot_pad",))
+def _quantize_recon(list_recon, rot_pad):
+    """bf16 recon cache -> (int8 codes, per-list f32 scale, dequantized
+    row norms).  Residual magnitudes cluster within a list, so one
+    symmetric scale per list (max|recon|/127) keeps quantization error
+    ~1/256 of the list's residual range — well under PQ noise (measured:
+    recall moves <0.3% at bench shapes, PERFORMANCE.md round 6)."""
+    r = list_recon.astype(jnp.float32)                   # (L, cap, rot)
+    L, cap, rot = r.shape
+    maxabs = jnp.max(jnp.abs(r), axis=(1, 2))            # (L,)
+    scale = jnp.where(maxabs > 0, maxabs / 127.0, 1.0)
+    q = jnp.clip(jnp.round(r / scale[:, None, None]), -127, 127)
+    rsq8 = scale[:, None] ** 2 * jnp.sum(q * q, axis=-1)  # (L, cap) f32
+    qi = jnp.pad(q.astype(jnp.int8), ((0, 0), (0, 0), (0, rot_pad - rot)))
+    return qi, scale, rsq8
+
+
+def _with_recon8(index: Index) -> Index:
+    """Attach the int8-quantized recon cache (derives the bf16 recon on
+    the fly when the index carries none — only the int8 copy is kept)."""
+    recon = index.list_recon
+    if recon is None:
+        recon = _decode_lists(index.centers, index.codebooks,
+                              index.list_codes, index.codebook_kind,
+                              index.pq_dim, index.pq_bits)
+    rot_pad = _round_up(index.rot_dim, 128)
+    qi, scale, rsq8 = _quantize_recon(recon, rot_pad)
+    index.list_recon_i8 = qi
+    index.list_recon_scale = scale
+    index.list_recon_i8_sq = rsq8
+    return index
+
+
 @functools.partial(jax.jit, static_argnames=("k", "n_probes", "metric"))
 def _search_impl_recon(centers, list_recon, list_indices, rotation, queries,
                        k, n_probes, metric, probes=None, list_recon_sq=None):
@@ -791,11 +923,11 @@ def _select_clusters(centers, rotation, queries, n_probes, metric,
 
 @functools.partial(jax.jit, static_argnames=("k", "metric", "n_groups",
                                              "block", "use_pallas",
-                                             "pallas_interpret"))
+                                             "pallas_interpret", "kt"))
 def _search_impl_recon_grouped(centers, list_recon, list_recon_sq,
                                list_indices, rotation, queries, probes, k,
                                metric, n_groups, block, use_pallas=False,
-                               pallas_interpret=False):
+                               pallas_interpret=False, kt=0):
     """List-centric recon scan over fixed-size pair groups.
 
     See :mod:`raft_tpu.neighbors.grouped` for the design (and the measured
@@ -820,7 +952,10 @@ def _search_impl_recon_grouped(centers, list_recon, list_recon_sq,
 
     group_list, slot_pairs = grouped.build_groups(probes, n_lists, n_groups)
 
-    kt = min(k, cap)
+    # kt < k (SearchParams.per_probe_topk) narrows the per-pair keep-set:
+    # the extraction-bound kernel speeds up near-linearly, at the cost of
+    # candidates a single probe contributed beyond rank kt
+    kt = min(kt or k, cap)
     if use_pallas:
         from raft_tpu.ops import pq_group_scan_pallas as pqp
 
@@ -862,9 +997,117 @@ def _search_impl_recon_grouped(centers, list_recon, list_recon_sq,
 
     outd, outi = grouped.scan_and_scatter(
         group_list, slot_pairs, P, cap, k, not ip_metric, block,
-        select_k, distance_block)
+        select_k, distance_block, kt=kt)
     return grouped.finalize_topk(
         outd, outi, nq, k, not ip_metric,
+        metric in (DistanceType.L2SqrtExpanded,
+                   DistanceType.L2SqrtUnexpanded), select_k)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "kt", "metric", "n_groups",
+                                             "pq_bits", "packed",
+                                             "pallas_interpret"))
+def _search_impl_codes_grouped(centers, codebooks, list_code_lanes,
+                               list_code_rsq, list_indices, rotation,
+                               queries, probes, k, kt, metric, n_groups,
+                               pq_bits, packed=False,
+                               pallas_interpret=False):
+    """Grouped COMPACT-CODE scan: the Pallas kernel streams lane-major
+    packed codes (~pq_bits/8 bytes per subspace per row — the recon path
+    reads 2*pq_len) and decodes them in-register against the
+    VMEM-resident codebook table via per-subspace one-hot MXU
+    contractions (pq_code_scan_pallas).  Distances equal the recon path's
+    bit-for-bit: the kernel's bf16 codebook cast reproduces the bf16
+    cache values.  L2-family metrics + per-subspace codebooks only —
+    search() gates on pq_code_scan_pallas.supported_codes and falls back
+    to the LUT formulation otherwise."""
+    from raft_tpu.neighbors import grouped
+    from raft_tpu.ops import pq_code_scan_pallas as pcs
+
+    nq, n_probes = probes.shape
+    P = nq * n_probes
+    n_lists = centers.shape[0]
+    cap = list_code_lanes.shape[2]
+    qrot = queries.astype(jnp.float32) @ rotation
+    cf = centers.astype(jnp.float32)
+
+    group_list, slot_pairs = grouped.build_groups(probes, n_lists, n_groups)
+    kt = min(kt or k, cap)
+    vals, ti = pcs.grouped_code_scan(
+        group_list, slot_pairs, qrot, cf, list_code_lanes, codebooks,
+        list_code_rsq, list_indices, kt, n_probes, pq_bits, packed=packed,
+        interpret=pallas_interpret)
+    outd, outi = grouped.scatter_packed(vals, ti, slot_pairs, P, True)
+    return grouped.finalize_topk(
+        outd, outi, nq, k, True,
+        metric in (DistanceType.L2SqrtExpanded,
+                   DistanceType.L2SqrtUnexpanded), select_k)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "kt", "metric", "n_groups",
+                                             "block", "use_pallas", "packed",
+                                             "pallas_interpret"))
+def _search_impl_recon8_grouped(centers, list_recon_i8, list_recon_scale,
+                                list_recon_i8_sq, list_indices, rotation,
+                                queries, probes, k, kt, metric, n_groups,
+                                block, use_pallas=False, packed=False,
+                                pallas_interpret=False):
+    """Grouped scan over the int8-quantized recon cache (1 byte/dim/row):
+    the Pallas kernel dequantizes in-register with the per-list scale —
+    ``d = ||sub||^2 + rsq8 - 2*scale*(sub . q8)``.  The XLA fallback
+    computes the identical quantized distance for CPU / unsupported
+    shapes.  L2-family metrics only (search() gates)."""
+    from raft_tpu.neighbors import grouped
+
+    nq, n_probes = probes.shape
+    P = nq * n_probes
+    n_lists = centers.shape[0]
+    _, cap, rot_pad = list_recon_i8.shape
+    rot = rotation.shape[1]
+
+    qrot = queries.astype(jnp.float32) @ rotation
+    cf = centers.astype(jnp.float32)
+
+    group_list, slot_pairs = grouped.build_groups(probes, n_lists, n_groups)
+    kt = min(kt or k, cap)
+    if use_pallas:
+        from raft_tpu.ops import pq_code_scan_pallas as pcs
+
+        vals, ti = pcs.grouped_recon8_scan(
+            group_list, slot_pairs, qrot, cf, list_recon_i8,
+            list_recon_scale, list_recon_i8_sq, list_indices, kt, n_probes,
+            packed=packed, interpret=pallas_interpret)
+        outd, outi = grouped.scatter_packed(vals, ti, slot_pairs, P, True)
+        return grouped.finalize_topk(
+            outd, outi, nq, k, True,
+            metric in (DistanceType.L2SqrtExpanded,
+                       DistanceType.L2SqrtUnexpanded), select_k)
+
+    # lane padding: the int8 cache's zero rot->rot_pad pad contributes
+    # nothing as long as the query side is zero-padded identically
+    qrot_p = jnp.pad(qrot, ((0, 0), (0, rot_pad - rot)))
+    cf_p = jnp.pad(cf, ((0, 0), (0, rot_pad - rot)))
+
+    def distance_block(gl, slot):
+        qid = jnp.where(slot < P, slot // n_probes, 0)
+        qv = qrot_p[qid]                                 # (B, G, rot_pad)
+        data = list_recon_i8[gl].astype(jnp.bfloat16)    # (B, cap, rot_pad)
+        ids = list_indices[gl]
+        sc = list_recon_scale[gl]                        # (B,)
+        rsq = list_recon_i8_sq[gl]                       # (B, cap)
+        sub = qv - cf_p[gl][:, None, :]
+        ip = jnp.einsum("bqr,bcr->bqc", sub.astype(jnp.bfloat16), data,
+                        preferred_element_type=jnp.float32)
+        d = jnp.maximum(jnp.sum(sub * sub, axis=-1)[:, :, None]
+                        + rsq[:, None, :]
+                        - 2.0 * sc[:, None, None] * ip, 0.0)
+        return jnp.where(ids[:, None, :] >= 0, d, jnp.inf), ids
+
+    outd, outi = grouped.scan_and_scatter(
+        group_list, slot_pairs, P, cap, k, True, block,
+        select_k, distance_block, kt=kt)
+    return grouped.finalize_topk(
+        outd, outi, nq, k, True,
         metric in (DistanceType.L2SqrtExpanded,
                    DistanceType.L2SqrtUnexpanded), select_k)
 
@@ -958,16 +1201,38 @@ def _search_impl(centers, codebooks, list_codes, list_indices, rotation,
                    DistanceType.L2SqrtUnexpanded), select_k)
 
 
+_SCAN_MODES = ("auto", "codes", "recon", "recon8", "lut")
+
+_L2_METRICS = (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
+               DistanceType.L2Unexpanded, DistanceType.L2SqrtUnexpanded)
+
+
+def _codes_mode_eligible(index: Index) -> bool:
+    """Static preconditions of the compact-code kernel (the shape/VMEM
+    gate runs later, per batch): L2-family metric, per-subspace
+    codebooks, and pq_bits that divide an int32 word so no code field
+    straddles words."""
+    return (index.metric in _L2_METRICS
+            and index.codebook_kind == CodebookKind.PER_SUBSPACE
+            and index.pq_bits in (4, 8))
+
+
 @auto_convert_output
 def search(res, params: SearchParams, index: Index, queries, k: int
            ) -> Tuple[jax.Array, jax.Array]:
     """Search (reference: ivf_pq.cuh:342).  Returns (distances, indices).
 
+    ``params.scan_mode`` picks the list-scan formulation (see
+    :class:`SearchParams`); "codes" and "recon8" silently fall back to
+    the LUT / XLA formulations off-TPU or for unsupported shapes, so the
+    same call works on every backend.
+
     .. note:: the first search may mutate ``index`` in place, lazily
        attaching derived caches (``list_recon``/``list_recon_sq``, the
-       group count and id-exactness caches); ``list_recon_sq`` is a
-       pytree leaf, so the registered pytree structure can change after
-       the first search (one retrace for jitted closures over the index).
+       codes-lane and int8 caches of their scan modes, the group count
+       and id-exactness caches); the derived caches are pytree leaves, so
+       the registered pytree structure can change after the first search
+       (one retrace for jitted closures over the index).
     """
     with named_range("ivf_pq::search"):
         queries = ensure_array(queries, "queries")
@@ -976,67 +1241,133 @@ def search(res, params: SearchParams, index: Index, queries, k: int
         n_probes = min(params.n_probes, index.n_lists)
         coarse_rt = getattr(params, "coarse_recall_target", 0.95)
         exact_coarse = getattr(params, "exact_coarse", False)
-        use_recon = (params.use_reconstruction
-                     if params.use_reconstruction is not None
-                     else index.list_recon is not None)
-        if use_recon:
+        mode = getattr(params, "scan_mode", "auto") or "auto"
+        if getattr(params, "use_reconstruction", None) is not None:
+            # compat override (pre-scan_mode API)
+            mode = "recon" if params.use_reconstruction else "lut"
+        expects(mode in _SCAN_MODES,
+                f"ivf_pq.search: unknown scan_mode {mode!r} "
+                f"(one of {_SCAN_MODES})")
+        kt_req = int(getattr(params, "per_probe_topk", 0) or 0)
+        packed = bool(getattr(params, "packed_extract", False))
+
+        if mode == "auto":
+            if index.list_recon is not None:
+                mode = "recon"
+            elif _codes_mode_eligible(index):
+                mode = "codes"
+            else:
+                mode = "lut"
+        if mode in ("codes", "recon8") and index.metric not in _L2_METRICS:
+            mode = "lut" if index.list_recon is None else "recon"
+
+        tracing = (isinstance(queries, jax.core.Tracer)
+                   or isinstance(index.centers, jax.core.Tracer))
+        if tracing:
+            # queries or the Index pytree traced by an outer jit/vmap: the
+            # grouped dispatches need a host-side group count — use the
+            # fully traceable probe-order formulations instead (the LUT
+            # scan computes the same quantized distance as the codes
+            # kernel, so AOT-exported "codes" searches stay exact)
+            if mode in ("recon", "recon8") and index.list_recon is not None:
+                return _search_impl_recon(
+                    index.centers, index.list_recon, index.list_indices,
+                    index.rotation, queries, k, n_probes, index.metric,
+                    list_recon_sq=index.list_recon_sq)
+            return _search_impl(index.centers, index.codebooks,
+                                index.list_codes, index.list_indices,
+                                index.rotation, queries, k, n_probes,
+                                index.metric, index.codebook_kind,
+                                jnp.dtype(params.lut_dtype).name,
+                                pq_bits=index.pq_bits,
+                                coarse_recall_target=coarse_rt,
+                                exact_coarse=exact_coarse)
+
+        def lut_scan():
+            with obs.stage("ivf_pq.search.lut") as st:
+                out = _search_impl(index.centers, index.codebooks,
+                                   index.list_codes, index.list_indices,
+                                   index.rotation, queries, k, n_probes,
+                                   index.metric, index.codebook_kind,
+                                   jnp.dtype(params.lut_dtype).name,
+                                   pq_bits=index.pq_bits,
+                                   coarse_recall_target=coarse_rt,
+                                   exact_coarse=exact_coarse)
+                st.fence(out)
+            return out
+
+        if mode == "lut":
+            return lut_scan()
+
+        from raft_tpu.neighbors import grouped
+        from raft_tpu.ops import pq_code_scan_pallas as pcs
+
+        # ---- lazy derived caches (one-time per index) -------------------
+        if mode == "recon":
             if index.list_recon is None:
                 # One-time materialization of the (n_lists, cap, rot_dim)
                 # bf16 cache on an index built without it; the cache stays
                 # attached for subsequent searches.
                 warnings.warn(
-                    "ivf_pq.search: use_reconstruction=True on an index "
-                    "built without a reconstruction cache — materializing "
-                    "the (n_lists, cap, rot_dim) bf16 cache now (and "
-                    "keeping it on the index). Build with "
-                    "cache_reconstructions=True or pass "
-                    "use_reconstruction=False to avoid this.")
+                    "ivf_pq.search: scan_mode='recon' on an index built "
+                    "without a reconstruction cache — materializing the "
+                    "(n_lists, cap, rot_dim) bf16 cache now (and keeping "
+                    "it on the index). Build with "
+                    "cache_reconstructions=True or pick another scan_mode "
+                    "to avoid this.")
                 index = _with_recon(res, index)
-            from raft_tpu.neighbors import grouped
-
-            if (isinstance(queries, jax.core.Tracer)
-                    or isinstance(index.centers, jax.core.Tracer)):
-                # queries or the Index pytree traced by an outer jit/vmap:
-                # the grouped dispatch needs a host-side group count — use
-                # the fully traceable probe-order scan instead
-                return _search_impl_recon(
-                    index.centers, index.list_recon, index.list_indices,
-                    index.rotation, queries, k, n_probes, index.metric,
-                    list_recon_sq=index.list_recon_sq)
             if index.list_recon_sq is None:
                 index.list_recon_sq = _recon_sq(index.list_recon)
-            with obs.stage("ivf_pq.search.coarse") as st:
-                probes = _select_clusters(index.centers, index.rotation,
-                                          queries, n_probes, index.metric,
-                                          recall_target=coarse_rt,
-                                          exact=exact_coarse)
-                st.fence(probes)
-            # group count is data-dependent; cached_groups avoids a
-            # per-batch host sync (measured ~125 ms over the remote tunnel)
-            gkey = (queries.shape[0], n_probes)
-            n_groups, pending = grouped.cached_groups(
-                index, gkey, probes, index.n_lists)
-            G, rot = grouped.GROUP, index.rot_dim
-            # the fused kernel's one-hot id contraction is f32 — require
-            # every actual candidate id (incl. user-supplied extend ids)
-            # to be f32-exact, not just the row count
-            use_pallas = (jax.default_backend() == "tpu"
-                          and grouped.ids_f32_exact(index,
-                                                    index.list_indices))
+        elif mode == "codes":
+            if not _codes_mode_eligible(index):
+                return lut_scan()
+            if index.list_code_lanes is None or index.list_code_rsq is None:
+                # the VMEM-LUT analogue of the reference's per-probe smem
+                # LUT build: here the scan tables are built once per index
+                with obs.stage("ivf_pq.search.lut_build") as st:
+                    index = _with_code_lanes(index)
+                    st.fence(index.list_code_lanes, index.list_code_rsq)
+        elif mode == "recon8":
+            if index.list_recon_i8 is None:
+                with obs.stage("ivf_pq.search.lut_build") as st:
+                    index = _with_recon8(index)
+                    st.fence(index.list_recon_i8)
 
-            def dispatch(ng):
-                cap = index.capacity
-                block = grouped.block_size(
-                    ng,
-                    G * cap * 8,      # fp32 distances + broadcast ids
-                    cap * rot * 2,    # bf16 recon slice
-                    G * rot * 4)      # query gather
-                return _search_impl_recon_grouped(
-                    index.centers, index.list_recon, index.list_recon_sq,
-                    index.list_indices, index.rotation, queries, probes, k,
-                    index.metric, ng, block, use_pallas=use_pallas)
+        cap = index.capacity
+        nq = queries.shape[0]
+        rot = index.rot_dim
+        kt = min(kt_req or k, cap)
+        G = grouped.GROUP
+        on_tpu = jax.default_backend() == "tpu"
+        # the fused kernels' one-hot id contraction is f32 — require
+        # every actual candidate id (incl. user-supplied extend ids)
+        # to be f32-exact, not just the row count
+        ids_ok = grouped.ids_f32_exact(index, index.list_indices)
 
-            with obs.stage("ivf_pq.search.scan") as st:
+        if mode == "codes" and not (
+                on_tpu and ids_ok
+                and pcs.supported_codes(True, True, cap, rot, kt, nq,
+                                        index.pq_dim, index.pq_bits,
+                                        packed)):
+            # no XLA twin of the codes kernel is worth running (it would
+            # re-decode every row anyway) — the LUT formulation computes
+            # the same quantized distance
+            return lut_scan()
+
+        with obs.stage("ivf_pq.search.coarse") as st:
+            probes = _select_clusters(index.centers, index.rotation,
+                                      queries, n_probes, index.metric,
+                                      recall_target=coarse_rt,
+                                      exact=exact_coarse)
+            st.fence(probes)
+        # group count is data-dependent; cached_groups avoids a
+        # per-batch host sync (measured ~125 ms over the remote tunnel)
+        gkey = (nq, n_probes)
+        n_groups, pending = grouped.cached_groups(
+            index, gkey, probes, index.n_lists)
+
+        def run_grouped(stage_label, dispatch):
+            with obs.stage(stage_label) as st:
                 out = dispatch(n_groups)
                 needed = grouped.commit_groups(index, gkey, pending)
                 if needed:
@@ -1046,17 +1377,51 @@ def search(res, params: SearchParams, index: Index, queries, k: int
                     out = dispatch(needed)
                 st.fence(out)
             return out
-        with obs.stage("ivf_pq.search.scan") as st:
-            out = _search_impl(index.centers, index.codebooks,
-                               index.list_codes, index.list_indices,
-                               index.rotation, queries, k, n_probes,
-                               index.metric, index.codebook_kind,
-                               jnp.dtype(params.lut_dtype).name,
-                               pq_bits=index.pq_bits,
-                               coarse_recall_target=coarse_rt,
-                               exact_coarse=exact_coarse)
-            st.fence(out)
-        return out
+
+        if mode == "codes":
+            return run_grouped(
+                "ivf_pq.search.code_scan",
+                lambda ng: _search_impl_codes_grouped(
+                    index.centers, index.codebooks, index.list_code_lanes,
+                    index.list_code_rsq, index.list_indices, index.rotation,
+                    queries, probes, k, kt, index.metric, ng,
+                    index.pq_bits, packed=packed))
+
+        if mode == "recon8":
+            rot_pad = index.list_recon_i8.shape[2]
+            use_pallas = (on_tpu and ids_ok
+                          and pcs.supported_recon8(True, cap, rot, kt, nq,
+                                                   packed))
+
+            def dispatch8(ng):
+                block = grouped.block_size(
+                    ng,
+                    G * cap * 8,          # fp32 distances + broadcast ids
+                    cap * rot_pad * 3,    # int8 slice + bf16 upcast
+                    G * rot_pad * 4)      # query gather
+                return _search_impl_recon8_grouped(
+                    index.centers, index.list_recon_i8,
+                    index.list_recon_scale, index.list_recon_i8_sq,
+                    index.list_indices, index.rotation, queries, probes, k,
+                    kt, index.metric, ng, block, use_pallas=use_pallas,
+                    packed=packed)
+
+            return run_grouped("ivf_pq.search.recon8_scan", dispatch8)
+
+        use_pallas = on_tpu and ids_ok
+
+        def dispatch(ng):
+            block = grouped.block_size(
+                ng,
+                G * cap * 8,      # fp32 distances + broadcast ids
+                cap * rot * 2,    # bf16 recon slice
+                G * rot * 4)      # query gather
+            return _search_impl_recon_grouped(
+                index.centers, index.list_recon, index.list_recon_sq,
+                index.list_indices, index.rotation, queries, probes, k,
+                index.metric, ng, block, use_pallas=use_pallas, kt=kt)
+
+        return run_grouped("ivf_pq.search.scan", dispatch)
 
 
 # ---------------------------------------------------------------------------

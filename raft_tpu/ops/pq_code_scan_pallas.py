@@ -1,0 +1,385 @@
+"""Pallas TPU kernels: grouped IVF-PQ scans over COMPACT codes.
+
+The recon-cache kernel (:mod:`raft_tpu.ops.pq_group_scan_pallas`) streams
+2 bytes/dim/row of bf16 reconstructions from HBM.  The reference instead
+scans the bit-packed PQ codes against a shared-memory LUT
+(``compute_similarity_kernel``, ivf_pq_search.cuh:611) — ~pq_dim
+bytes/row.  This module is the TPU analogue, two kernels:
+
+- **code scan** (:func:`grouped_code_scan`): each program DMAs its list's
+  *packed codes* — an (Wi, cap) int32 block with candidates on the LANE
+  axis (``Wi = ceil(pq_dim*pq_bits/32)`` words; the naive (cap, Wi)
+  layout lane-pads Wi to 128 and forfeits the traffic win) — and the
+  full (pq_dim, pq_len, book) codebook table, which is a few hundred KB
+  and VMEM-resident for the whole grid.  Mosaic has no row gather, so
+  per subspace the LUT lookup becomes a **transposed one-hot MXU
+  contraction**: ``onehotT (book, cap) = (iota == code_j)``, then
+  ``reconT_j = cbT_j (pq_len, book) @ onehotT`` decodes the whole
+  subspace column block in one matmul.  Decoding to ``reconT (rot, cap)``
+  and running ONE shared distance GEMM costs ~book/pq_len times fewer
+  MACs than contracting a per-query LUT against the one-hots
+  (pq_dim·G·book·cap vs pq_dim·pq_len·book·cap + G·rot·cap).  The bf16
+  codebook cast makes the decoded values bit-identical to the bf16 recon
+  cache, so distances match the recon kernel's.
+- **int8 recon scan** (:func:`grouped_recon8_scan`): the second traffic
+  lever — the recon cache quantized to int8 with a per-list scale
+  (1 byte/dim/row); the kernel dequantizes in-register
+  (``d = ||sub||² + rsq8 − 2·scale·(sub·q8)``).
+
+Both reuse the recon kernel's one-hot query gather and top-kt
+extraction; an opt-in **packed-key extraction** (:func:`_extract_topk_packed`)
+halves the cross-lane reduces per pass by packing (distance bits | column)
+into one int32 key — valid for L2 (d ≥ 0 makes the f32 bit pattern
+order-isomorphic to int order); value truncation is ≤ ceil(log2 cap)
+mantissa bits (~2⁻¹³ relative at cap 1024), far under PQ quantization
+noise, and the exact-refine pass recomputes distances anyway.
+
+Codes must not straddle int32 words for the in-register unpack to be one
+shift+mask: gated to ``32 % pq_bits == 0`` → pq_bits ∈ {4, 8} (the
+reference's default and its half-width option).  Other widths fall back
+to the recon / XLA LUT paths.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_tpu.neighbors.grouped import GROUP
+from raft_tpu.ops.pq_group_scan_pallas import (_KT_MAX, _KT_UNROLL,
+                                               _extract_topk,
+                                               _gather_queries,
+                                               _scratch_shapes)
+
+_VMEM_BUDGET = 10 << 20
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def code_lane_words(pq_dim: int, pq_bits: int) -> int:
+    """int32 words per row in the lane-major packed-code layout."""
+    return -(-(-(-pq_dim * pq_bits // 8)) // 4)
+
+
+@jax.jit
+def pack_code_lanes(list_codes: jax.Array) -> jax.Array:
+    """(n_lists, cap, W) uint8 packed codes -> (n_lists, Wi, cap) int32.
+
+    Byte k of a row lands in word ``k // 4`` at bit ``8*(k % 4)`` —
+    LSB-first, so the bit stream is unchanged and subspace j still
+    starts at bit ``j*pq_bits``.  Candidates move to the LANE axis: the
+    (cap, Wi) orientation would lane-pad Wi (16 words at bench shape) to
+    128 — an 8x HBM blowup that would erase the codes path's entire
+    traffic advantage.
+    """
+    L, cap, W = list_codes.shape
+    Wi = -(-W // 4)
+    b = jnp.pad(list_codes, ((0, 0), (0, 0), (0, Wi * 4 - W)))
+    b = b.astype(jnp.int32).reshape(L, cap, Wi, 4)
+    shifts = (8 * jnp.arange(4, dtype=jnp.int32))[None, None, None, :]
+    words = jnp.sum(jax.lax.shift_left(b, shifts), axis=-1)
+    return jnp.transpose(words, (0, 2, 1))
+
+
+def _decode_reconT(codes_ref, cb_ref, pq_dim, pq_bits, rot_pad, cap):
+    """In-register decode of one list's codes to (rot_pad, cap) bf16 —
+    the transposed recon block.  Python-unrolled over subspaces: the
+    word/shift offsets are static, and each step is one VPU shift+mask
+    plus one (pq_len, book) x (book, cap) MXU matmul.  The bf16 cast of
+    the codebook reproduces the bf16 recon cache bit-for-bit."""
+    mask = (1 << pq_bits) - 1
+    book = cb_ref.shape[2]
+    pq_len = cb_ref.shape[1]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (book, cap), 0)
+    parts = []
+    for j in range(pq_dim):
+        bitpos = j * pq_bits
+        w, sh = bitpos // 32, bitpos % 32
+        word = codes_ref[0, w:w + 1, :]                  # (1, cap) int32
+        # arithmetic >> then & mask == logical shift (sh + pq_bits <= 32)
+        cj = (word >> sh) & mask if sh else word & mask
+        onehotT = (rows == cj).astype(jnp.bfloat16)      # (book, cap)
+        cbT_j = cb_ref[j].astype(jnp.bfloat16)           # (pq_len, book)
+        rT = jax.lax.dot_general(cbT_j, onehotT,
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        parts.append(rT.astype(jnp.bfloat16))            # (pq_len, cap)
+    rot = pq_dim * pq_len
+    if rot_pad > rot:
+        parts.append(jnp.zeros((rot_pad - rot, cap), jnp.bfloat16))
+    return jnp.concatenate(parts, axis=0)                # (rot_pad, cap)
+
+
+def _extract_topk_packed(d, ids_row, vals_ref, ids_out_ref, vscratch,
+                         pscratch, kt, cap_bits):
+    """Packed-key top-kt: ONE cross-lane reduce per selection pass.
+
+    L2 distances are >= 0, so their f32 bit patterns order like ints;
+    ``key = (bits(d) & ~col_mask) | col`` makes each pass a single int
+    min-reduce with a built-in lowest-column tie-break (vs the standard
+    extraction's max + argmin + id reduces).  Values lose the low
+    ``cap_bits`` mantissa bits; columns decode exactly, and the
+    column -> global-id mapping runs once per selected slot after the
+    selection loop.  Sentinel/exhausted slots surface as INT32_MAX keys
+    and are emitted as +inf values (the shared caller contract)."""
+    cap = d.shape[1]
+    col_mask = (1 << cap_bits) - 1
+    inf_bits = jnp.int32(0x7F800000)
+    int_max = jnp.int32(2**31 - 1)
+    col = jax.lax.broadcasted_iota(jnp.int32, d.shape, 1)
+    invalid = (ids_row < 0)[None, :]
+    bits = jax.lax.bitcast_convert_type(d, jnp.int32)
+    key = jnp.where(invalid, int_max, (bits & ~col_mask) | col)
+    ids_f = ids_row.astype(jnp.float32)
+
+    picked = []
+    for _ in range(kt):
+        m = jnp.min(key, axis=1)                         # (G,) int32
+        key = jnp.where(key == m[:, None], int_max, key)
+        picked.append(m)
+    for j, m in enumerate(picked):
+        vj = jax.lax.bitcast_convert_type(m & ~col_mask, jnp.float32)
+        vj = jnp.where(m >= inf_bits, jnp.inf, vj)
+        sel = col == (m & col_mask)[:, None]
+        gid = jnp.max(jnp.where(sel, ids_f[None, :], -jnp.inf), axis=1)
+        vscratch[:, j] = vj
+        pscratch[:, j] = gid.astype(jnp.int32)
+    vals_ref[0] = vscratch[:, :]
+    ids_out_ref[0] = pscratch[:, :]
+
+
+def _extract(d, ids_ref, vals_ref, ids_out_ref, vscratch, pscratch, kt,
+             packed, cap_bits):
+    ids_row = ids_ref[0, 0]                              # (cap,) int32
+    if packed:
+        _extract_topk_packed(d, ids_row, vals_ref, ids_out_ref, vscratch,
+                             pscratch, kt, cap_bits)
+    else:
+        _extract_topk(d, ids_row, vals_ref, ids_out_ref, vscratch,
+                      pscratch, kt)
+
+
+def _kernel_codes(gl_ref, slot_ref, qrot_ref, cf_ref, codes_ref, cb_ref,
+                  rsq_ref, ids_ref, vals_ref, ids_out_ref, vscratch,
+                  pscratch, *, kt, n_probes, P, pq_dim, pq_bits, packed,
+                  cap_bits):
+    qv = _gather_queries(slot_ref, qrot_ref, n_probes, P)
+    sub = qv - cf_ref[0, 0][None, :]                     # (G, rot_pad) f32
+    sub_sq = jnp.sum(sub * sub, axis=1)                  # (G,)
+    cap = codes_ref.shape[2]
+    reconT = _decode_reconT(codes_ref, cb_ref, pq_dim, pq_bits,
+                            qrot_ref.shape[1], cap)      # (rot_pad, cap)
+    ip = jax.lax.dot_general(sub.astype(jnp.bfloat16), reconT,
+                             (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    d = sub_sq[:, None] + rsq_ref[0, 0][None, :] - 2.0 * ip
+    d = jnp.maximum(d, 0.0)
+    _extract(d, ids_ref, vals_ref, ids_out_ref, vscratch, pscratch, kt,
+             packed, cap_bits)
+
+
+def _kernel_recon8(gl_ref, slot_ref, qrot_ref, cf_ref, data_ref, scale_ref,
+                   rsq_ref, ids_ref, vals_ref, ids_out_ref, vscratch,
+                   pscratch, *, kt, n_probes, P, packed, cap_bits):
+    qv = _gather_queries(slot_ref, qrot_ref, n_probes, P)
+    sub = qv - cf_ref[0, 0][None, :]                     # (G, rot_pad) f32
+    sub_sq = jnp.sum(sub * sub, axis=1)                  # (G,)
+    data = data_ref[0].astype(jnp.bfloat16)              # (cap, rot_pad)
+    scale = scale_ref[0, 0, 0]                           # f32 scalar
+    ip = jax.lax.dot_general(sub.astype(jnp.bfloat16), data,
+                             (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    d = sub_sq[:, None] + rsq_ref[0, 0][None, :] - 2.0 * scale * ip
+    d = jnp.maximum(d, 0.0)
+    _extract(d, ids_ref, vals_ref, ids_out_ref, vscratch, pscratch, kt,
+             packed, cap_bits)
+
+
+def _pad_lanes(x, width):
+    """Zero-pad the trailing (lane) axis of a 2-D array to ``width``."""
+    if x.shape[-1] == width:
+        return x.astype(jnp.float32)
+    return jnp.pad(x.astype(jnp.float32),
+                   ((0, 0), (0, width - x.shape[-1])))
+
+
+def _cap_bits(cap: int) -> int:
+    return max((cap - 1).bit_length(), 1)
+
+
+@functools.partial(jax.jit, static_argnames=("kt", "n_probes", "pq_bits",
+                                             "packed", "interpret"))
+def grouped_code_scan(group_list, slot_pairs, qrot, centers_f32,
+                      codes_lanes, codebooks, rsq, list_indices, kt,
+                      n_probes, pq_bits, packed=False, interpret=False):
+    """Fused grouped scan over packed PQ codes + local top-kt.
+
+    Same contract as ``pq_group_scan_pallas.grouped_l2_scan`` with the
+    bf16 recon cache replaced by ``codes_lanes`` (n_lists, Wi, cap) int32
+    (:func:`pack_code_lanes`) + ``codebooks`` (pq_dim, book, pq_len);
+    ``rsq`` (n_lists, cap) f32 row norms of the bf16 reconstructions.
+    rot_dim need not be 128-aligned: queries/centers are lane-padded here
+    and the decoded block pads with zero rows (the deep conf's rot=96).
+    """
+    n_groups = group_list.shape[0]
+    nq, rot = qrot.shape
+    _, _, cap = codes_lanes.shape
+    pq_dim, book, pq_len = codebooks.shape
+    Wi = codes_lanes.shape[1]
+    P = nq * n_probes
+    rot_pad = _round_up(rot, 128)
+
+    nq_pad = _round_up(nq + 1, 128)
+    qrot_pad = jnp.zeros((nq_pad, rot_pad), jnp.float32)
+    qrot_pad = qrot_pad.at[:nq, :rot].set(qrot.astype(jnp.float32))
+    cf_pad = _pad_lanes(centers_f32, rot_pad)
+    # (pq_dim, pq_len, book): books on lanes — the (.., book, pq_len)
+    # orientation would lane-pad pq_len (2 at bench shape) to 128
+    cbT = jnp.swapaxes(codebooks.astype(jnp.float32), 1, 2)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_groups,),
+        in_specs=[
+            pl.BlockSpec((1, 1, GROUP), lambda g, gl: (g, 0, 0)),
+            pl.BlockSpec((nq_pad, rot_pad), lambda g, gl: (0, 0)),
+            pl.BlockSpec((1, 1, rot_pad), lambda g, gl: (gl[g], 0, 0)),
+            pl.BlockSpec((1, Wi, cap), lambda g, gl: (gl[g], 0, 0)),
+            pl.BlockSpec((pq_dim, pq_len, book), lambda g, gl: (0, 0, 0)),
+            pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
+            pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, GROUP, kt), lambda g, gl: (g, 0, 0)),
+            pl.BlockSpec((1, GROUP, kt), lambda g, gl: (g, 0, 0)),
+        ],
+        scratch_shapes=_scratch_shapes(kt),
+    )
+    vals, gids = pl.pallas_call(
+        functools.partial(_kernel_codes, kt=kt, n_probes=n_probes, P=P,
+                          pq_dim=pq_dim, pq_bits=pq_bits, packed=packed,
+                          cap_bits=_cap_bits(cap)),
+        out_shape=[
+            jax.ShapeDtypeStruct((n_groups, GROUP, kt), jnp.float32),
+            jax.ShapeDtypeStruct((n_groups, GROUP, kt), jnp.int32),
+        ],
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(group_list, slot_pairs[:, None, :], qrot_pad, cf_pad[:, None, :],
+      codes_lanes, cbT, rsq[:, None, :], list_indices[:, None, :])
+    return vals, gids
+
+
+@functools.partial(jax.jit, static_argnames=("kt", "n_probes", "packed",
+                                             "interpret"))
+def grouped_recon8_scan(group_list, slot_pairs, qrot, centers_f32,
+                        recon_i8, scales, rsq8, list_indices, kt, n_probes,
+                        packed=False, interpret=False):
+    """Fused grouped scan over the int8-quantized recon cache.
+
+    ``recon_i8`` (n_lists, cap, rot_pad) int8 with lanes already
+    128-padded (see ivf_pq._with_recon8), ``scales`` (n_lists,) f32
+    per-list dequant scales, ``rsq8`` (n_lists, cap) f32 row norms of
+    the DEQUANTIZED rows (so distances are consistent with the in-kernel
+    dequant).  Same output contract as ``grouped_l2_scan``.
+    """
+    n_groups = group_list.shape[0]
+    nq, rot = qrot.shape
+    _, cap, rot_pad = recon_i8.shape
+    P = nq * n_probes
+
+    nq_pad = _round_up(nq + 1, 128)
+    qrot_pad = jnp.zeros((nq_pad, rot_pad), jnp.float32)
+    qrot_pad = qrot_pad.at[:nq, :rot].set(qrot.astype(jnp.float32))
+    cf_pad = _pad_lanes(centers_f32, rot_pad)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_groups,),
+        in_specs=[
+            pl.BlockSpec((1, 1, GROUP), lambda g, gl: (g, 0, 0)),
+            pl.BlockSpec((nq_pad, rot_pad), lambda g, gl: (0, 0)),
+            pl.BlockSpec((1, 1, rot_pad), lambda g, gl: (gl[g], 0, 0)),
+            pl.BlockSpec((1, cap, rot_pad), lambda g, gl: (gl[g], 0, 0)),
+            pl.BlockSpec((1, 1, 1), lambda g, gl: (gl[g], 0, 0)),
+            pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
+            pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, GROUP, kt), lambda g, gl: (g, 0, 0)),
+            pl.BlockSpec((1, GROUP, kt), lambda g, gl: (g, 0, 0)),
+        ],
+        scratch_shapes=_scratch_shapes(kt),
+    )
+    vals, gids = pl.pallas_call(
+        functools.partial(_kernel_recon8, kt=kt, n_probes=n_probes, P=P,
+                          packed=packed, cap_bits=_cap_bits(cap)),
+        out_shape=[
+            jax.ShapeDtypeStruct((n_groups, GROUP, kt), jnp.float32),
+            jax.ShapeDtypeStruct((n_groups, GROUP, kt), jnp.int32),
+        ],
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(group_list, slot_pairs[:, None, :], qrot_pad, cf_pad[:, None, :],
+      recon_i8, scales.astype(jnp.float32)[:, None, None],
+      rsq8[:, None, :], list_indices[:, None, :])
+    return vals, gids
+
+
+def _extract_ok(kt: int, packed: bool) -> bool:
+    # the packed variant is unrolled-only; the generic path also serves
+    # the fori_loop regime up to _KT_MAX
+    return 0 < kt <= (_KT_UNROLL if packed else _KT_MAX)
+
+
+def supported_codes(metric_is_l2: bool, per_subspace: bool, cap: int,
+                    rot: int, kt: int, nq: int, pq_dim: int, pq_bits: int,
+                    packed: bool = False) -> bool:
+    """Shapes/configs the code-scan kernel handles.
+
+    pq_bits must divide 32 (in-register unpack is one static shift+mask
+    per subspace), codebooks must be PER_SUBSPACE (a per-cluster table
+    would re-DMA book*rot per group), and the summed VMEM footprint —
+    query table + one-hot, packed-code block, codebook table, decoded
+    reconT block, distances + extraction temps — stays under budget.
+    Candidate-id f32-exactness is data-dependent and checked by the
+    caller (grouped.ids_f32_exact), as for the recon kernel."""
+    if not (metric_is_l2 and per_subspace and pq_bits in (4, 8)):
+        return False
+    book = 1 << pq_bits
+    pq_len = rot // pq_dim if pq_dim and rot % pq_dim == 0 else 0
+    if not pq_len:
+        return False
+    rot_pad = _round_up(rot, 128)
+    nq_pad = _round_up(nq + 1, 128)
+    Wi = code_lane_words(pq_dim, pq_bits)
+    vmem = (2 * nq_pad * rot_pad * 4            # query table + one-hot
+            + _round_up(Wi, 8) * cap * 4        # packed-code block
+            + pq_dim * _round_up(pq_len, 8) * _round_up(book, 128) * 4
+            + 2 * rot_pad * cap * 2             # reconT + concat temp
+            + _round_up(book, 8) * cap * 2      # one-hot transient
+            + 2 * GROUP * cap * 4)              # distances + extraction
+    return (cap % 16 == 0 and GROUP % 16 == 0 and _extract_ok(kt, packed)
+            and nq <= 6144 and vmem <= _VMEM_BUDGET)
+
+
+def supported_recon8(metric_is_l2: bool, cap: int, rot: int, kt: int,
+                     nq: int, packed: bool = False) -> bool:
+    """Shapes the int8 recon kernel handles: int8 tiles are (32, 128), so
+    cap must be 32-aligned (the list allocator's _LIST_ALIGN guarantees
+    it); rot is lane-padded internally."""
+    rot_pad = _round_up(rot, 128)
+    nq_pad = _round_up(nq + 1, 128)
+    vmem = (2 * nq_pad * rot_pad * 4
+            + cap * rot_pad * 1                 # int8 data block
+            + cap * rot_pad * 2                 # bf16 dequant transient
+            + 2 * GROUP * cap * 4)
+    return (metric_is_l2 and cap % 32 == 0 and GROUP % 16 == 0
+            and _extract_ok(kt, packed) and nq <= 6144
+            and vmem <= _VMEM_BUDGET)

@@ -181,6 +181,72 @@ class TestAot:
         np.testing.assert_allclose(np.asarray(d1), np.asarray(d2),
                                    rtol=1e-5, atol=1e-5)
 
+    def test_ivf_pq_codes_artifact(self, res):
+        """Compact-code deployment artifact: scan_mode="codes" bakes
+        only the packed PQ codes (+codebooks) and round-trips against
+        the live code-domain search."""
+        from raft_tpu.core import aot
+        from raft_tpu.neighbors import ivf_pq
+
+        rng = np.random.default_rng(2)
+        db = jnp.asarray(rng.normal(size=(2048, 32)).astype(np.float32))
+        q = jnp.asarray(rng.normal(size=(16, 32)).astype(np.float32))
+        index = ivf_pq.build(
+            res, ivf_pq.IndexParams(n_lists=16, pq_dim=8,
+                                    kmeans_n_iters=4), db)
+        buf = aot.export_ivf_pq_search(res, index, n_probes=8, k=5,
+                                       batch=16, scan_mode="codes")
+        g = aot.load_search_fn(buf)
+        d1, i1 = g(q)
+        d2, i2 = ivf_pq._search_impl(
+            index.centers, index.codebooks, index.list_codes,
+            index.list_indices, index.rotation, q, k=5, n_probes=8,
+            metric=index.metric, codebook_kind=index.codebook_kind,
+            lut_dtype=jnp.float32, pq_bits=index.pq_bits)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2),
+                                   rtol=1e-5, atol=1e-5)
+        # the codes artifact must be materially smaller than the recon
+        # one: it carries 1 byte/subspace/row instead of 2 bytes/dim/row
+        recon_buf = aot.export_ivf_pq_search(res, index, n_probes=8,
+                                             k=5, batch=16)
+        assert len(buf.getvalue()) < len(recon_buf.getvalue())
+
+    def test_ivf_flat_search_artifact(self, res):
+        from raft_tpu.core import aot
+        from raft_tpu.neighbors import ivf_flat
+
+        rng = np.random.default_rng(3)
+        db = jnp.asarray(rng.normal(size=(2048, 32)).astype(np.float32))
+        q = jnp.asarray(rng.normal(size=(16, 32)).astype(np.float32))
+        index = ivf_flat.build(
+            res, ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=4), db)
+        buf = aot.export_ivf_flat_search(res, index, n_probes=8, k=5,
+                                         batch=16)
+        g = aot.load_search_fn(buf)
+        d1, i1 = g(q)
+        d2, i2 = ivf_flat._search_impl(
+            index.centers, index.list_data, index.list_indices, q, k=5,
+            n_probes=8, metric=index.metric)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_brute_force_knn_artifact(self, res):
+        from raft_tpu.core import aot
+        from raft_tpu.neighbors import brute_force
+
+        rng = np.random.default_rng(4)
+        db = jnp.asarray(rng.normal(size=(1024, 32)).astype(np.float32))
+        q = jnp.asarray(rng.normal(size=(16, 32)).astype(np.float32))
+        buf = aot.export_brute_force_knn(res, db, k=7, batch=16)
+        g = aot.load_search_fn(buf)
+        d1, i1 = g(q)
+        d2, i2 = brute_force.knn(res, db, q, 7)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2),
+                                   rtol=1e-5, atol=1e-5)
+
     def test_cagra_search_artifact(self, res):
         """CAGRA walk deployment artifact: the walk table + entry set +
         exported walk program reload into a callable that matches the

@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 from raft_tpu import matrix
+from raft_tpu.matrix.select_k import _TILE_LEN
 
 RNG = np.random.default_rng(7)
 
@@ -41,6 +42,66 @@ class TestSelectK:
         vals, _ = matrix.select_k(jnp.asarray(x), 17)
         v = np.asarray(vals)
         assert np.all(np.diff(v, axis=1) >= 0)
+
+    @pytest.mark.parametrize("k", [128, 256])
+    @pytest.mark.parametrize("select_min", [True, False])
+    def test_deep_batch_vs_numpy(self, k, select_min):
+        """The ANN inner-loop shape: wide rows through the two-pass tiled
+        path at batch 64 — exact agreement with the numpy oracle."""
+        rng = np.random.default_rng(100 + k)
+        x = rng.normal(size=(64, 131072)).astype(np.float32)
+        vals, idx = matrix.select_k(jnp.asarray(x), k,
+                                    select_min=select_min)
+        vals, idx = np.asarray(vals), np.asarray(idx)
+        ref = np.sort(x, axis=1)[:, :k] if select_min \
+            else -np.sort(-x, axis=1)[:, :k]
+        np.testing.assert_allclose(vals, ref, rtol=1e-6)
+        np.testing.assert_allclose(np.take_along_axis(x, idx, axis=1),
+                                   vals, rtol=1e-6)
+        # no index appears twice in a row
+        assert all(len(set(r.tolist())) == k for r in idx)
+
+    @pytest.mark.parametrize("length", [
+        _TILE_LEN - 1,       # single-pass, just under
+        _TILE_LEN,           # single-pass, exactly at
+        _TILE_LEN + 1,       # two-pass, 1-element tail tile
+        _TILE_LEN + 129,     # two-pass, sub-k tail tile
+        2 * _TILE_LEN,       # two-pass, full tiles
+    ])
+    def test_tile_boundary_lengths(self, length):
+        """Lengths straddling _TILE_LEN: the tiled path's tail-tile
+        padding must never surface padded slots in the result."""
+        k = 128
+        rng = np.random.default_rng(length)
+        x = rng.normal(size=(4, length)).astype(np.float32)
+        vals, idx = matrix.select_k(jnp.asarray(x), k)
+        vals, idx = np.asarray(vals), np.asarray(idx)
+        np.testing.assert_allclose(vals, np.sort(x, axis=1)[:, :k],
+                                   rtol=1e-6)
+        assert np.all(idx >= 0) and np.all(idx < length)
+        np.testing.assert_allclose(np.take_along_axis(x, idx, axis=1),
+                                   vals, rtol=1e-6)
+
+    def test_ties_and_inf_sentinels(self):
+        """Duplicated values and ±inf padding (the top-k merge sentinel
+        regime): the selected multiset must equal the oracle's even when
+        the winners are all ties, and inf rows must not poison ids."""
+        k = 128
+        length = _TILE_LEN + 777
+        rng = np.random.default_rng(9)
+        # heavy ties: values drawn from 17 distinct levels
+        x = rng.integers(0, 17, size=(3, length)).astype(np.float32)
+        # a row padded with +inf beyond a short valid prefix (ANN
+        # sentinel shape), and one containing -inf entries
+        x[1, 200:] = np.inf
+        x[2, ::5] = -np.inf
+        vals, idx = matrix.select_k(jnp.asarray(x), k)
+        vals, idx = np.asarray(vals), np.asarray(idx)
+        np.testing.assert_array_equal(vals, np.sort(x, axis=1)[:, :k])
+        assert np.all(idx >= 0) and np.all(idx < length)
+        np.testing.assert_array_equal(
+            np.take_along_axis(x, idx, axis=1), vals)
+        assert all(len(set(r.tolist())) == k for r in idx)
 
 
 class TestOps:
