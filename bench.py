@@ -87,6 +87,29 @@ def _recall_at_qps(points, qps_bar: float = QPS_REFERENCE_POINT):
     return max(ok) if ok else None
 
 
+def _check_sane(name: str, ids, n_rows: int, dists=None) -> None:
+    """Integrity tripwire on benchmark outputs: ids in [-1, n_rows) and
+    distances finite on filled slots — a broken kernel must fail the run,
+    not post a great QPS number on nonsense answers."""
+    ids = np.asarray(ids)
+    assert ((ids >= -1) & (ids < n_rows)).all(), \
+        f"{name}: ids outside [-1, {n_rows})"
+    if dists is not None:
+        d = np.asarray(dists)
+        assert np.isfinite(d[ids >= 0]).all(), \
+            f"{name}: non-finite distance on a filled slot"
+
+
+def _integrity_counters() -> dict:
+    """The integrity.* counter snapshot (boundary checks, canary/verify
+    outcomes) for the emitted JSON."""
+    from raft_tpu import observability as obs
+
+    snap = obs.registry().snapshot()["counters"]
+    return {k: v for k, v in sorted(snap.items())
+            if k.startswith("integrity.")}
+
+
 def _ground_truth(res, db, queries):
     from raft_tpu.neighbors import brute_force
 
@@ -149,13 +172,14 @@ def bench_ivf_pq(res, db, queries, gt_i=None) -> dict:
             d, i = ivf_pq.search(res, sp, index, queries, kk)
             if refine_ratio > 1:
                 d, i = refine_fn(res, db, queries, i, K)
-            return i
+            return d, i
 
-        i = query()                                        # warmup/compile
+        d, i = query()                                     # warmup/compile
+        _check_sane("ivf_pq", i, N_DB, d)
         recall = _recall(np.asarray(i), gt_i)
         t0 = time.perf_counter()
         for _ in range(RUNS):
-            i = query()
+            _, i = query()
         # host readback, not block_until_ready: the latter has been observed
         # to return early over the remote-tunnel backend, overstating QPS
         np.asarray(i)
@@ -229,7 +253,8 @@ def bench_cagra(res, db, queries, gt_i=None) -> dict:
     points = []
     for itopk, width in CAGRA_POINTS:
         sp = cagra.SearchParams(itopk_size=itopk, search_width=width)
-        i = cagra.search(res, sp, index, queries, K)[1]   # warmup
+        d, i = cagra.search(res, sp, index, queries, K)   # warmup
+        _check_sane("cagra", i, N_DB, d)
         recall = _recall(np.asarray(i), gt_i)
         t0 = time.perf_counter()
         for _ in range(RUNS):
@@ -273,6 +298,7 @@ def bench_kmeans(res, X) -> dict:
     params = KMeansParams(n_clusters=KMEANS_K, max_iter=KMEANS_ITERS,
                           tol=0.0, n_init=1, init=InitMethod.Random)
     c, _, _ = kmeans.fit(res, params, X)       # warmup/compile
+    assert np.isfinite(np.asarray(c)).all(), "kmeans: non-finite centroids"
     np.asarray(c)   # forced readback: block_until_ready can return early
                     # over the remote tunnel, bleeding the warmup's
                     # remote compile + execution into the timed region
@@ -322,7 +348,8 @@ def bench_ivf_flat(res, db, queries, gt_i=None) -> dict:
     points = []
     for n_probes in IVF_FLAT_POINTS:
         sp = ivf_flat.SearchParams(n_probes=n_probes)
-        i = ivf_flat.search(res, sp, index, queries, K)[1]   # warmup
+        d, i = ivf_flat.search(res, sp, index, queries, K)   # warmup
+        _check_sane("ivf_flat", i, N_DB, d)
         recall = _recall(np.asarray(i), gt_i)
         t0 = time.perf_counter()
         for _ in range(RUNS):
@@ -364,14 +391,16 @@ def bench_brute_force(res, db, queries) -> dict:
     from raft_tpu.neighbors import brute_force
 
     sub = db[:BF_N]
-    i = brute_force.knn(res, sub, queries, BF_K)[1]          # warmup
+    d, i = brute_force.knn(res, sub, queries, BF_K)          # warmup
+    _check_sane("bfknn", i, BF_N, d)
     t0 = time.perf_counter()
     for _ in range(RUNS):
         i = brute_force.knn(res, sub, queries, BF_K)[1]
     np.asarray(i)           # host readback (see bench_ivf_pq note)
     qps = N_QUERIES / ((time.perf_counter() - t0) / RUNS)
 
-    v = fused_l2_nn(queries, sub)[0]                         # warmup
+    v, fi = fused_l2_nn(queries, sub)                        # warmup
+    _check_sane("fused_l2_nn", fi, BF_N, v)
     t0 = time.perf_counter()
     for _ in range(RUNS):
         v, fi = fused_l2_nn(queries, sub)
@@ -646,6 +675,8 @@ def run_conf(conf_path: str) -> None:
 
             found = [query(q) for q in q_batches]   # warmup/compile
             np.asarray(found[-1])   # forced readback (see bench_kmeans)
+            _check_sane(entry["name"], np.concatenate(
+                [np.asarray(f) for f in found]), db.shape[0])
             recall = _recall(np.concatenate([np.asarray(f)
                                              for f in found]), gt_i)
             t0 = time.perf_counter()
@@ -692,6 +723,8 @@ def run_conf(conf_path: str) -> None:
         print(json.dumps({"summary": "recall at QPS=2000", "name": name,
                           "recall": top["recall"], "qps": top["qps"]}),
               flush=True)
+    print(json.dumps({"integrity_counters": _integrity_counters()}),
+          flush=True)
 
 
 def _setup_jax_cache() -> None:
@@ -730,6 +763,8 @@ def main() -> None:
     print(json.dumps(bench_ivf_pq(res, db, queries, gt_i)), flush=True)
     print(json.dumps(bench_kmeans(res, db[:KMEANS_N])), flush=True)
     print(json.dumps(bench_mnmg(res)), flush=True)
+    print(json.dumps({"integrity_counters": _integrity_counters()}),
+          flush=True)
 
 
 if __name__ == "__main__":

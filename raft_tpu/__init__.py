@@ -18,6 +18,7 @@ idiomatically for TPU:
 
 from raft_tpu import config  # noqa: F401
 from raft_tpu import observability  # noqa: F401
+from raft_tpu import integrity  # noqa: F401
 from raft_tpu.core import (  # noqa: F401
     Resources,
     DeviceResources,

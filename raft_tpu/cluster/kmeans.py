@@ -34,6 +34,7 @@ from raft_tpu.cluster.kmeans_types import InitMethod, KMeansParams
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.core.tracing import range as named_range
+from raft_tpu.integrity import boundary as _boundary
 from raft_tpu import observability as obs
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn
 from raft_tpu.distance.pairwise import pairwise_distance
@@ -263,6 +264,8 @@ def fit(
     with named_range("kmeans::fit"):
         X = ensure_array(X, "X")
         expects(X.ndim == 2, "kmeans.fit: 2-D X required")
+        X, _ = _boundary.check_matrix(X, "X", site="kmeans.fit",
+                                      allow_empty=False)
         expects(params.n_clusters <= X.shape[0],
                 "kmeans.fit: n_clusters > n_samples")
         w = (jnp.ones(X.shape[0], jnp.float32) if sample_weight is None
@@ -325,6 +328,7 @@ def predict(
     Reference: cluster/kmeans.cuh:151.
     """
     X = ensure_array(X, "X")
+    X, _ = _boundary.check_matrix(X, "X", site="kmeans.predict")
     centroids = ensure_array(centroids, "centroids")
     labels, dists = min_cluster_and_distance(X, centroids,
                                              metric=params.metric)
@@ -348,8 +352,9 @@ def fit_predict(res, params: KMeansParams, X,
 @auto_convert_output
 def transform(res, params: KMeansParams, X, centroids) -> jax.Array:
     """Distance from every sample to every centroid (reference: kmeans.cuh:243)."""
-    return raw(pairwise_distance)(ensure_array(X, "X"),
-                                  ensure_array(centroids, "centroids"),
+    X, _ = _boundary.check_matrix(ensure_array(X, "X"), "X",
+                                  site="kmeans.transform")
+    return raw(pairwise_distance)(X, ensure_array(centroids, "centroids"),
                                   params.metric)
 
 

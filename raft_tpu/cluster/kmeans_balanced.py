@@ -31,6 +31,7 @@ from raft_tpu.cluster.kmeans_types import KMeansBalancedParams
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.core.tracing import range as named_range
+from raft_tpu.integrity import boundary as _boundary
 from raft_tpu import observability as obs
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn
 from raft_tpu.distance.types import DistanceType
@@ -253,6 +254,8 @@ def fit(
     with named_range("kmeans_balanced::fit"), \
             obs.stage("kmeans_balanced.fit") as st:
         X = ensure_array(X, "X")
+        X, _ = _boundary.check_matrix(X, "X", site="kmeans_balanced.fit",
+                                      allow_empty=False)
         n, _ = X.shape
         expects(n_clusters <= n, "kmeans_balanced.fit: n_clusters > n_samples")
         expects(params.metric in (DistanceType.L2Expanded,
@@ -288,6 +291,7 @@ def fit(
 def predict(res, params: KMeansBalancedParams, X, centroids) -> jax.Array:
     """Nearest-centroid labels (reference: kmeans_balanced.cuh:133)."""
     X = ensure_array(X, "X")
+    X, _ = _boundary.check_matrix(X, "X", site="kmeans_balanced.predict")
     labels, _ = _assign(X.astype(jnp.float32),
                         ensure_array(centroids, "centroids"), params.metric)
     return labels

@@ -38,3 +38,60 @@ def set_output_as(output: Union[str, Callable]) -> None:
 
 def get_output_as() -> Union[str, Callable]:
     return output_as_
+
+
+# ---------------------------------------------------------------------------
+# input-validation policy (raft_tpu.integrity boundary layer)
+# ---------------------------------------------------------------------------
+
+# "raise": non-finite input rows raise integrity.ValidationError at the
+#          public entry point (one fused isfinite pass + host sync).
+# "mask":  non-finite query rows are replaced in-graph and flagged in the
+#          outputs (ids -1 / worst distance) instead of poisoning the
+#          batch; no host sync.
+# "off":   no validation work at all — the jitted path is byte-identical
+#          to an unvalidated call (the serving hot-path setting once
+#          inputs are trusted).
+SUPPORTED_VALIDATION_POLICIES = ("raise", "mask", "off")
+
+validation_policy_: str = "raise"
+
+
+def set_validation_policy(policy: str) -> None:
+    """Set the boundary-validation policy for public entry points."""
+    if policy not in SUPPORTED_VALIDATION_POLICIES:
+        raise ValueError(
+            f"Unsupported validation policy {policy!r}; expected one of "
+            f"{SUPPORTED_VALIDATION_POLICIES}")
+    global validation_policy_
+    validation_policy_ = policy
+
+
+def get_validation_policy() -> str:
+    return validation_policy_
+
+
+class validation_policy:
+    """Context manager scoping the validation policy::
+
+        with config.validation_policy("off"):
+            ivf_pq.search(...)   # trusted hot path, zero validation work
+    """
+
+    def __init__(self, policy: str):
+        if policy not in SUPPORTED_VALIDATION_POLICIES:
+            raise ValueError(
+                f"Unsupported validation policy {policy!r}; expected one of "
+                f"{SUPPORTED_VALIDATION_POLICIES}")
+        self._policy = policy
+        self._saved: str = validation_policy_
+
+    def __enter__(self) -> "validation_policy":
+        global validation_policy_
+        self._saved = validation_policy_
+        validation_policy_ = self._policy
+        return self
+
+    def __exit__(self, *exc) -> None:
+        global validation_policy_
+        validation_policy_ = self._saved

@@ -32,6 +32,12 @@ else:  # pre-graduation releases
                              out_specs=out_specs, check_rep=check_vma)
 
 
+if hasattr(jax, "enable_x64"):
+    enable_x64 = jax.enable_x64
+else:  # pre-graduation releases keep it under jax.experimental
+    from jax.experimental import enable_x64  # noqa: F401
+
+
 if hasattr(jax.lax, "axis_size"):
     def axis_size(axis_name):
         """Static extent of a mesh axis inside a traced context."""

@@ -75,6 +75,10 @@ class DistributedIndex:
     list_recon: jax.Array     # (n_dev, n_lists, cap, rot_dim) bf16
     metric: int = DistanceType.L2Expanded
     size: int = 0
+    # per-shard recall canaries (tuple of integrity.CanarySet / None) —
+    # host-side metadata, NOT a pytree leaf, so jax transforms drop it;
+    # build / health_check carry it explicitly
+    shard_canaries: Optional[tuple] = None
 
     @property
     def n_shards(self) -> int:
@@ -131,7 +135,10 @@ def _build_impl(handle, params: ivf_pq.IndexParams,
         if (params.codebook_kind == ivf_pq.CodebookKind.PER_SUBSPACE
                 and params.n_lists < kb._MESO_THRESHOLD
                 and params.n_lists <= per
-                and params.add_data_on_build):
+                and params.add_data_on_build
+                # canaries need per-shard exact ground truth, which only
+                # the sequential per-shard build computes
+                and params.canary_queries == 0):
             return _build_spmd(handle, params, dataset, mesh, axis, n,
                                n_dev, per)
 
@@ -158,8 +165,11 @@ def _build_impl(handle, params: ivf_pq.IndexParams,
             for ix in locals_]
 
         placed = _stack_leaves(per_shard_leaves, mesh, axis, devs)
-        return DistributedIndex.tree_unflatten(
+        out = DistributedIndex.tree_unflatten(
             (params.metric, n), tuple(placed))
+        out.shard_canaries = _collect_canaries(locals_, per,
+                                               offset_ids=True)
+        return out
 
 
 def _stack_leaves(per_shard_leaves, mesh, axis, devs):
@@ -362,6 +372,8 @@ class DistributedFlatIndex:
     list_sizes: jax.Array
     metric: int = DistanceType.L2Expanded
     size: int = 0
+    # per-shard recall canaries — host-side, not a pytree leaf
+    shard_canaries: Optional[tuple] = None
 
     @property
     def n_shards(self) -> int:
@@ -430,8 +442,11 @@ def _build_flat_impl(handle, params, dataset) -> DistributedFlatIndex:
                    pad_cap(ix.list_indices, -1), ix.list_sizes)
                   for ix in locals_]
         placed = _stack_leaves(leaves, mesh, axis, devs)
-        return DistributedFlatIndex.tree_unflatten(
+        out = DistributedFlatIndex.tree_unflatten(
             (params.metric, n), tuple(placed))
+        out.shard_canaries = _collect_canaries(locals_, per,
+                                               offset_ids=True)
+        return out
 
 
 @functools.partial(jax.jit, static_argnames=("k", "n_probes", "metric",
@@ -524,6 +539,9 @@ class DistributedCagraIndex:
     metric: int = DistanceType.L2Expanded
     size: int = 0
     use_walk: bool = True
+    # per-shard recall canaries — host-side, not a pytree leaf; CAGRA
+    # shard ids stay LOCAL, so these carry local ground-truth ids
+    shard_canaries: Optional[tuple] = None
 
     @property
     def n_shards(self) -> int:
@@ -566,9 +584,10 @@ def _build_cagra_impl(handle, params, dataset) -> DistributedCagraIndex:
         comms, mesh, axis, n, n_dev, per, devs = _shard_layout(
             handle, dataset)
 
-        locals_, pdim, use_walk = [], None, True
+        locals_, shard_idxs, pdim, use_walk = [], [], None, True
         for s in range(n_dev):
             idx = cagra.build(handle, params, dataset[s * per:(s + 1) * per])
+            shard_idxs.append(idx)
             if pdim is None:
                 pdim = cagra._auto_pdim(idx)
                 use_walk = (pdim > 0 and cagra._table_bytes(
@@ -586,8 +605,12 @@ def _build_cagra_impl(handle, params, dataset) -> DistributedCagraIndex:
                                jnp.zeros((1,), jnp.int32))
             locals_.append((idx.dataset, idx.graph) + walk_leaves)
         placed = _stack_leaves(locals_, mesh, axis, devs)
-        return DistributedCagraIndex.tree_unflatten(
+        out = DistributedCagraIndex.tree_unflatten(
             (params.metric, n, use_walk), tuple(placed))
+        # CAGRA shard ids are local: ground truth needs no offset
+        out.shard_canaries = _collect_canaries(shard_idxs, per,
+                                               offset_ids=False)
+        return out
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -665,3 +688,78 @@ def search_cagra(handle, params, index: DistributedCagraIndex, queries,
                 comms.axis_name, handle.mesh, index.use_walk,
                 n_samplings=max(params.num_random_samplings, 1)),
             retry_policy, deadline)
+
+
+# ---------------------------------------------------------------------------
+# per-shard recall-canary health checks (raft_tpu.integrity)
+# ---------------------------------------------------------------------------
+
+def _collect_canaries(shard_indexes, per, *, offset_ids):
+    """Gather per-shard CanarySets off the local indexes.  ``offset_ids``
+    globalizes the stored ground-truth ids to match the stacked leaves'
+    id space (IVF shards store GLOBAL ids; CAGRA shards stay local)."""
+    cans = [getattr(ix, "canaries", None) for ix in shard_indexes]
+    if all(c is None for c in cans):
+        return None
+    out = []
+    for s, cs in enumerate(cans):
+        if cs is not None and offset_ids and s > 0:
+            cs = dataclasses.replace(cs, gt_ids=cs.gt_ids + s * per)
+        out.append(cs)
+    return tuple(out)
+
+
+def _local_index(index, s):
+    """Reassemble shard ``s`` as a single-device index (a leaf slice —
+    the stacked layout is exactly the local index layout plus a leading
+    shard axis)."""
+    from raft_tpu.neighbors import cagra, ivf_flat, ivf_pq
+    if isinstance(index, DistributedIndex):
+        return ivf_pq.Index(
+            centers=index.centers[s], codebooks=index.codebooks[s],
+            list_codes=index.list_codes[s],
+            list_indices=index.list_indices[s],
+            list_sizes=index.list_sizes[s], rotation=index.rotation[s],
+            metric=index.metric, list_recon=index.list_recon[s])
+    if isinstance(index, DistributedFlatIndex):
+        return ivf_flat.Index(
+            centers=index.centers[s], list_data=index.list_data[s],
+            list_indices=index.list_indices[s],
+            list_sizes=index.list_sizes[s], metric=index.metric)
+    if isinstance(index, DistributedCagraIndex):
+        return cagra.Index(dataset=index.dataset[s], graph=index.graph[s],
+                           metric=index.metric)
+    raise TypeError(
+        f"distributed.ann.health_check: unsupported index type "
+        f"{type(index).__name__}")
+
+
+def health_check(handle, index, *, raise_on_fail: bool = True):
+    """Re-search every shard's stored recall canaries and compare against
+    the stored floor (see :func:`raft_tpu.integrity.health_check`).
+
+    Returns a list with one :class:`~raft_tpu.integrity.CanaryReport`
+    (or ``None``) per shard, or ``None`` when the index carries no
+    canaries.  With ``raise_on_fail`` (default) the first failing shard
+    raises :class:`~raft_tpu.integrity.IntegrityError` — the error names
+    the shard in its message."""
+    from raft_tpu.integrity import IntegrityError
+    from raft_tpu.integrity import canary as _canary
+    cans = getattr(index, "shard_canaries", None)
+    if cans is None:
+        return None
+    reports = []
+    for s, cs in enumerate(cans):
+        if cs is None:
+            reports.append(None)
+            continue
+        local = _local_index(index, s)
+        local.canaries = cs
+        try:
+            reports.append(_canary.health_check(
+                handle, local, raise_on_fail=raise_on_fail))
+        except IntegrityError as e:
+            raise IntegrityError(f"shard {s}: {e}",
+                                 invariant=e.invariant,
+                                 coord=(s,) + tuple(e.coord or ())) from e
+    return reports

@@ -1,0 +1,39 @@
+"""raft_tpu.integrity — index integrity verification and input hardening.
+
+Three layers, mirroring a serving stack's defense in depth:
+
+1. :func:`verify` — tiered invariant checks (``structural`` /
+   ``statistical`` / ``full``) over IVF-Flat, IVF-PQ and CAGRA indexes,
+   raising a typed :class:`IntegrityError` that names the first violated
+   invariant and its index coordinates.
+2. Boundary validation (:mod:`~raft_tpu.integrity.boundary`) — a
+   jit-compatible ``check_matrix`` / ``guard_nonfinite`` layer applied at
+   every public build/search/extend/cluster entry point, governed by
+   ``config.set_validation_policy("raise" | "mask" | "off")``.
+3. Recall canaries (:mod:`~raft_tpu.integrity.canary`) — build-time
+   sentinel queries with exact ground truth stored inside the index;
+   :func:`health_check` re-searches them after ``load()`` / ``extend()``
+   / checkpoint resume and fails fast when recall drops below the stored
+   floor.
+
+Counters land under ``integrity.*`` in the observability registry; the
+verifier runs under a ``verify`` stage label.
+"""
+
+from raft_tpu.integrity import boundary  # noqa: F401
+from raft_tpu.integrity import canary  # noqa: F401
+from raft_tpu.integrity.boundary import (  # noqa: F401
+    check_matrix,
+    guard_nonfinite,
+    mask_search_outputs,
+)
+from raft_tpu.integrity.canary import (  # noqa: F401
+    CanaryReport,
+    CanarySet,
+    health_check,
+)
+from raft_tpu.integrity.errors import (  # noqa: F401
+    IntegrityError,
+    ValidationError,
+)
+from raft_tpu.integrity.verify import verify  # noqa: F401

@@ -1,0 +1,190 @@
+"""Recall canaries — build-time sentinel queries with exact ground truth.
+
+The self-test pattern of ``comms/self_test.py`` applied to ANN indexes:
+``build()`` samples a handful of dataset rows as sentinel queries,
+computes their *exact* neighbors while the dataset is still in hand, and
+stores both inside the index (CRC-protected by a nested RTIE envelope in
+the serialized stream).  :func:`health_check` re-searches the sentinels
+and compares recall against the stored floor — run automatically after
+``load()``, ``extend()`` and checkpoint ``resume=True``, so an index
+whose invariants were silently violated is detected *before* it serves
+traffic, not by a dashboard dip hours later.
+
+Canary recall is a one-sided detector: corruption can only lower it, but
+rows added by ``extend()`` can legitimately displace stored ground truth
+too, so the floor should be conservative (default 0.5 of a build-time
+recall that is typically > 0.9).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu import observability as obs
+from raft_tpu.core import serialize as ser
+from raft_tpu.integrity.errors import IntegrityError
+
+# build-time defaults: enough sentinels for a stable recall estimate,
+# few enough that the stored block and the health-check search are noise
+DEFAULT_QUERIES = 32
+DEFAULT_K = 10
+DEFAULT_FLOOR = 0.5
+
+
+@dataclasses.dataclass
+class CanarySet:
+    """Sentinel queries + exact ground truth + acceptance floor."""
+
+    queries: np.ndarray       # (c, dim) float32
+    gt_ids: np.ndarray        # (c, k) int32, exact neighbors at build
+    floor: float              # health_check fails below this recall
+    build_recall: float = -1.0   # measured right after build (reporting)
+
+    @property
+    def k(self) -> int:
+        return self.gt_ids.shape[1]
+
+    @property
+    def n_queries(self) -> int:
+        return self.queries.shape[0]
+
+
+@dataclasses.dataclass
+class CanaryReport:
+    """health_check outcome (returned, and raised-from on failure)."""
+
+    recall: float
+    floor: float
+    n_queries: int
+    k: int
+
+    @property
+    def ok(self) -> bool:
+        return self.recall >= self.floor
+
+
+def make(res, dataset, *, metric: int, n_queries: int = DEFAULT_QUERIES,
+         k: int = DEFAULT_K, floor: float = DEFAULT_FLOOR) -> CanarySet:
+    """Sample sentinel queries from ``dataset`` and compute exact ground
+    truth (one brute-force pass) while the raw rows are still available.
+    Ground-truth ids are dataset row positions — the default source ids
+    ``build()`` assigns."""
+    from raft_tpu.core.outputs import raw
+    from raft_tpu.neighbors import brute_force
+
+    dataset = jnp.asarray(dataset)
+    n = dataset.shape[0]
+    c = min(n_queries, n)
+    k = min(k, n)
+    # strided row sample: deterministic (reproducible builds burn no key
+    # stream) and distinct since (c-1)*stride < n
+    stride = max(1, n // c)
+    queries = dataset[np.arange(c) * stride]
+    _, gt = raw(brute_force.knn)(res, dataset, queries, k, metric=metric)
+    return CanarySet(queries=np.asarray(queries, np.float32),
+                     gt_ids=np.asarray(gt, np.int32), floor=float(floor))
+
+
+def _search_canaries(res, index, cs: CanarySet) -> np.ndarray:
+    """Re-search the sentinels on ``index``; returns (c, k) found ids."""
+    from raft_tpu.core.outputs import raw
+    from raft_tpu.neighbors import cagra, ivf_flat, ivf_pq
+
+    q = jnp.asarray(cs.queries)
+    if isinstance(index, ivf_flat.Index):
+        p = ivf_flat.SearchParams(n_probes=min(32, index.n_lists))
+        _, ids = raw(ivf_flat.search)(res, p, index, q, cs.k)
+    elif isinstance(index, ivf_pq.Index):
+        p = ivf_pq.SearchParams(n_probes=min(32, index.n_lists))
+        _, ids = raw(ivf_pq.search)(res, p, index, q, cs.k)
+    elif isinstance(index, cagra.Index):
+        _, ids = raw(cagra.search)(res, cagra.SearchParams(), index, q,
+                                   cs.k)
+    else:
+        raise TypeError(
+            f"health_check: unsupported index type {type(index).__name__}")
+    return np.asarray(ids)
+
+
+def measure(res, index, cs: CanarySet) -> float:
+    """Canary recall of ``index`` against the stored ground truth."""
+    found = _search_canaries(res, index, cs)
+    hits = sum(len(set(f.tolist()) & set(t.tolist()))
+               for f, t in zip(found, cs.gt_ids))
+    return hits / cs.gt_ids.size
+
+
+def health_check(res, index, *, raise_on_fail: bool = True
+                 ) -> Optional[CanaryReport]:
+    """Re-search the index's stored sentinels and compare recall to the
+    floor.  Returns the report (``None`` when the index carries no
+    canaries); raises :class:`IntegrityError` on a floor violation unless
+    ``raise_on_fail=False``."""
+    cs = getattr(index, "canaries", None)
+    if cs is None:
+        return None
+    with obs.stage("integrity.health_check"):
+        rec = measure(res, index, cs)
+    if obs.enabled():
+        obs.registry().counter("integrity.canary.checks").inc()
+    report = CanaryReport(recall=rec, floor=cs.floor,
+                          n_queries=cs.n_queries, k=cs.k)
+    if not report.ok:
+        if obs.enabled():
+            obs.registry().counter("integrity.canary.failures").inc()
+        if raise_on_fail:
+            raise IntegrityError(
+                f"canary recall {rec:.3f} below floor {cs.floor:.3f} "
+                f"({cs.n_queries} sentinels, k={cs.k}; build-time recall "
+                f"was {cs.build_recall:.3f})",
+                invariant="canary.recall_floor")
+    return report
+
+
+def auto_check(res, index, *, site: str) -> None:
+    """The post-``load()`` / ``extend()`` / ``resume`` hook: a no-op for
+    canary-less indexes, an :class:`IntegrityError` for a failing one."""
+    cs = getattr(index, "canaries", None)
+    if cs is None:
+        return
+    if obs.enabled():
+        obs.registry().counter(f"integrity.canary.auto.{site}").inc()
+    health_check(res, index, raise_on_fail=True)
+
+
+# ---------------------------------------------------------------------------
+# serialization: a nested RTIE envelope inside the index stream, so the
+# canary block has its own CRC and a corrupt block fails fast on load
+# ---------------------------------------------------------------------------
+
+def to_stream(res, stream, cs: Optional[CanarySet]) -> None:
+    ser.serialize_scalar(res, stream, np.int32(0 if cs is None else 1))
+    if cs is None:
+        return
+    body = io.BytesIO()
+    with ser.enveloped_writer(body) as env:
+        ser.serialize_scalar(res, env, np.float64(cs.floor))
+        ser.serialize_scalar(res, env, np.float64(cs.build_recall))
+        ser.serialize_mdspan(res, env, cs.queries)
+        ser.serialize_mdspan(res, env, cs.gt_ids)
+    ser.serialize_mdspan(res, stream,
+                         np.frombuffer(body.getvalue(), np.uint8))
+
+
+def from_stream(res, stream) -> Optional[CanarySet]:
+    present = int(ser.deserialize_scalar(res, stream))
+    if not present:
+        return None
+    blob = np.asarray(ser.deserialize_mdspan(res, stream), np.uint8)
+    env = ser.open_envelope(io.BytesIO(blob.tobytes()))
+    floor = float(ser.deserialize_scalar(res, env))
+    build_recall = float(ser.deserialize_scalar(res, env))
+    queries = np.asarray(ser.deserialize_mdspan(res, env), np.float32)
+    gt_ids = np.asarray(ser.deserialize_mdspan(res, env), np.int32)
+    return CanarySet(queries=queries, gt_ids=gt_ids, floor=floor,
+                     build_recall=build_recall)

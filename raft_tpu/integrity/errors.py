@@ -1,0 +1,43 @@
+"""Typed integrity failures.
+
+The serving-stack counterpart of the reference's ``RAFT_EXPECTS`` /
+``RAFT_FAIL`` macros (core/error.py): where ``LogicError`` means "the
+caller misused the API", :class:`IntegrityError` means "the index (or an
+input) is in a semantically invalid state" — every instance names the
+first violated invariant and, when one exists, the index coordinate
+where it was observed, so a monitoring stack can aggregate failures by
+invariant without parsing prose.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from raft_tpu.core.error import RaftError
+
+
+class IntegrityError(RaftError):
+    """An index invariant (or canary recall floor) is violated.
+
+    Attributes
+    ----------
+    invariant : str
+        Dotted name of the first violated invariant, e.g.
+        ``"ivf.list_sizes.range"`` or ``"canary.recall_floor"``.
+    coord : tuple or None
+        Index coordinates of the first violation (e.g. ``(list, slot)``
+        for an IVF slot, ``(row, col)`` for a CAGRA edge), when the
+        invariant is localized.
+    """
+
+    def __init__(self, message: str, *, invariant: str = "unknown",
+                 coord: Optional[Tuple[int, ...]] = None):
+        super().__init__(message)
+        self.invariant = invariant
+        self.coord = coord
+
+
+class ValidationError(IntegrityError, ValueError):
+    """A public entry point rejected its input under policy ``raise``
+    (non-finite rows, malformed shapes).  Also a ``ValueError`` so
+    callers that predate the integrity layer keep catching it."""

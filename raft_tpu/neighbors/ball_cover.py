@@ -36,6 +36,7 @@ import jax.numpy as jnp
 
 from ..core.error import expects
 from ..core.mdarray import ensure_array
+from ..integrity import boundary as _boundary
 from ..core.outputs import auto_convert_output
 from ..core.tracing import range as named_range
 from ..distance.types import DistanceType, resolve_metric
@@ -118,6 +119,8 @@ def build_index(res, index: BallCoverIndex) -> BallCoverIndex:
     with named_range("ball_cover::build_index"):
         expects(not index.trained, "index already built")
         X = index.X.astype(jnp.float32)
+        X, _ = _boundary.check_matrix(X, "X", site="ball_cover.build_index",
+                                      allow_empty=False)
         n, L = index.n, index.n_landmarks
         # uniform random landmark sample — the "random" in random ball cover
         perm = jax.random.permutation(res.next_key(), n)[:L]
@@ -226,13 +229,18 @@ def _query(res, index: BallCoverIndex, queries, k: int,
     queries = ensure_array(queries, "queries").astype(jnp.float32)
     expects(queries.ndim == 2 and queries.shape[1] == index.dim,
             "ball_cover: query dim mismatch")
+    queries, ok_rows = _boundary.check_matrix(
+        queries, "queries", site="ball_cover.query", dim=index.dim)
     L = index.n_landmarks
     chunk = min(L, max(1, k))
     max_chunks = -(-L // chunk)
-    return _query_impl(index.landmarks, index.radii, index.list_data,
+    d, i = _query_impl(index.landmarks, index.radii, index.list_data,
                        index.list_indices, queries, int(k), index.metric,
                        chunk, max_chunks, bool(perform_post_filtering),
                        jnp.float32(weight))
+    if ok_rows is not None:
+        d, i = _boundary.mask_search_outputs(d, i, ok_rows)
+    return d, i
 
 
 @auto_convert_output

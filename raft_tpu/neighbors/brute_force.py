@@ -24,6 +24,7 @@ import numpy as np
 
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import ensure_array
+from raft_tpu.integrity import boundary as _boundary
 from raft_tpu.core.tracing import range as named_range
 from raft_tpu.distance.pairwise import pairwise_distance
 from raft_tpu.distance.types import DistanceType
@@ -91,10 +92,17 @@ def knn(
                 and database.shape[1] == queries.shape[1],
                 "knn: (n,d) database and (q,d) queries required")
         expects(0 < k <= database.shape[0], "knn: need 0 < k <= n")
+        queries, ok_rows = _boundary.check_matrix(
+            queries, "queries", site="brute_force.knn",
+            dim=database.shape[1])
         tile = min(tile_n, database.shape[0])
         d, i = _knn_impl(database, queries, k, metric, metric_arg, tile)
         if global_id_offset:
             i = i + global_id_offset
+        if ok_rows is not None:
+            d, i = _boundary.mask_search_outputs(
+                d, i, ok_rows,
+                select_min=metric != DistanceType.InnerProduct)
         return d, i
 
 

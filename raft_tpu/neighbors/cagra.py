@@ -69,6 +69,8 @@ from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.core.tracing import range as named_range
 from raft_tpu import observability as obs
+from raft_tpu.integrity import boundary as _boundary
+from raft_tpu.integrity import canary as _canary
 from raft_tpu.distance.types import DistanceType
 from raft_tpu.matrix import ops as matrix_ops
 from raft_tpu.matrix.select_k import select_k
@@ -99,6 +101,12 @@ class IndexParams:
     build_reverse_rounds: int = 1     # reverse-edge merge rounds
     build_walk_rounds: int = 2        # graph-walk refinement rounds
     build_walk_iters: int = 8         # expansion steps per walk round
+    # recall canaries (raft_tpu.integrity): > 0 samples that many sentinel
+    # queries at build, stores their exact neighbors in the index, and
+    # health-checks recall against the floor after load()/resume
+    canary_queries: int = 0
+    canary_k: int = 10
+    canary_floor: float = 0.5
 
 
 @dataclasses.dataclass
@@ -142,6 +150,10 @@ class Index:
     dataset: jax.Array            # (n, dim)
     graph: jax.Array              # (n, graph_degree) int32
     metric: int = DistanceType.L2Expanded
+    # Recall-canary sentinel set (integrity.CanarySet) — host-side
+    # metadata, deliberately NOT a pytree leaf (aux must stay hashable),
+    # so jax transforms drop it; build/serialize carry it explicitly.
+    canaries: Optional[object] = None
 
     @property
     def size(self) -> int:
@@ -1132,6 +1144,9 @@ def build(res, params: IndexParams, dataset, *,
     from raft_tpu.resilience import as_manager
     ckpt = as_manager(checkpoint)
     dataset = ensure_array(dataset, "dataset")
+    dataset, _ = _boundary.check_matrix(dataset, "dataset",
+                                        site="cagra.build",
+                                        allow_empty=False)
     with obs.build_scope("cagra.build") as rep:
         if resume and ckpt is not None and ckpt.has("knn_graph"):
             knn = jnp.asarray(ckpt.load("knn_graph")["knn"])
@@ -1152,6 +1167,14 @@ def build(res, params: IndexParams, dataset, *,
                 ckpt.save("graph", {"graph": np.asarray(graph)})
         interruptible.synchronize(graph)
         index = Index(dataset=dataset, graph=graph, metric=params.metric)
+        if params.canary_queries > 0:
+            cs = _canary.make(res, dataset, metric=params.metric,
+                              n_queries=params.canary_queries,
+                              k=params.canary_k, floor=params.canary_floor)
+            index.canaries = cs
+            cs.build_recall = _canary.measure(res, index, cs)
+            if resume:
+                _canary.auto_check(res, index, site="resume")
     return rep.attach(index)
 
 
@@ -1868,11 +1891,29 @@ def search(res, params: SearchParams, index: Index, queries, k: int
        neighborhood table (:class:`_WalkCache`) to the index in place —
        a non-pytree attribute, so jitted closures over the index do not
        retrace; pass ``walk_pdim=0`` to skip it.
+
+    Queries pass through the boundary validator (see
+    :mod:`raft_tpu.integrity.boundary`): under policy ``mask``,
+    non-finite query rows return id -1 / worst distance instead of
+    poisoning the batch.
     """
+    queries = ensure_array(queries, "queries")
+    queries, ok_rows = _boundary.check_matrix(
+        queries, "queries", site="cagra.search", dim=index.dim)
+    # legacy shape guard: still fires when the validator policy is "off"
+    expects(queries.ndim == 2 and queries.shape[1] == index.dim,
+            "cagra.search: query dim mismatch")
+    dist, ids = _search_checked(res, params, index, queries, k)
+    if ok_rows is not None:
+        dist, ids = _boundary.mask_search_outputs(
+            dist, ids, ok_rows,
+            select_min=index.metric != DistanceType.InnerProduct)
+    return dist, ids
+
+
+def _search_checked(res, params: SearchParams, index: Index, queries,
+                    k: int) -> Tuple[jax.Array, jax.Array]:
     with named_range("cagra::search"):
-        queries = ensure_array(queries, "queries")
-        expects(queries.ndim == 2 and queries.shape[1] == index.dim,
-                "cagra.search: query dim mismatch")
         itopk = max(params.itopk_size, k)
         max_iter = params.max_iterations or (
             10 + itopk // max(params.search_width, 1))
@@ -1923,7 +1964,9 @@ def search(res, params: SearchParams, index: Index, queries, k: int
 # serialization (reference: cagra_serialize.cuh)
 # ---------------------------------------------------------------------------
 
-_SERIALIZATION_VERSION = 1
+# v2: trailing recall-canary block (nested envelope, may be absent)
+_SERIALIZATION_VERSION = 2
+_MIN_READ_VERSION = 1
 
 
 def serialize(res, stream: BinaryIO, index: Index) -> None:
@@ -1933,6 +1976,7 @@ def serialize(res, stream: BinaryIO, index: Index) -> None:
         ser.serialize_scalar(res, body, np.int32(index.metric))
         ser.serialize_mdspan(res, body, index.dataset)
         ser.serialize_mdspan(res, body, index.graph)
+        _canary.to_stream(res, body, index.canaries)
 
 
 def deserialize(res, stream: BinaryIO) -> Index:
@@ -1940,14 +1984,17 @@ def deserialize(res, stream: BinaryIO) -> Index:
     :class:`~raft_tpu.core.serialize.CorruptIndexError`."""
     body = ser.open_envelope(stream)
     version = int(ser.deserialize_scalar(res, body))
-    if version != _SERIALIZATION_VERSION:
+    if not _MIN_READ_VERSION <= version <= _SERIALIZATION_VERSION:
         raise ValueError(
             f"cagra serialization version mismatch: got {version}, "
-            f"expected {_SERIALIZATION_VERSION}")
+            f"expected {_MIN_READ_VERSION}..{_SERIALIZATION_VERSION}")
     metric = int(ser.deserialize_scalar(res, body))
     dataset = jnp.asarray(ser.deserialize_mdspan(res, body))
     graph = jnp.asarray(ser.deserialize_mdspan(res, body))
-    return Index(dataset=dataset, graph=graph, metric=metric)
+    index = Index(dataset=dataset, graph=graph, metric=metric)
+    if version >= 2:
+        index.canaries = _canary.from_stream(res, body)
+    return index
 
 
 def save(res, filename: str, index: Index, *, retry_policy=None,
@@ -1959,7 +2006,12 @@ def save(res, filename: str, index: Index, *, retry_policy=None,
 
 
 def load(res, filename: str, *, retry_policy=None, deadline=None) -> Index:
-    """File-load overload; transient IO retries, corruption fails fast."""
+    """File-load overload; transient IO retries, corruption fails fast.
+
+    Indexes carrying recall canaries are health-checked before being
+    returned (see :func:`raft_tpu.integrity.health_check`)."""
     from raft_tpu.resilience import load_index
-    return load_index("cagra.load", lambda b: deserialize(res, b),
-                      filename, retry_policy, deadline)
+    index = load_index("cagra.load", lambda b: deserialize(res, b),
+                       filename, retry_policy, deadline)
+    _canary.auto_check(res, index, site="load")
+    return index

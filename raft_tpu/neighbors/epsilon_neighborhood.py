@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.distance.pairwise import pairwise_distance
+from raft_tpu.integrity import boundary as _boundary
 from raft_tpu.distance.types import DistanceType
 from raft_tpu.core.outputs import raw
 
@@ -37,6 +38,12 @@ def eps_neighbors_l2sq(
     """
     x = ensure_array(x, "x")
     y = ensure_array(y, "y")
+    x, ok_rows = _boundary.check_matrix(x, "x",
+                                        site="eps_neighbors_l2sq")
+    y, _ = _boundary.check_matrix(y, "y", site="eps_neighbors_l2sq")
     d = raw(pairwise_distance)(x, y, DistanceType.L2Unexpanded)
     adj = d < eps_sq
+    if ok_rows is not None:
+        # masked x rows report no neighbors rather than eps-balls around 0
+        adj = adj & ok_rows[:, None]
     return adj, jnp.sum(adj, axis=1).astype(jnp.int32)

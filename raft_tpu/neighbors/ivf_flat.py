@@ -37,6 +37,8 @@ from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.core.tracing import range as named_range
 from raft_tpu import observability as obs
+from raft_tpu.integrity import boundary as _boundary
+from raft_tpu.integrity import canary as _canary
 from raft_tpu.distance.types import DistanceType
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.utils.precision import get_matmul_precision
@@ -55,6 +57,12 @@ class IndexParams:
     kmeans_trainset_fraction: float = 0.5
     adaptive_centers: bool = False
     add_data_on_build: bool = True
+    # recall canaries (raft_tpu.integrity): > 0 samples that many sentinel
+    # queries at build, stores their exact neighbors in the index, and
+    # health-checks recall against the floor after load()/extend()
+    canary_queries: int = 0
+    canary_k: int = 10
+    canary_floor: float = 0.5
 
 
 @dataclasses.dataclass
@@ -93,6 +101,10 @@ class Index:
     # fp32, loop-invariant across searches (recomputing it per call costs
     # a full pass over the raw vectors).  Lazily attached by search().
     list_data_sq: Optional[jax.Array] = None
+    # Recall-canary sentinel set (integrity.CanarySet) — host-side
+    # metadata, deliberately NOT a pytree leaf (aux must stay hashable),
+    # so jax transforms drop it; build/extend/serialize carry it.
+    canaries: Optional[object] = None
 
     @property
     def n_lists(self) -> int:
@@ -153,7 +165,7 @@ def _pack_lists(dataset: jax.Array, labels: jax.Array, source_ids: jax.Array,
 @jax.jit
 def _append_lists_multi(bufs, rows, list_idx: jax.Array,
                         list_sizes: jax.Array, new_labels: jax.Array,
-                        new_ids: jax.Array):
+                        new_ids: jax.Array, lane_bufs=(), lane_rows=()):
     """Scatter-append rows into existing padded lists — the O(n_new)
     extend fast path (callers must have verified no list overflows the
     current capacity).  The reference's extend likewise appends in place
@@ -162,7 +174,10 @@ def _append_lists_multi(bufs, rows, list_idx: jax.Array,
 
     ``bufs``/``rows`` are matching tuples of per-list storages and their
     new rows (IVF-PQ appends codes + recon cache + recon norms in one
-    pass); the slot layout is computed once and shared."""
+    pass); the slot layout is computed once and shared.  ``lane_bufs`` /
+    ``lane_rows`` are lane-major (n_lists, X, capacity) storages (the
+    packed-code-lane cache) whose new (n_new, X) rows scatter at
+    ``[label, :, slot]``."""
     n_lists = list_sizes.shape[0]
     n_new = new_ids.shape[0]
     order = jnp.argsort(new_labels)
@@ -173,8 +188,12 @@ def _append_lists_multi(bufs, rows, list_idx: jax.Array,
     slot = list_sizes[sl] + (jnp.arange(n_new) - starts[sl])
     bufs = tuple(b.at[sl, slot].set(r[order].astype(b.dtype))
                  for b, r in zip(bufs, rows))
+    lane_bufs = tuple(
+        b.at[sl[:, None], jnp.arange(b.shape[1])[None, :],
+             slot[:, None]].set(r[order].astype(b.dtype))
+        for b, r in zip(lane_bufs, lane_rows))
     list_idx = list_idx.at[sl, slot].set(new_ids[order].astype(jnp.int32))
-    return bufs, list_idx, list_sizes + new_counts
+    return bufs, lane_bufs, list_idx, list_sizes + new_counts
 
 
 def _append_lists(list_data: jax.Array, list_idx: jax.Array,
@@ -182,7 +201,7 @@ def _append_lists(list_data: jax.Array, list_idx: jax.Array,
                   new_labels: jax.Array, new_ids: jax.Array
                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Single-payload convenience wrapper over _append_lists_multi."""
-    (list_data,), list_idx, sizes = _append_lists_multi(
+    (list_data,), _, list_idx, sizes = _append_lists_multi(
         (list_data,), (new_rows,), list_idx, list_sizes, new_labels,
         new_ids)
     return list_data, list_idx, sizes
@@ -199,6 +218,9 @@ def build(res, params: IndexParams, dataset) -> Index:
             obs.build_scope("ivf_flat.build") as rep:
         dataset = ensure_array(dataset, "dataset")
         expects(dataset.ndim == 2, "ivf_flat.build: 2-D dataset required")
+        dataset, _ = _boundary.check_matrix(dataset, "dataset",
+                                            site="ivf_flat.build",
+                                            allow_empty=False)
         n, dim = dataset.shape
         expects(params.n_lists <= n, "ivf_flat.build: n_lists > n_rows")
 
@@ -242,6 +264,13 @@ def build(res, params: IndexParams, dataset) -> Index:
         if params.add_data_on_build:
             index = extend(res, index, dataset,
                            jnp.arange(n, dtype=jnp.int32))
+            if params.canary_queries > 0:
+                cs = _canary.make(res, dataset, metric=params.metric,
+                                  n_queries=params.canary_queries,
+                                  k=params.canary_k,
+                                  floor=params.canary_floor)
+                index.canaries = cs
+                cs.build_recall = _canary.measure(res, index, cs)
         return rep.attach(index)
 
 
@@ -259,6 +288,9 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
         new_vectors = ensure_array(new_vectors, "new_vectors")
         expects(new_vectors.ndim == 2 and new_vectors.shape[1] == index.dim,
                 "ivf_flat.extend: dim mismatch")
+        new_vectors, _ = _boundary.check_matrix(
+            new_vectors, "new_vectors", site="ivf_flat.extend",
+            dim=index.dim)
         n_new = new_vectors.shape[0]
         if new_indices is None:
             new_indices = index.size + jnp.arange(n_new, dtype=jnp.int32)
@@ -286,7 +318,7 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
                     bufs.append(index.list_data_sq)
                     rows.append(jnp.sum(
                         new_vectors.astype(jnp.float32) ** 2, axis=-1))
-                new_bufs, list_idx, sizes = _append_lists_multi(
+                new_bufs, _, list_idx, sizes = _append_lists_multi(
                     tuple(bufs), tuple(rows), index.list_indices,
                     index.list_sizes, new_labels, new_indices)
                 st.fence(new_bufs)
@@ -309,11 +341,15 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
                     centers = centers / jnp.maximum(
                         jnp.linalg.norm(centers, axis=1, keepdims=True),
                         1e-12)
-            return Index(centers=centers, list_data=list_data,
-                         list_indices=list_idx, list_sizes=sizes,
-                         metric=index.metric,
-                         adaptive_centers=index.adaptive_centers,
-                         list_data_sq=data_sq)
+            out = Index(centers=centers, list_data=list_data,
+                        list_indices=list_idx, list_sizes=sizes,
+                        metric=index.metric,
+                        adaptive_centers=index.adaptive_centers,
+                        list_data_sq=data_sq)
+            if index.canaries is not None:
+                out.canaries = index.canaries
+                _canary.auto_check(res, out, site="extend")
+            return out
 
         # slow path: existing rows, flattened back out of the padded storage
         old_valid = index.list_indices >= 0
@@ -327,7 +363,10 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
         all_ids = jnp.concatenate([old_ids, new_indices.astype(jnp.int32)])
         all_labels = jnp.concatenate([old_labels, new_labels])
 
-        capacity = _round_up(max(int(jnp.max(needed)), _LIST_ALIGN),
+        # +1 before rounding: a repack must never leave the fullest list
+        # brim-full (max exactly on an alignment boundary), or the very
+        # next one-row extend is forced back onto this O(n) path
+        capacity = _round_up(max(int(jnp.max(needed)) + 1, _LIST_ALIGN),
                              _LIST_ALIGN)
         with obs.stage("ivf_flat.extend.pack") as st:
             list_data, list_idx, sizes = _pack_lists(
@@ -347,10 +386,14 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
                 centers = centers / jnp.maximum(
                     jnp.linalg.norm(centers, axis=1, keepdims=True), 1e-12)
 
-        return Index(centers=centers, list_data=list_data,
-                     list_indices=list_idx, list_sizes=sizes,
-                     metric=index.metric,
-                     adaptive_centers=index.adaptive_centers)
+        out = Index(centers=centers, list_data=list_data,
+                    list_indices=list_idx, list_sizes=sizes,
+                    metric=index.metric,
+                    adaptive_centers=index.adaptive_centers)
+        if index.canaries is not None:
+            out.canaries = index.canaries
+            _canary.auto_check(res, out, site="extend")
+        return out
 
 
 @functools.partial(jax.jit, static_argnames=("k", "n_probes", "metric",
@@ -544,11 +587,29 @@ def search(res, params: SearchParams, index: Index, queries, k: int
        ``None`` leaf to an array leaf — code that captured the index in
        a jitted closure before the first search will retrace once, and
        tree-structure comparisons across that boundary will differ.
+
+    Queries pass through the boundary validator (see
+    :mod:`raft_tpu.integrity.boundary`): under policy ``mask``,
+    non-finite query rows return id -1 / worst distance instead of
+    poisoning the batch.
     """
+    queries = ensure_array(queries, "queries")
+    queries, ok_rows = _boundary.check_matrix(
+        queries, "queries", site="ivf_flat.search", dim=index.dim)
+    # legacy shape guard: still fires when the validator policy is "off"
+    expects(queries.ndim == 2 and queries.shape[1] == index.dim,
+            "ivf_flat.search: query dim mismatch")
+    dist, ids = _search_checked(res, params, index, queries, k)
+    if ok_rows is not None:
+        dist, ids = _boundary.mask_search_outputs(
+            dist, ids, ok_rows,
+            select_min=index.metric != DistanceType.InnerProduct)
+    return dist, ids
+
+
+def _search_checked(res, params: SearchParams, index: Index, queries,
+                    k: int) -> Tuple[jax.Array, jax.Array]:
     with named_range("ivf_flat::search"):
-        queries = ensure_array(queries, "queries")
-        expects(queries.ndim == 2 and queries.shape[1] == index.dim,
-                "ivf_flat.search: query dim mismatch")
         from raft_tpu.neighbors import grouped
 
         n_probes = min(params.n_probes, index.n_lists)
@@ -632,7 +693,9 @@ def search(res, params: SearchParams, index: Index, queries, k: int
 # serialization (reference: ivf_flat_serialize.cuh; version hard-checked)
 # ---------------------------------------------------------------------------
 
-_SERIALIZATION_VERSION = 1
+# v2: trailing recall-canary block (nested envelope, may be absent)
+_SERIALIZATION_VERSION = 2
+_MIN_READ_VERSION = 1
 
 
 def serialize(res, stream: BinaryIO, index: Index) -> None:
@@ -645,6 +708,7 @@ def serialize(res, stream: BinaryIO, index: Index) -> None:
         for arr in (index.centers, index.list_data, index.list_indices,
                     index.list_sizes):
             ser.serialize_mdspan(res, body, arr)
+        _canary.to_stream(res, body, index.canaries)
 
 
 def deserialize(res, stream: BinaryIO) -> Index:
@@ -653,15 +717,18 @@ def deserialize(res, stream: BinaryIO) -> Index:
     envelope), never load as garbage arrays."""
     body = ser.open_envelope(stream)
     version = int(ser.deserialize_scalar(res, body))
-    if version != _SERIALIZATION_VERSION:
+    if not _MIN_READ_VERSION <= version <= _SERIALIZATION_VERSION:
         raise ValueError(
             f"ivf_flat serialization version mismatch: got {version}, "
-            f"expected {_SERIALIZATION_VERSION}")  # reference hard-fails too
+            f"expected {_MIN_READ_VERSION}..{_SERIALIZATION_VERSION}")
     metric = int(ser.deserialize_scalar(res, body))
     adaptive = bool(ser.deserialize_scalar(res, body))
     arrays = [jnp.asarray(ser.deserialize_mdspan(res, body))
               for _ in range(4)]
-    return Index(*arrays, metric=metric, adaptive_centers=adaptive)
+    index = Index(*arrays, metric=metric, adaptive_centers=adaptive)
+    if version >= 2:
+        index.canaries = _canary.from_stream(res, body)
+    return index
 
 
 def save(res, filename: str, index: Index, *, retry_policy=None,
@@ -675,7 +742,12 @@ def save(res, filename: str, index: Index, *, retry_policy=None,
 
 def load(res, filename: str, *, retry_policy=None, deadline=None) -> Index:
     """File-load overload; transient IO errors retry, corruption raises
-    :class:`~raft_tpu.core.serialize.CorruptIndexError` immediately."""
+    :class:`~raft_tpu.core.serialize.CorruptIndexError` immediately.
+
+    Indexes carrying recall canaries are health-checked before being
+    returned (see :func:`raft_tpu.integrity.health_check`)."""
     from raft_tpu.resilience import _load_index
-    return _load_index("ivf_flat.load", lambda b: deserialize(res, b),
-                       filename, retry_policy, deadline)
+    index = _load_index("ivf_flat.load", lambda b: deserialize(res, b),
+                        filename, retry_policy, deadline)
+    _canary.auto_check(res, index, site="load")
+    return index

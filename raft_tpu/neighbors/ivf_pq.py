@@ -50,6 +50,8 @@ from raft_tpu.core.interruptible import interruptible
 from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.core.tracing import range as named_range
 from raft_tpu import observability as obs
+from raft_tpu.integrity import boundary as _boundary
+from raft_tpu.integrity import canary as _canary
 from raft_tpu.distance.types import DistanceType
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.neighbors.ivf_flat import (_append_lists_multi, _pack_lists,
@@ -83,6 +85,12 @@ class IndexParams:
     # Set False for datasets whose reconstructions would not fit HBM; search
     # then uses the memory-lean LUT formulation.
     cache_reconstructions: bool = True
+    # Recall canaries (integrity.canary): > 0 samples that many sentinel
+    # queries at build, stores their exact neighbors inside the index and
+    # re-checks recall after load()/extend()/resume (floor below).
+    canary_queries: int = 0
+    canary_k: int = 10
+    canary_floor: float = 0.5
 
 
 @dataclasses.dataclass
@@ -200,6 +208,11 @@ class Index:
     # packed byte width, not pq_dim); 0 -> equal to the code width (the
     # pq_bits=8 layout where packing is the identity)
     pq_dim_: int = 0
+    # Recall-canary sentinel set (integrity.CanarySet) — host-side
+    # metadata, deliberately NOT a pytree leaf (and not aux data either:
+    # aux must stay hashable for jit caching), so it does not survive
+    # jax transforms; build/extend/serialize carry it explicitly.
+    canaries: Optional[object] = None
 
     @property
     def n_lists(self) -> int:
@@ -425,6 +438,9 @@ def build(res, params: IndexParams, dataset, *,
             obs.build_scope("ivf_pq.build") as rep:
         dataset = ensure_array(dataset, "dataset")
         expects(dataset.ndim == 2, "ivf_pq.build: 2-D dataset required")
+        dataset, _ = _boundary.check_matrix(dataset, "dataset",
+                                            site="ivf_pq.build",
+                                            allow_empty=False)
         n, dim = dataset.shape
         expects(params.n_lists <= n, "ivf_pq.build: n_lists > n_rows")
         expects(4 <= params.pq_bits <= 8,
@@ -516,6 +532,14 @@ def build(res, params: IndexParams, dataset, *,
             with obs.stage("ivf_pq.build.recon_cache") as st:
                 index = _with_recon(res, index)
                 st.fence(index.list_recon)
+        if params.canary_queries > 0 and params.add_data_on_build:
+            cs = _canary.make(res, dataset, metric=params.metric,
+                              n_queries=params.canary_queries,
+                              k=params.canary_k, floor=params.canary_floor)
+            index.canaries = cs
+            cs.build_recall = _canary.measure(res, index, cs)
+            if resume:
+                _canary.auto_check(res, index, site="resume")
         return rep.attach(index)
 
 
@@ -559,6 +583,8 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
     """Encode + add vectors (reference: ivf_pq.cuh:266 ``extend``)."""
     with named_range("ivf_pq::extend"):
         new_vectors = ensure_array(new_vectors, "new_vectors")
+        new_vectors, _ = _boundary.check_matrix(
+            new_vectors, "new_vectors", site="ivf_pq.extend", dim=index.dim)
         expects(new_vectors.ndim == 2 and new_vectors.shape[1] == index.dim,
                 "ivf_pq.extend: dim mismatch")
         n_new = new_vectors.shape[0]
@@ -573,6 +599,11 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
         # several copies of the dataset and OOM a single chip; per-chunk
         # the peak extra memory is O(chunk * rot_dim)
         chunk = 1 << 20
+        # the decoded rows also feed the code-lane cache's row norms, so
+        # a lean codes-mode index (lanes attached, no bf16 recon) still
+        # gets coherent appended norms
+        want_recon_rows = (index.list_recon is not None
+                           or index.list_code_lanes is not None)
         with obs.stage("ivf_pq.extend.encode") as st:
             codes_parts, label_parts, recon_parts = [], [], []
             for s0 in range(0, n_new, chunk):
@@ -584,7 +615,7 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
                                           index.pq_dim)
                 cu = _encode(index.codebooks, resid_c, index.codebook_kind,
                              lab_c)
-                if index.list_recon is not None:
+                if want_recon_rows:
                     recon_parts.append(_decode_rows(index.codebooks, cu,
                                                     lab_c,
                                                     index.codebook_kind))
@@ -595,7 +626,7 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
             labels = (jnp.concatenate(label_parts)
                       if len(label_parts) > 1 else label_parts[0])
             recon_rows = None
-            if index.list_recon is not None:
+            if want_recon_rows:
                 recon_rows = (jnp.concatenate(recon_parts)
                               if len(recon_parts) > 1 else recon_parts[0])
             st.fence(codes, labels)
@@ -608,20 +639,40 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
         # (one (n_lists,)-reduction host sync decides; see ivf_flat.extend)
         if int(jnp.max(needed)) <= index.capacity:
             with obs.stage("ivf_pq.extend.pack") as st:
-                bufs, rows = [index.list_codes], [codes]
+                rsq_rows = (jnp.sum(recon_rows.astype(jnp.float32) ** 2,
+                                    axis=-1)
+                            if recon_rows is not None else None)
+                # every derived cache appends at the same slots in the
+                # same scatter pass — `at[name]` records each buffer's
+                # position in the returned tuple
+                bufs, rows, at = [index.list_codes], [codes], {}
+
+                def _add(name, buf, row):
+                    at[name] = len(bufs)
+                    bufs.append(buf)
+                    rows.append(row)
+
                 if index.list_recon is not None:
-                    # the new rows' decoded residuals (+ norms, computed in
-                    # the encode chunks above) append into the caches at the
-                    # same slots, in the same scatter pass
-                    bufs.append(index.list_recon)
-                    rows.append(recon_rows)
+                    _add("recon", index.list_recon, recon_rows)
                     if index.list_recon_sq is not None:
-                        bufs.append(index.list_recon_sq)
-                        rows.append(jnp.sum(
-                            recon_rows.astype(jnp.float32) ** 2, axis=-1))
-                new_bufs, list_idx, sizes = _append_lists_multi(
+                        _add("recon_sq", index.list_recon_sq, rsq_rows)
+                # the code-lane cache's row norms may alias list_recon_sq
+                # (see _with_code_lanes) — append the shared buffer once
+                rsq_shared = (index.list_code_lanes is not None
+                              and index.list_code_rsq is not None
+                              and index.list_code_rsq is index.list_recon_sq
+                              and "recon_sq" in at)
+                lane_bufs, lane_rows = (), ()
+                if index.list_code_lanes is not None:
+                    from raft_tpu.ops import pq_code_scan_pallas as pcs
+                    lane_bufs = (index.list_code_lanes,)
+                    lane_rows = (pcs.pack_row_lanes(codes),)
+                    if index.list_code_rsq is not None and not rsq_shared:
+                        _add("code_rsq", index.list_code_rsq, rsq_rows)
+                new_bufs, new_lanes, list_idx, sizes = _append_lists_multi(
                     tuple(bufs), tuple(rows), index.list_indices,
-                    index.list_sizes, labels, new_indices)
+                    index.list_sizes, labels, new_indices,
+                    lane_bufs, lane_rows)
                 st.fence(new_bufs)
             out = Index(
                 centers=index.centers, codebooks=index.codebooks,
@@ -630,9 +681,23 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
                 metric=index.metric, codebook_kind=index.codebook_kind,
                 pq_bits=index.pq_bits, pq_dim_=index.pq_dim)
             if index.list_recon is not None:
-                out.list_recon = new_bufs[1]
-                out.list_recon_sq = (new_bufs[2] if len(new_bufs) > 2
+                out.list_recon = new_bufs[at["recon"]]
+                out.list_recon_sq = (new_bufs[at["recon_sq"]]
+                                     if "recon_sq" in at
                                      else _recon_sq(out.list_recon))
+            if index.list_code_lanes is not None:
+                out.list_code_lanes = new_lanes[0]
+                if rsq_shared:
+                    out.list_code_rsq = out.list_recon_sq
+                elif "code_rsq" in at:
+                    out.list_code_rsq = new_bufs[at["code_rsq"]]
+            # int8 recon caches are NOT carried: their per-list symmetric
+            # scale was chosen from the pre-extend residual range, so
+            # appended rows could overflow it — the next recon8 search
+            # re-quantizes lazily (integrity.verify flags a stale copy)
+            if index.canaries is not None:
+                out.canaries = index.canaries
+                _canary.auto_check(res, out, site="extend")
             return out
 
         # flatten existing + concat + repack (same dance as ivf_flat.extend)
@@ -646,7 +711,10 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
         all_ids = jnp.concatenate([old_ids, new_indices.astype(jnp.int32)])
         all_labels = jnp.concatenate([old_labels, labels])
 
-        capacity = _round_up(max(int(jnp.max(needed)), _LIST_ALIGN),
+        # +1 before rounding: never leave the fullest list brim-full after
+        # a repack (see ivf_flat.extend) — a build lands here via the empty
+        # index, so this also guarantees every fresh build has append room
+        capacity = _round_up(max(int(jnp.max(needed)) + 1, _LIST_ALIGN),
                              _LIST_ALIGN)
         with obs.stage("ivf_pq.extend.pack") as st:
             list_codes, list_idx, sizes = _pack_lists(
@@ -664,6 +732,15 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
         # index never materializes (n, rot_dim) reconstructions
         if index.list_recon is not None:
             out = _with_recon(res, out)
+        # repack moved every row, so scan caches rebuild from the fresh
+        # codes rather than arriving cold at the next search
+        if index.list_code_lanes is not None:
+            out = _with_code_lanes(out)
+        if index.list_recon_i8 is not None:
+            out = _with_recon8(out)
+        if index.canaries is not None:
+            out.canaries = index.canaries
+            _canary.auto_check(res, out, site="extend")
         return out
 
 
@@ -1227,6 +1304,11 @@ def search(res, params: SearchParams, index: Index, queries, k: int
     the LUT / XLA formulations off-TPU or for unsupported shapes, so the
     same call works on every backend.
 
+    Queries pass through the boundary validator (see
+    :mod:`raft_tpu.integrity.boundary`): under policy ``mask``,
+    non-finite query rows return id -1 / worst distance instead of
+    poisoning the batch.
+
     .. note:: the first search may mutate ``index`` in place, lazily
        attaching derived caches (``list_recon``/``list_recon_sq``, the
        codes-lane and int8 caches of their scan modes, the group count
@@ -1234,10 +1316,23 @@ def search(res, params: SearchParams, index: Index, queries, k: int
        the registered pytree structure can change after the first search
        (one retrace for jitted closures over the index).
     """
+    queries = ensure_array(queries, "queries")
+    queries, ok_rows = _boundary.check_matrix(
+        queries, "queries", site="ivf_pq.search", dim=index.dim)
+    # legacy shape guard: still fires when the validator policy is "off"
+    expects(queries.ndim == 2 and queries.shape[1] == index.dim,
+            "ivf_pq.search: query dim mismatch")
+    dist, ids = _search_checked(res, params, index, queries, k)
+    if ok_rows is not None:
+        dist, ids = _boundary.mask_search_outputs(
+            dist, ids, ok_rows,
+            select_min=index.metric != DistanceType.InnerProduct)
+    return dist, ids
+
+
+def _search_checked(res, params: SearchParams, index: Index, queries,
+                    k: int) -> Tuple[jax.Array, jax.Array]:
     with named_range("ivf_pq::search"):
-        queries = ensure_array(queries, "queries")
-        expects(queries.ndim == 2 and queries.shape[1] == index.dim,
-                "ivf_pq.search: query dim mismatch")
         n_probes = min(params.n_probes, index.n_lists)
         coarse_rt = getattr(params, "coarse_recall_target", 0.95)
         exact_coarse = getattr(params, "exact_coarse", False)
@@ -1429,7 +1524,9 @@ def search(res, params: SearchParams, index: Index, queries, k: int
 # ---------------------------------------------------------------------------
 
 # v2: list_codes are bit-packed; pq_dim is stored explicitly
-_SERIALIZATION_VERSION = 2
+# v3: trailing recall-canary block (nested envelope, may be absent)
+_SERIALIZATION_VERSION = 3
+_MIN_READ_VERSION = 2
 
 
 def serialize(res, stream: BinaryIO, index: Index) -> None:
@@ -1443,6 +1540,7 @@ def serialize(res, stream: BinaryIO, index: Index) -> None:
         for arr in (index.centers, index.codebooks, index.list_codes,
                     index.list_indices, index.list_sizes, index.rotation):
             ser.serialize_mdspan(res, body, arr)
+        _canary.to_stream(res, body, index.canaries)
 
 
 def deserialize(res, stream: BinaryIO, *,
@@ -1451,10 +1549,10 @@ def deserialize(res, stream: BinaryIO, *,
     :class:`~raft_tpu.core.serialize.CorruptIndexError`."""
     body = ser.open_envelope(stream)
     version = int(ser.deserialize_scalar(res, body))
-    if version != _SERIALIZATION_VERSION:
+    if not _MIN_READ_VERSION <= version <= _SERIALIZATION_VERSION:
         raise ValueError(
             f"ivf_pq serialization version mismatch: got {version}, "
-            f"expected {_SERIALIZATION_VERSION}")
+            f"expected {_MIN_READ_VERSION}..{_SERIALIZATION_VERSION}")
     metric = int(ser.deserialize_scalar(res, body))
     kind = int(ser.deserialize_scalar(res, body))
     pq_bits = int(ser.deserialize_scalar(res, body))
@@ -1463,6 +1561,8 @@ def deserialize(res, stream: BinaryIO, *,
               for _ in range(6)]
     index = Index(*arrays, metric=metric, codebook_kind=kind,
                   pq_bits=pq_bits, pq_dim_=pq_dim)
+    if version >= 3:
+        index.canaries = _canary.from_stream(res, body)
     # the reconstruction cache is derived state: re-decode from codes —
     # unless the caller opted out (indexes too large for the cache, the
     # same regime as IndexParams.cache_reconstructions=False)
@@ -1481,10 +1581,17 @@ def save(res, filename: str, index: Index, *, retry_policy=None,
 
 def load(res, filename: str, *, cache_reconstructions: bool = True,
          retry_policy=None, deadline=None) -> Index:
-    """File-load overload; transient IO retries, corruption fails fast."""
+    """File-load overload; transient IO retries, corruption fails fast.
+
+    Indexes carrying recall canaries are health-checked before being
+    returned: a loaded index whose recall dropped below the stored floor
+    raises :class:`~raft_tpu.integrity.IntegrityError` here, not in
+    production traffic."""
     from raft_tpu.resilience import load_index
-    return load_index(
+    index = load_index(
         "ivf_pq.load",
         lambda b: deserialize(
             res, b, cache_reconstructions=cache_reconstructions),
         filename, retry_policy, deadline)
+    _canary.auto_check(res, index, site="load")
+    return index

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.core.tracing import range as named_range
+from raft_tpu.integrity import boundary as _boundary
 from raft_tpu import observability as obs
 from raft_tpu.distance.types import DistanceType
 from raft_tpu.matrix.select_k import select_k
@@ -87,7 +88,13 @@ def refine(
                            DistanceType.L2SqrtUnexpanded,
                            DistanceType.InnerProduct),
                 "refine: L2 / InnerProduct metrics only (as the reference)")
+        queries, ok_rows = _boundary.check_matrix(
+            queries, "queries", site="refine", dim=dataset.shape[1])
         with obs.stage("refine") as st:
             out = _refine_impl(dataset, queries, candidates, k, metric)
             st.fence(out)
+        if ok_rows is not None:
+            out = _boundary.mask_search_outputs(
+                out[0], out[1], ok_rows,
+                select_min=metric != DistanceType.InnerProduct)
         return out

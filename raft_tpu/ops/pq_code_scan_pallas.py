@@ -87,6 +87,19 @@ def pack_code_lanes(list_codes: jax.Array) -> jax.Array:
     return jnp.transpose(words, (0, 2, 1))
 
 
+def pack_row_lanes(codes: jax.Array) -> jax.Array:
+    """(n, W) uint8 packed code rows -> (n, Wi) int32 lane words — the
+    row-wise twin of :func:`pack_code_lanes`, used by the extend fast
+    path to scatter-append into the lane-major cache without re-packing
+    the whole index."""
+    n, W = codes.shape
+    Wi = -(-W // 4)
+    b = jnp.pad(codes, ((0, 0), (0, Wi * 4 - W)))
+    b = b.astype(jnp.int32).reshape(n, Wi, 4)
+    shifts = (8 * jnp.arange(4, dtype=jnp.int32))[None, None, :]
+    return jnp.sum(jax.lax.shift_left(b, shifts), axis=-1)
+
+
 def _decode_reconT(codes_ref, cb_ref, pq_dim, pq_bits, rot_pad, cap):
     """In-register decode of one list's codes to (rot_pad, cap) bf16 —
     the transposed recon block.  Python-unrolled over subspaces: the

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import compat
 from ..core.mdarray import ensure_array
 from ..core.error import expects
 
@@ -201,7 +202,7 @@ def solve(res, cost, *, maximize: bool = False) -> LapSolution:
         schedule.append(eps)
         eps = max(1, eps // _EPS_FACTOR)
 
-    with jax.enable_x64():   # int64 device arrays (no f64 ever on device)
+    with compat.enable_x64():   # int64 device arrays (no f64 ever on device)
         sched = jnp.asarray(schedule, jnp.int64)
         assign, owner, prices, profit = jax.vmap(
             lambda b: _solve_grid(b, sched, n))(jnp.asarray(benefit))

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""CI guard: public entry points must run the boundary validator.
+
+Every module-level public entry point in ``raft_tpu/neighbors`` and
+``raft_tpu/cluster`` that accepts user arrays (build / search / extend /
+fit / predict / ...) must route them through
+``raft_tpu.integrity.boundary`` (``check_matrix`` / ``guard_nonfinite``),
+either directly or by delegating to a same-module function that does.
+This keeps the PR 4 input-hardening contract from silently eroding as
+entry points are added.
+
+Usage: python scripts/check_boundary_guard.py   (exits 1 on violations)
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+PACKAGES = ("raft_tpu/neighbors", "raft_tpu/cluster")
+
+# entry-point names that take user arrays and must validate them
+GUARDED = {
+    "build", "search", "extend", "fit", "predict", "transform",
+    "fit_predict", "knn", "knn_query", "all_knn_query", "build_index",
+    "eps_neighbors_l2sq", "refine",
+}
+VALIDATORS = {"check_matrix", "guard_nonfinite"}
+
+
+def _calls_validator(fn: ast.FunctionDef) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and node.attr in VALIDATORS:
+            return True
+        if isinstance(node, ast.Name) and node.id in VALIDATORS:
+            return True
+    return False
+
+
+def _local_callees(fn: ast.FunctionDef) -> set:
+    """Names a function may delegate to: direct calls, but also bare
+    references (``raw(fit)(...)`` wraps ``fit`` before calling it)."""
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            out.add(node.attr)
+    return out
+
+
+def check_file(path: pathlib.Path) -> list:
+    tree = ast.parse(path.read_text(), filename=str(path))
+    fns = {n.name: n for n in tree.body if isinstance(n, ast.FunctionDef)}
+
+    # fixed point: a function is "checked" if it calls a validator, or
+    # calls a same-module function that is checked (delegation)
+    checked = {name for name, fn in fns.items() if _calls_validator(fn)}
+    changed = True
+    while changed:
+        changed = False
+        for name, fn in fns.items():
+            if name in checked:
+                continue
+            if _local_callees(fn) & checked:
+                checked.add(name)
+                changed = True
+
+    try:
+        path = path.relative_to(ROOT)
+    except ValueError:
+        pass
+    return [
+        f"{path}:{fn.lineno}: public entry point "
+        f"'{name}' never reaches the boundary validator "
+        f"(raft_tpu.integrity.boundary.check_matrix)"
+        for name, fn in sorted(fns.items())
+        if name in GUARDED and name not in checked
+    ]
+
+
+def main() -> int:
+    violations = []
+    for pkg in PACKAGES:
+        for path in sorted((ROOT / pkg).glob("*.py")):
+            violations.extend(check_file(path))
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"\n{len(violations)} unguarded entry point(s); wire "
+              "check_matrix/guard_nonfinite at the boundary (see "
+              "docs/api.md, 'Integrity & validation').")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
