@@ -417,6 +417,198 @@ def bench_brute_force(res, db, queries) -> dict:
     }
 
 
+SERVING_N = 100_000            # 100k-index serving smoke (CI job)
+SERVING_MAX_BATCH = 256
+SERVING_K = 10
+
+
+def bench_serving(res, db, queries, *, build_param=None, search_param=None,
+                  k=SERVING_K, max_batch=SERVING_MAX_BATCH,
+                  max_wait_us=1000.0, clients=8, request_rows=32,
+                  duration_s=2.0, offered_fraction=0.7) -> list:
+    """Online serving over a warmed IVF-PQ index vs the raw batch path.
+
+    Closed loop (``clients`` synchronous threads, ``request_rows`` rows
+    per request) measures ``serving_qps_sustained``; the acceptance bar
+    is >= 80% of raw-batch QPS at the same (index, params, max_batch)
+    operating point.  Open loop at ``offered_fraction`` of the measured
+    capacity reports ``serving_p99_ms`` (client-observed submit->result,
+    cross-checked against the ``serving.latency.total`` histogram).  The
+    ``xla.compiles`` counter is sampled around the measured window —
+    steady state must be recompile-free (the closed bucket-shape
+    contract; CI fails the smoke job otherwise).
+    """
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu import observability as obs
+    from raft_tpu import serving
+    from raft_tpu.neighbors import ivf_pq
+
+    bp = build_param or {"nlist": 1024, "pq_dim": 32}
+    spc = search_param or {"nprobe": 32}
+    index = ivf_pq.build(
+        res, ivf_pq.IndexParams(n_lists=bp["nlist"], pq_dim=bp["pq_dim"],
+                                kmeans_n_iters=bp.get("kmeans_n_iters", 10)),
+        db)
+    sp = ivf_pq.SearchParams(n_probes=spc["nprobe"])
+    q = np.asarray(queries)                 # clients submit host data
+    reps = int(np.ceil(max_batch / q.shape[0])) if q.shape[0] < max_batch \
+        else 1
+    if reps > 1:
+        q = np.concatenate([q] * reps)
+
+    # raw batch reference: full max_batch batches, per-batch readback
+    # (matches the serving dispatch, which reads each batch back)
+    qb = jnp.asarray(q[:max_batch])
+    d, i = ivf_pq.search(res, sp, index, qb, k)            # warmup
+    jax.block_until_ready((d, i))
+    iters = max(8, int(2.0 / max(_timed_batch(res, sp, index, qb, k), 1e-4)))
+    iters = min(iters, 200)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        d, i = ivf_pq.search(res, sp, index, qb, k)
+        np.asarray(i)
+    raw_qps = iters * max_batch / (time.perf_counter() - t0)
+
+    ex = serving.Executor(res, "ivf_pq", index, ks=(k,),
+                          max_batch=max_batch, search_params=sp)
+    out = []
+    with obs.collecting():
+        cfg = serving.ServerConfig(max_batch=max_batch,
+                                   max_wait_us=max_wait_us,
+                                   max_queue_rows=max_batch * 16)
+        with serving.Server(ex, cfg) as srv:
+            # ramp: settle residual one-time compiles (host transfers,
+            # mask ops) before the measured window
+            for m in (1, request_rows, max_batch):
+                srv.search(q[:m], k)
+            c0 = obs.registry().counter("xla.compiles").value
+
+            # ---- closed loop ----------------------------------------
+            done = [0] * clients
+            stop_at = time.perf_counter() + duration_s
+
+            def client(j):
+                base = (j * 131) % max(1, q.shape[0] - request_rows)
+                sub = q[base:base + request_rows]
+                while time.perf_counter() < stop_at:
+                    srv.search(sub, k)
+                    done[j] += sub.shape[0]
+
+            ts = [threading.Thread(target=client, args=(j,))
+                  for j in range(clients)]
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            serving_qps = sum(done) / (time.perf_counter() - t0)
+            recompiles = (obs.registry().counter("xla.compiles").value
+                          - c0)
+
+            # ---- open loop ------------------------------------------
+            rate = max(serving_qps * offered_fraction, request_rows)
+            interval = request_rows / rate
+            lats, futs = [], []
+            t_end = time.perf_counter() + duration_s
+            next_t = time.perf_counter()
+            while time.perf_counter() < t_end:
+                lag = next_t - time.perf_counter()
+                if lag > 0:
+                    time.sleep(lag)
+                t_sub = time.perf_counter()
+                f = srv.submit(q[:request_rows], k)
+                f.add_done_callback(
+                    lambda fut, t=t_sub:
+                    lats.append(time.perf_counter() - t))
+                futs.append(f)
+                next_t += interval
+            for f in futs:
+                f.result(timeout=30.0)
+            snap = obs.snapshot()
+        hist = snap.get("histograms", {}).get("serving.latency.total", {})
+        fill = snap.get("histograms", {}).get("serving.batch_fill", {})
+
+    p50, p95, p99 = (float(v) * 1e3
+                     for v in np.percentile(lats, [50, 95, 99]))
+    out.append({
+        "metric": "serving_qps_sustained",
+        "value": round(serving_qps, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(serving_qps / max(raw_qps, 1e-9), 3),
+        "detail": {"raw_batch_qps": round(raw_qps, 1),
+                   "fraction_of_raw": round(serving_qps
+                                            / max(raw_qps, 1e-9), 3),
+                   "recompiles_steady": int(recompiles),
+                   "clients": clients, "request_rows": request_rows,
+                   "max_batch": max_batch, "max_wait_us": max_wait_us,
+                   "batch_fill_p50": fill.get("p50")},
+    })
+    out.append({
+        "metric": "serving_p99_ms",
+        "value": round(p99, 3),
+        "unit": "ms",
+        "vs_baseline": 1.0,
+        "detail": {"p50_ms": round(p50, 3), "p95_ms": round(p95, 3),
+                   "offered_rows_per_s": round(rate, 1),
+                   "requests": len(lats),
+                   "hist_p99_ms": (round(hist["p99"] * 1e3, 3)
+                                   if hist.get("p99") is not None
+                                   else None)},
+    })
+    return out
+
+
+def _timed_batch(res, sp, index, qb, k) -> float:
+    from raft_tpu.neighbors import ivf_pq
+    t0 = time.perf_counter()
+    np.asarray(ivf_pq.search(res, sp, index, qb, k)[1])
+    return time.perf_counter() - t0
+
+
+def run_serving(conf_path: str) -> int:
+    """``--serving`` mode: the CI serving smoke.  Builds the conf's
+    dataset + index, runs :func:`bench_serving`, prints its metric
+    lines, and FAILS (exit 1) on steady-state recompiles or sustained
+    throughput under ``min_qps_fraction_of_raw``."""
+    from raft_tpu import DeviceResources
+
+    with open(conf_path) as f:
+        conf = json.load(f)
+    res = DeviceResources(seed=0)
+    db, queries = _make_dataset(conf["dataset"])
+    s = conf["serving"]
+    lines = bench_serving(
+        res, db, queries,
+        build_param=s.get("build_param"),
+        search_param=s.get("search_param"),
+        k=s.get("k", SERVING_K),
+        max_batch=s.get("max_batch", SERVING_MAX_BATCH),
+        max_wait_us=s.get("max_wait_us", 1000.0),
+        clients=s.get("clients", 8),
+        request_rows=s.get("request_rows", 32),
+        duration_s=s.get("duration_s", 2.0),
+        offered_fraction=s.get("offered_fraction", 0.7))
+    for line in lines:
+        print(json.dumps(line), flush=True)
+    qps_line = lines[0]["detail"]
+    failures = []
+    if qps_line["recompiles_steady"] != 0:
+        failures.append(f"{qps_line['recompiles_steady']} XLA recompiles "
+                        "in steady state (want 0 after warmup)")
+    bar = s.get("min_qps_fraction_of_raw", 0.8)
+    if qps_line["fraction_of_raw"] < bar:
+        failures.append(
+            f"sustained serving QPS is {qps_line['fraction_of_raw']:.2f}x "
+            f"raw batch QPS (bar: {bar:.2f}x)")
+    for msg in failures:
+        print(f"SERVING SMOKE FAIL: {msg}", flush=True)
+    return 1 if failures else 0
+
+
 PAIRWISE_N, PAIRWISE_DIM = 5000, 50
 
 
@@ -763,6 +955,10 @@ def main() -> None:
     print(json.dumps(bench_ivf_pq(res, db, queries, gt_i)), flush=True)
     print(json.dumps(bench_kmeans(res, db[:KMEANS_N])), flush=True)
     print(json.dumps(bench_mnmg(res)), flush=True)
+    # online serving over a 100k slice of the same dataset (the CI
+    # smoke runs the conf/serving-smoke.json variant of this)
+    for line in bench_serving(res, db[:SERVING_N], queries[:2048]):
+        print(json.dumps(line), flush=True)
     print(json.dumps({"integrity_counters": _integrity_counters()}),
           flush=True)
 
@@ -774,5 +970,11 @@ if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--conf":
         _setup_jax_cache()
         run_conf(sys.argv[2])
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--serving":
+        _setup_jax_cache()
+        conf = sys.argv[2] if len(sys.argv) >= 3 else \
+            os.path.join(os.path.dirname(__file__), "conf",
+                         "serving-smoke.json")
+        sys.exit(run_serving(conf))
     else:
         main()

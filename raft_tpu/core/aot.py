@@ -25,7 +25,9 @@ reference's serialized index + prebuilt kernels.
 from __future__ import annotations
 
 import io
-from typing import BinaryIO, Callable, Sequence
+import threading
+import weakref
+from typing import BinaryIO, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -113,6 +115,103 @@ def load_search_fn(stream: BinaryIO) -> Callable:
         return call(*arrays, queries)
 
     return g
+
+
+# ---------------------------------------------------------------------------
+# executable cache — bucket-shaped warm executors for the serving layer
+# ---------------------------------------------------------------------------
+
+class ExecutableCache:
+    """Process cache of loaded search executables, keyed per bucket shape.
+
+    The serving layer pre-warms one executable per *bucket* — the same
+    index exported at several batch sizes (1, 2, 4, ... max_batch).  The
+    cache key therefore includes EVERY shape the export was specialized
+    to: ``(kind, index identity, batch, k, n_probes, extra...)``.  Keying
+    on the index alone (the obvious first cut) collides the buckets —
+    every bucket would get the executable of whichever batch size warmed
+    first, and steady-state traffic at the other sizes would re-trace.
+
+    Index identity is ``id(index)`` *validated through a weakref*: a hit
+    whose stored referent is no longer the keyed object (the id was
+    recycled after a gc) is treated as a miss and re-exported, so a dead
+    index can never serve another index's executables.
+
+    Loaded callables dispatch through jax's primitive cache keyed on the
+    (stable) exported-program identity and argument avals: the serving
+    warmup calls each bucket's executable once, after which steady-state
+    traffic at any warmed bucket shape triggers zero recompiles.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: Dict[Tuple, Tuple[weakref.ref, Callable]] = {}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def get(self, kind: str, res, index, *, batch: int, k: int,
+            n_probes: int = 0, scan_mode: Optional[str] = None,
+            **export_kwargs) -> Callable:
+        """The warmed ``g(queries) -> (distances, indices)`` for one
+        bucket, exporting + loading on first use.
+
+        ``kind`` is one of ``"ivf_pq" | "ivf_flat" | "brute_force" |
+        "cagra"``; ``batch`` is the bucket's (padded) query count and is
+        part of the cache key.  Extra keyword arguments are forwarded to
+        the exporter (and keyed on, sorted by name).
+        """
+        extra = tuple(sorted(export_kwargs.items()))
+        key = (kind, id(index), int(batch), int(k), int(n_probes),
+               scan_mode, extra)
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None and hit[0]() is index:
+                return hit[1]
+        g = self._export_load(kind, res, index, batch=batch, k=k,
+                              n_probes=n_probes, scan_mode=scan_mode,
+                              **export_kwargs)
+        with self._lock:
+            self._entries[key] = (weakref.ref(index), g)
+        return g
+
+    def _export_load(self, kind: str, res, index, *, batch: int, k: int,
+                     n_probes: int, scan_mode: Optional[str],
+                     **export_kwargs) -> Callable:
+        if kind == "ivf_pq":
+            buf = export_ivf_pq_search(
+                res, index, n_probes=n_probes, k=k, batch=batch,
+                scan_mode=scan_mode or "recon", **export_kwargs)
+        elif kind == "ivf_flat":
+            buf = export_ivf_flat_search(res, index, n_probes=n_probes,
+                                         k=k, batch=batch, **export_kwargs)
+        elif kind == "brute_force":
+            buf = export_brute_force_knn(res, index, k=k, batch=batch,
+                                         **export_kwargs)
+        elif kind == "cagra":
+            buf = export_cagra_search(res, index, k=k, batch=batch,
+                                      **export_kwargs)
+        else:
+            expects(False, f"aot: unknown executable kind {kind!r}")
+        # NOT wrapped in an outer jit: an exported call dispatches through
+        # the primitive cache keyed on (exported identity, avals) — warm
+        # once, then zero recompiles — while jit(g) would re-lower the
+        # program with the index arrays embedded as constants (a second
+        # compile AND a second copy of the index in device memory)
+        return load_search_fn(buf)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+_EXECUTABLES = ExecutableCache()
+
+
+def executables() -> ExecutableCache:
+    """The process-global executable cache (serving warms into this)."""
+    return _EXECUTABLES
 
 
 def export_ivf_pq_search(res, index, n_probes: int, k: int, batch: int,

@@ -42,17 +42,25 @@ def _is_floating(x) -> bool:
     return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
 
 
-def guard_nonfinite(x, *, site: str, policy: Optional[str] = None
+def guard_nonfinite(x, *, site: str, policy: Optional[str] = None,
+                    host: bool = False
                     ) -> Tuple[jax.Array, Optional[jax.Array]]:
     """Policy-driven non-finite guard over the rows of ``x``.
 
     Returns ``(x, ok_rows)`` where ``ok_rows`` is a per-row bool vector
     under policy ``mask`` (callers use it to flag outputs) and ``None``
     otherwise.  Non-floating inputs pass through untouched.
+
+    ``host=True`` runs the identical policy in numpy and returns host
+    arrays — for callers validating request-shaped data whose sizes are
+    unbounded (the serving submit path), where a per-shape device
+    compile would break the zero-recompile contract.
     """
     p = policy if policy is not None else config.get_validation_policy()
     if p == "off":
         return x, None
+    if host:
+        return _guard_nonfinite_host(x, site=site, policy=p)
     x = jnp.asarray(x)
     if not _is_floating(x):
         return x, None
@@ -84,8 +92,39 @@ def guard_nonfinite(x, *, site: str, policy: Optional[str] = None
     return clean, ok
 
 
+def _guard_nonfinite_host(x, *, site: str, policy: str
+                          ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """Numpy twin of the device guard — same policy semantics, same
+    counters, zero device work (and therefore zero compiles)."""
+    x = np.asarray(x)
+    if not jnp.issubdtype(x.dtype, jnp.floating):  # dtype-level, no transfer
+        return x, None
+    if obs.enabled():
+        obs.registry().counter("integrity.boundary.checks").inc()
+    reduce_axes = tuple(range(1, x.ndim))
+    ok = np.all(np.isfinite(x.astype(np.float32)), axis=reduce_axes)
+    if policy == "raise":
+        if not bool(np.all(ok)):
+            bad = int(np.argmin(ok))
+            if obs.enabled():
+                obs.registry().counter("integrity.boundary.raised").inc()
+            raise ValidationError(
+                f"{site}: non-finite values in input row {bad} "
+                f"(policy 'raise'; use config.validation_policy('mask') "
+                f"to flag rows instead, or 'off' for trusted inputs)",
+                invariant="boundary.nonfinite", coord=(bad,))
+        return x, None
+    shape_ok = ok.reshape(ok.shape + (1,) * (x.ndim - 1))
+    clean = np.where(shape_ok, x, np.zeros((), x.dtype))
+    if obs.enabled():
+        obs.registry().counter("integrity.boundary.masked_rows").inc(
+            int(np.sum(~ok)))
+    return clean, ok
+
+
 def check_matrix(x, name: str, *, site: str, dim: Optional[int] = None,
-                 allow_empty: bool = True, policy: Optional[str] = None
+                 allow_empty: bool = True, policy: Optional[str] = None,
+                 host: bool = False
                  ) -> Tuple[jax.Array, Optional[jax.Array]]:
     """Structural + non-finite validation for a 2-D input.
 
@@ -109,7 +148,7 @@ def check_matrix(x, name: str, *, site: str, dim: Optional[int] = None,
         raise ValidationError(
             f"{site}: {name} has no rows",
             invariant="boundary.empty")
-    return guard_nonfinite(x, site=site, policy=p)
+    return guard_nonfinite(x, site=site, policy=p, host=host)
 
 
 def mask_search_outputs(distances: jax.Array, indices: jax.Array,

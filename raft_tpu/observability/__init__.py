@@ -23,7 +23,9 @@ Quick tour::
 
 from raft_tpu.observability.registry import (
     Counter,
+    DEFAULT_HISTOGRAM_BOUNDS,
     Gauge,
+    Histogram,
     MetricsRegistry,
     Timer,
     collecting,
@@ -40,7 +42,9 @@ from raft_tpu.observability.report import BuildReport, build_report, build_scope
 
 __all__ = [
     "Counter",
+    "DEFAULT_HISTOGRAM_BOUNDS",
     "Gauge",
+    "Histogram",
     "MetricsRegistry",
     "Timer",
     "BuildReport",

@@ -57,4 +57,18 @@ def to_prometheus(snapshot: Optional[Dict] = None, *,
         lines.append(f"{pname}_min {t['min_s']}")
         lines.append(f"{pname}_max {t['max_s']}")
         lines.append(f"{pname}_last {t['last_s']}")
+    for name, h in sorted(snapshot.get("histograms", {}).items()):
+        pname = _prom_name(name, prefix)
+        lines.append(f"# TYPE {pname} histogram")
+        cum = 0
+        for le, c in zip(h["bounds"], h["counts"]):
+            cum += c
+            lines.append(f'{pname}_bucket{{le="{le}"}} {cum}')
+        lines.append(f'{pname}_bucket{{le="+Inf"}} {h["count"]}')
+        lines.append(f"{pname}_sum {h['sum']}")
+        lines.append(f"{pname}_count {h['count']}")
+        # pre-computed quantile gauges: native histograms carry no
+        # quantiles, but p50/p95/p99 are the numbers dashboards want
+        for q in ("p50", "p95", "p99"):
+            lines.append(f"{pname}_{q} {h[q]}")
     return "\n".join(lines) + "\n"

@@ -19,10 +19,11 @@ close on worker threads (e.g. host callbacks, jax.monitoring listeners).
 
 from __future__ import annotations
 
+import bisect
 import contextlib
 import math
 import threading
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator, Optional, Sequence, Tuple
 
 
 class Counter:
@@ -96,6 +97,87 @@ class Timer:
         }
 
 
+# Default histogram buckets: log-spaced (factor 2) from 1 µs to ~67 s —
+# wide enough for both per-query serving latencies and build stages.  27
+# finite upper bounds + one overflow bucket; fixed at construction so
+# ``observe`` is one bisect + one increment under the registry lock.
+DEFAULT_HISTOGRAM_BOUNDS: Tuple[float, ...] = tuple(
+    1e-6 * 2.0 ** i for i in range(27))
+
+
+class Histogram:
+    """Fixed-bucket distribution (e.g. ``serving.latency.total``).
+
+    Log-spaced upper bounds by default (:data:`DEFAULT_HISTOGRAM_BOUNDS`);
+    values are dimensionless to the registry — record seconds for
+    latencies, rows for batch fills.  Like every metric here the *call
+    sites* are collection-gated: while ``enabled()`` is False no library
+    code calls :meth:`observe`, so a disabled histogram is zero work.
+
+    Quantiles (p50/p95/p99) are estimated by linear interpolation inside
+    the target bucket — resolution is the bucket width (a factor of 2 by
+    default), which is the standard Prometheus-histogram tradeoff.
+    """
+
+    __slots__ = ("name", "bounds", "counts", "count", "sum", "min", "max",
+                 "_lock")
+
+    def __init__(self, name: str, lock: threading.RLock,
+                 bounds: Optional[Sequence[float]] = None) -> None:
+        self.name = name
+        self.bounds = tuple(float(b) for b in
+                            (bounds if bounds is not None
+                             else DEFAULT_HISTOGRAM_BOUNDS))
+        assert list(self.bounds) == sorted(self.bounds), \
+            "histogram bounds must be sorted"
+        self.counts = [0] * (len(self.bounds) + 1)   # +1: overflow bucket
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = 0.0
+        self._lock = lock
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        idx = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            self.counts[idx] += 1
+            self.count += 1
+            self.sum += value
+            self.min = min(self.min, value)
+            self.max = max(self.max, value)
+
+    def quantile(self, q: float) -> float:
+        """Estimated value at quantile ``q`` in [0, 1] (0.0 when empty)."""
+        with self._lock:
+            if self.count == 0:
+                return 0.0
+            target = q * self.count
+            seen = 0.0
+            for i, c in enumerate(self.counts):
+                if seen + c >= target and c > 0:
+                    lo = self.bounds[i - 1] if i > 0 else 0.0
+                    hi = self.bounds[i] if i < len(self.bounds) else self.max
+                    frac = (target - seen) / c
+                    return min(lo + frac * (hi - lo), self.max)
+                seen += c
+            return self.max
+
+    def as_dict(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "count": self.count,
+                "sum": self.sum,
+                "min": self.min if self.count else 0.0,
+                "max": self.max,
+                "p50": self.quantile(0.50),
+                "p95": self.quantile(0.95),
+                "p99": self.quantile(0.99),
+                "bounds": list(self.bounds),
+                "counts": list(self.counts),
+            }
+
+
 class MetricsRegistry:
     """Named metric store with get-or-create accessors and snapshot/reset."""
 
@@ -104,6 +186,7 @@ class MetricsRegistry:
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._timers: Dict[str, Timer] = {}
+        self._histograms: Dict[str, Histogram] = {}
 
     def counter(self, name: str) -> Counter:
         with self._lock:
@@ -126,6 +209,17 @@ class MetricsRegistry:
                 m = self._timers[name] = Timer(name, self._lock)
             return m
 
+    def histogram(self, name: str,
+                  bounds: Optional[Sequence[float]] = None) -> Histogram:
+        """Get-or-create; ``bounds`` applies only at creation (the first
+        caller fixes the bucket layout, like a Prometheus registration)."""
+        with self._lock:
+            m = self._histograms.get(name)
+            if m is None:
+                m = self._histograms[name] = Histogram(name, self._lock,
+                                                       bounds)
+            return m
+
     def snapshot(self) -> Dict[str, Dict]:
         """Point-in-time copy: plain dicts, safe to mutate / serialize."""
         with self._lock:
@@ -133,6 +227,8 @@ class MetricsRegistry:
                 "counters": {n: c.value for n, c in self._counters.items()},
                 "gauges": {n: g.value for n, g in self._gauges.items()},
                 "timers": {n: t.as_dict() for n, t in self._timers.items()},
+                "histograms": {n: h.as_dict()
+                               for n, h in self._histograms.items()},
             }
 
     def reset(self) -> None:
@@ -140,6 +236,7 @@ class MetricsRegistry:
             self._counters.clear()
             self._gauges.clear()
             self._timers.clear()
+            self._histograms.clear()
 
 
 # ---------------------------------------------------------------------------

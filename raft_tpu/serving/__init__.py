@@ -1,0 +1,69 @@
+"""raft_tpu.serving — online query serving over the batch indexes.
+
+Every entry point below this package is batch-shaped: ``search()`` wants
+a pre-formed query matrix.  This package is the request scheduler that
+turns those batch kernels into an online service (the layer every
+RAFT-class deployment interposes between users and the GPU/TPU):
+
+- :mod:`~raft_tpu.serving.buckets` — the closed shape set: power-of-two
+  query-count buckets, fixed k/n_probes per bucket, padded rows flagged
+  through the integrity mask path (id -1 / worst distance);
+- :mod:`~raft_tpu.serving.admission` — bounded queue with typed
+  :class:`Overloaded` load-shedding, per-tenant :class:`TokenBucket`
+  quotas, deadline-aware queueing on
+  :class:`~raft_tpu.resilience.retry.Deadline`;
+- :mod:`~raft_tpu.serving.batcher` — the dynamic batcher: dispatch on
+  ``max_batch`` OR ``max_wait_us``, whichever first;
+- :mod:`~raft_tpu.serving.executor` — bucket-warmed executors over
+  IVF-PQ / IVF-Flat / CAGRA / brute force (AOT-exported via
+  ``core/aot``) and :mod:`raft_tpu.distributed.ann` (jit-warmed;
+  degraded-mode shard masking and ``health_check`` compose unchanged);
+- :mod:`~raft_tpu.serving.server` — the ``Server`` front end:
+  ``submit() -> Future``, boundary validation per request, serving
+  counters + latency histograms at enqueue→dispatch→complete.
+
+Quick tour::
+
+    from raft_tpu import serving
+    ex = serving.Executor(res, "ivf_pq", index, ks=(10,),
+                          max_batch=1024, search_params=sp)
+    with serving.Server(ex, serving.ServerConfig(max_wait_us=500)) as srv:
+        d, i = srv.search(queries[:3], k=10)
+"""
+
+from raft_tpu.serving.admission import (  # noqa: F401
+    AdmissionQueue,
+    Overloaded,
+    QuotaExceeded,
+    Request,
+    TokenBucket,
+)
+from raft_tpu.serving.batcher import DynamicBatcher  # noqa: F401
+from raft_tpu.serving.buckets import (  # noqa: F401
+    bucket_for,
+    bucket_sizes,
+    pad_rows,
+    valid_rows_mask,
+)
+from raft_tpu.serving.executor import (  # noqa: F401
+    DistributedExecutor,
+    Executor,
+)
+from raft_tpu.serving.server import Server, ServerConfig  # noqa: F401
+
+__all__ = [
+    "AdmissionQueue",
+    "DistributedExecutor",
+    "DynamicBatcher",
+    "Executor",
+    "Overloaded",
+    "QuotaExceeded",
+    "Request",
+    "Server",
+    "ServerConfig",
+    "TokenBucket",
+    "bucket_for",
+    "bucket_sizes",
+    "pad_rows",
+    "valid_rows_mask",
+]

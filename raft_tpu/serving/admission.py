@@ -1,0 +1,173 @@
+"""Admission control — bounded queueing, load shedding, tenant quotas.
+
+The serving layer's survival rules, applied BEFORE a request touches an
+accelerator: a bounded queue with typed :class:`Overloaded` rejection
+(the load-shedding contract: clients see an immediate, retryable error
+instead of unbounded latency), per-tenant token-bucket quotas
+(:class:`QuotaExceeded`), and deadline awareness — a request whose
+:class:`~raft_tpu.resilience.retry.Deadline` is already spent is refused
+at the door, and one that expires while queued is completed with
+:class:`~raft_tpu.resilience.retry.DeadlineExceededError` at dispatch
+instead of wasting a bucket slot.
+
+Counters (collection-gated): ``serving.admitted``,
+``serving.shed.queue_full``, ``serving.shed.quota``,
+``serving.shed.deadline``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, Optional, Tuple
+
+from raft_tpu import observability as obs
+from raft_tpu.core.error import RaftError
+from raft_tpu.resilience.retry import Deadline
+
+
+class Overloaded(RaftError):
+    """The server shed this request (queue full).  Retryable by the
+    client after backoff — the serving analogue of
+    :class:`~raft_tpu.resilience.faults.TransientFault`."""
+
+
+class QuotaExceeded(Overloaded):
+    """The tenant's token bucket is empty.  A subclass of
+    :class:`Overloaded` so quota-blind clients need one handler."""
+
+
+class TokenBucket:
+    """Per-tenant rate limiter: ``rate`` tokens/s, ``burst`` capacity.
+
+    One token per query row (not per request), so a 100-row submission
+    spends 100 tokens — quota units are rows/s of accelerator work.
+    """
+
+    __slots__ = ("rate", "burst", "_tokens", "_t_last", "_clock", "_lock")
+
+    def __init__(self, rate: float, burst: float,
+                 clock=time.monotonic) -> None:
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._tokens = float(burst)
+        self._clock = clock
+        self._t_last = clock()
+        self._lock = threading.Lock()
+
+    def try_acquire(self, n: float = 1.0) -> bool:
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._t_last) * self.rate)
+            self._t_last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+
+@dataclasses.dataclass
+class Request:
+    """One queued submission (host-side bookkeeping around a Future)."""
+
+    queries: object               # (n, dim) array, already boundary-checked
+    k: int
+    tenant: str
+    deadline: Optional[Deadline]
+    future: Future
+    n: int                        # row count (static, pre-pad)
+    t_enqueue: float              # time.monotonic at admission
+    # per-row validity from the boundary validator under policy "mask"
+    # (None under "raise"/"off"); applied to this request's output slice
+    ok_rows: Optional[object] = None
+
+
+class AdmissionQueue:
+    """Bounded FIFO of :class:`Request` with quota + shed policy.
+
+    ``max_queue_rows`` bounds the total queued *rows* (the unit the
+    executor pays for).  ``quotas`` maps tenant name -> (rate, burst) in
+    rows/s; absent tenants are unmetered.
+    """
+
+    def __init__(self, max_queue_rows: int,
+                 quotas: Optional[Dict[str, Tuple[float, float]]] = None,
+                 clock=time.monotonic) -> None:
+        self._max_rows = int(max_queue_rows)
+        self._clock = clock
+        self._buckets = {t: TokenBucket(r, b, clock)
+                         for t, (r, b) in (quotas or {}).items()}
+        self._lock = threading.Lock()
+        self.cond = threading.Condition(self._lock)
+        self._items: list = []
+        self._rows = 0
+
+    # ---- admission ------------------------------------------------------
+
+    def offer(self, req: Request) -> None:
+        """Admit or shed (raises :class:`Overloaded` / subclasses)."""
+        if req.deadline is not None and req.deadline.expired:
+            _count("serving.shed.deadline")
+            raise Overloaded(
+                "serving: request deadline already expired at submit")
+        bucket = self._buckets.get(req.tenant)
+        if bucket is not None and not bucket.try_acquire(req.n):
+            _count("serving.shed.quota")
+            raise QuotaExceeded(
+                f"serving: tenant {req.tenant!r} exceeded its quota "
+                f"({bucket.rate:g} rows/s, burst {bucket.burst:g})")
+        with self.cond:
+            if self._rows + req.n > self._max_rows:
+                _count("serving.shed.queue_full")
+                raise Overloaded(
+                    f"serving: queue full ({self._rows} rows queued, "
+                    f"bound {self._max_rows}) — retry with backoff")
+            self._items.append(req)
+            self._rows += req.n
+            _count("serving.admitted")
+            if obs.enabled():
+                obs.registry().gauge("serving.queue_depth").set(self._rows)
+            self.cond.notify_all()
+
+    # ---- dispatcher side (call with ``cond`` held) ----------------------
+
+    def peek_oldest(self) -> Optional[Request]:
+        return self._items[0] if self._items else None
+
+    def cut_batch(self, max_rows: int) -> list:
+        """Pop the FIFO head run: requests sharing the head's ``k`` whose
+        rows fit in ``max_rows``.  Expired requests are popped and
+        returned too — the dispatcher completes them with
+        DeadlineExceededError without spending bucket rows on them."""
+        out, rows, batch_k = [], 0, None
+        while self._items:
+            head = self._items[0]
+            expired = head.deadline is not None and head.deadline.expired
+            if not expired:
+                if batch_k is not None and head.k != batch_k:
+                    break           # k is fixed per bucket; next cut gets it
+                if rows + head.n > max_rows:
+                    break
+                batch_k = head.k
+                rows += head.n
+            self._items.pop(0)
+            self._rows -= head.n
+            out.append(head)
+        if obs.enabled():
+            obs.registry().gauge("serving.queue_depth").set(self._rows)
+        return out
+
+    @property
+    def rows(self) -> int:
+        return self._rows
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+
+def _count(name: str) -> None:
+    if obs.enabled():
+        obs.registry().counter(name).inc()

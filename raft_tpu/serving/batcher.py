@@ -1,0 +1,186 @@
+"""Dynamic batcher — coalesce submissions into padded bucket batches.
+
+One dispatcher thread drains the admission queue and cuts batches under
+the policy the reference-class serving stacks use (and the ISSUE names):
+dispatch when the pending rows reach ``max_batch`` OR the oldest queued
+request has waited ``max_wait_us`` — whichever comes first.  A cut batch
+is concatenated, zero-padded up to its bucket (powers of two — see
+:mod:`raft_tpu.serving.buckets`), searched through the warmed executor,
+and sliced back per request.
+
+Timing uses ``time.monotonic`` (the deadline clock) — wall-profiling
+belongs to :func:`raft_tpu.observability.stage`, but the batcher needs
+timestamps even when collection is off, because ``max_wait`` and
+deadlines are control flow, not telemetry.  Histograms
+(``serving.latency.queue``, ``.exec``, ``.total`` seconds and
+``serving.batch_fill``) are recorded only while collection is enabled.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu import observability as obs
+from raft_tpu.resilience.retry import DeadlineExceededError
+from raft_tpu.serving.admission import AdmissionQueue
+from raft_tpu.serving.buckets import bucket_for
+
+
+class DynamicBatcher:
+    """Owns the dispatcher thread between an admission queue and an
+    executor (``raft_tpu.serving.executor.Executor``)."""
+
+    def __init__(self, queue: AdmissionQueue, executor, *,
+                 max_batch: int, max_wait_us: float,
+                 on_batch: Optional[Callable] = None) -> None:
+        self.queue = queue
+        self.executor = executor
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_us) * 1e-6
+        self._on_batch = on_batch
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop = False
+        self._thread = threading.Thread(target=self._run,
+                                        name="raft-tpu-serving-batcher",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self, drain: bool = True) -> None:
+        """Stop the dispatcher.  With ``drain`` (default) queued requests
+        are dispatched first; otherwise they fail with Overloaded."""
+        if self._thread is None:
+            return
+        with self.queue.cond:
+            self._drain = drain
+            self._stop = True
+            self.queue.cond.notify_all()
+        self._thread.join(timeout=30.0)
+        self._thread = None
+
+    # ---- dispatcher loop ------------------------------------------------
+
+    def _run(self) -> None:
+        self._drain = True
+        while True:
+            batch = None
+            with self.queue.cond:
+                while True:
+                    if self._stop and (not self._drain or not len(self.queue)):
+                        break
+                    oldest = self.queue.peek_oldest()
+                    if oldest is None:
+                        self.queue.cond.wait(timeout=0.1)
+                        continue
+                    waited = time.monotonic() - oldest.t_enqueue
+                    if (self.queue.rows >= self.max_batch
+                            or waited >= self.max_wait_s
+                            or self._stop):
+                        batch = self.queue.cut_batch(self.max_batch)
+                        break
+                    # no timeout underrun: wake exactly when the oldest
+                    # request hits max_wait (or earlier on new arrivals)
+                    self.queue.cond.wait(timeout=self.max_wait_s - waited)
+            if batch:
+                self._dispatch(batch)
+            elif self._stop:
+                self._fail_remaining()
+                return
+
+    def _fail_remaining(self) -> None:
+        from raft_tpu.serving.admission import Overloaded
+        with self.queue.cond:
+            rest = self.queue.cut_batch(10 ** 9)
+            while rest:
+                for r in rest:
+                    r.future.set_exception(
+                        Overloaded("serving: server stopped"))
+                rest = self.queue.cut_batch(10 ** 9)
+
+    # ---- one batch ------------------------------------------------------
+
+    def _dispatch(self, batch) -> None:
+        t_dispatch = time.monotonic()
+        live = []
+        for r in batch:
+            if r.deadline is not None and r.deadline.expired:
+                _count("serving.expired")
+                r.future.set_exception(DeadlineExceededError(
+                    f"serving: deadline expired after "
+                    f"{t_dispatch - r.t_enqueue:.3f}s in queue"))
+            else:
+                live.append(r)
+        if not live:
+            return
+        k = live[0].k
+        n = sum(r.n for r in live)
+        bucket = bucket_for(n, self.max_batch)
+        # batch assembly and result slicing are HOST-side numpy: request
+        # sizes vary continuously, and any jnp op keyed on them
+        # (concatenate / pad / slice) would compile per novel shape —
+        # breaking the zero-recompile contract the buckets exist for.
+        # The device only ever sees the warmed (bucket, dim) shapes.
+        buf = np.zeros((bucket, self.executor.dim),
+                       dtype=self.executor.query_dtype)
+        off = 0
+        for r in live:
+            buf[off:off + r.n] = np.asarray(r.queries)
+            off += r.n
+        try:
+            d, i = self.executor.search_bucket(jnp.asarray(buf), n, k)
+            d, i = np.asarray(d), np.asarray(i)     # one host readback
+        except BaseException as e:  # noqa: BLE001 - forwarded per request
+            for r in live:
+                r.future.set_exception(e)
+            return
+        t_done = time.monotonic()
+        self._record(live, n, bucket, t_dispatch, t_done)
+        off = 0
+        worst = np.inf if self.executor.select_min else -np.inf
+        for r in live:
+            rd = d[off:off + r.n]
+            ri = i[off:off + r.n]
+            if r.ok_rows is not None:
+                # per-request boundary mask (policy "mask"): same output
+                # contract as integrity.boundary.mask_search_outputs,
+                # applied host-side on the already-fetched slice
+                bad = ~np.asarray(r.ok_rows)[:, None]
+                rd = np.where(bad, np.asarray(worst, rd.dtype), rd)
+                ri = np.where(bad, np.asarray(-1, ri.dtype), ri)
+            off += r.n
+            r.future.set_result((rd, ri))
+        if self._on_batch is not None:
+            self._on_batch(n, bucket)
+
+    def _record(self, live, n, bucket, t_dispatch, t_done) -> None:
+        if not obs.enabled():
+            return
+        reg = obs.registry()
+        reg.counter("serving.batches").inc()
+        reg.counter("serving.batched_rows").inc(n)
+        reg.counter("serving.padded_rows").inc(bucket - n)
+        reg.histogram("serving.batch_fill",
+                      bounds=[i / 16 for i in range(1, 17)]).observe(
+                          n / bucket)
+        h_queue = reg.histogram("serving.latency.queue")
+        h_total = reg.histogram("serving.latency.total")
+        for r in live:
+            h_queue.observe(t_dispatch - r.t_enqueue)
+            h_total.observe(t_done - r.t_enqueue)
+        reg.histogram("serving.latency.exec").observe(t_done - t_dispatch)
+
+
+def _count(name: str) -> None:
+    if obs.enabled():
+        obs.registry().counter(name).inc()

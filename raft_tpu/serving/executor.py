@@ -1,0 +1,243 @@
+"""Bucket-shaped search executors over the existing indexes.
+
+One executor wraps one searchable index (IVF-PQ / IVF-Flat / CAGRA /
+brute force, or a :mod:`raft_tpu.distributed.ann` sharded index) and
+serves a CLOSED set of (batch, k) shapes — the buckets.  ``warmup()``
+compiles every bucket once at server start; after that, a dispatch at
+any bucket shape is a cache hit (zero recompiles, the steady-state
+contract the serving bench asserts via the ``xla.compiles`` counter).
+
+Two warm paths:
+
+``"aot"`` (default for IVF-PQ / IVF-Flat / brute force)
+    Executables come from :func:`raft_tpu.core.aot.executables` — the
+    index is exported per bucket (StableHLO) and reloaded, the same
+    artifact shape a compile-free deployment process would load.  Falls
+    back to ``"jit"`` per bucket when an exporter refuses (e.g. CAGRA's
+    calibration-dependent fallback walk).
+``"jit"``
+    The live module search functions, warmed by calling each bucket
+    shape once.  The only choice for distributed indexes (shard_map
+    closures over a mesh are not exportable) — degraded-mode shard
+    masking and post-load ``health_check`` compose unchanged because the
+    executor calls the same public entry points.
+
+Padded rows are flagged through the integrity mask path
+(:func:`~raft_tpu.integrity.boundary.mask_search_outputs`): id -1 /
+worst distance, exactly like a masked non-finite row.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Callable, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu import observability as obs
+from raft_tpu.core.aot import executables as _aot_executables
+from raft_tpu.core.error import expects
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.integrity import boundary as _boundary
+from raft_tpu.serving.buckets import bucket_sizes, pad_rows, valid_rows_mask
+
+_KINDS = ("ivf_pq", "ivf_flat", "cagra", "brute_force")
+
+
+class Executor:
+    """Warmed bucket-shaped search over one local index.
+
+    ``search_params`` is the algorithm's SearchParams (n_probes etc.) —
+    fixed for the executor's lifetime, part of every bucket's compiled
+    shape.  ``ks`` is the closed set of supported k values.
+    """
+
+    def __init__(self, res, kind: str, index, *, ks: Sequence[int] = (10,),
+                 max_batch: int = 1024, search_params=None,
+                 warm: str = "aot") -> None:
+        expects(kind in _KINDS,
+                f"serving: unknown executor kind {kind!r} (one of {_KINDS})")
+        expects(warm in ("aot", "jit"),
+                f"serving: warm mode must be 'aot' or 'jit', got {warm!r}")
+        self.res = res
+        self.kind = kind
+        self.index = index
+        self.ks = tuple(int(k) for k in ks)
+        self.max_batch = int(max_batch)
+        self.params = search_params
+        self.warm = warm
+        self.buckets = bucket_sizes(self.max_batch)
+        self._fns: Dict[Tuple[int, int], Callable] = {}
+        self._warmed = False
+
+    # ---- geometry -------------------------------------------------------
+
+    @property
+    def dim(self) -> int:
+        if self.kind == "brute_force":
+            return int(self.index.shape[1])
+        return int(self.index.dim)
+
+    @property
+    def select_min(self) -> bool:
+        metric = getattr(self.index, "metric", DistanceType.L2Expanded)
+        return metric != DistanceType.InnerProduct
+
+    @property
+    def query_dtype(self):
+        if self.kind == "brute_force":
+            return self.index.dtype
+        if self.kind == "cagra":
+            return self.index.dataset.dtype
+        return self.index.centers.dtype
+
+    # ---- warmup ---------------------------------------------------------
+
+    def warmup(self) -> int:
+        """Compile every (bucket, k) once; returns the number of warmed
+        executables.  Idempotent."""
+        if self._warmed:
+            return len(self._fns)
+        for b in self.buckets:
+            for k in self.ks:
+                zeros = jnp.zeros((b, self.dim), self.query_dtype)
+                # b-1 valid rows also warms the padded-row mask ops at
+                # this bucket shape (mask shape is n_valid-independent)
+                d, i = self.search_bucket(zeros, max(1, b - 1), k)
+                jax.block_until_ready((d, i))
+                if obs.enabled():
+                    obs.registry().counter("serving.warmed_executables").inc()
+        self._warmed = True
+        return len(self._fns)
+
+    def _obtain(self, bucket: int, k: int) -> Callable:
+        key = (bucket, k)
+        fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        fn = None
+        if self.warm == "aot":
+            try:
+                fn = self._aot_fn(bucket, k)
+            except Exception as e:  # noqa: BLE001 - exporter refusal
+                warnings.warn(
+                    f"serving: AOT export failed for {self.kind} bucket "
+                    f"({bucket}, {k}) — falling back to live search: {e}",
+                    stacklevel=2)
+        if fn is None:
+            fn = self._live_fn(k)
+        self._fns[key] = fn
+        return fn
+
+    def _aot_fn(self, bucket: int, k: int) -> Callable:
+        cache = _aot_executables()
+        if self.kind == "ivf_pq":
+            n_probes = min(self.params.n_probes, self.index.n_lists)
+            mode = getattr(self.params, "scan_mode", "auto")
+            if mode not in ("recon", "codes", "lut"):
+                mode = ("recon" if self.index.list_recon is not None
+                        else "lut")
+            return cache.get("ivf_pq", self.res, self.index, batch=bucket,
+                             k=k, n_probes=n_probes, scan_mode=mode)
+        if self.kind == "ivf_flat":
+            n_probes = min(self.params.n_probes, self.index.n_lists)
+            return cache.get("ivf_flat", self.res, self.index, batch=bucket,
+                             k=k, n_probes=n_probes)
+        if self.kind == "brute_force":
+            return cache.get("brute_force", self.res, self.index,
+                             batch=bucket, k=k)
+        # cagra: export when the packed walk calibrates, else live
+        itopk = max(getattr(self.params, "itopk_size", 64), k)
+        width = getattr(self.params, "search_width", 1)
+        return cache.get("cagra", self.res, self.index, batch=bucket, k=k,
+                         itopk=itopk, search_width=width)
+
+    def _live_fn(self, k: int) -> Callable:
+        # live module entry points under validation policy "off": the
+        # server already boundary-checked each request at submit, and
+        # padded zero rows must not be re-flagged
+        from raft_tpu import config
+
+        if self.kind == "ivf_pq":
+            from raft_tpu.neighbors import ivf_pq as mod
+        elif self.kind == "ivf_flat":
+            from raft_tpu.neighbors import ivf_flat as mod
+        elif self.kind == "cagra":
+            from raft_tpu.neighbors import cagra as mod
+        else:
+            from raft_tpu.neighbors import brute_force
+
+            def bf(queries):
+                with config.validation_policy("off"):
+                    return brute_force.knn(self.res, self.index, queries, k)
+            return bf
+
+        def live(queries):
+            with config.validation_policy("off"):
+                return mod.search(self.res, self.params, self.index,
+                                  queries, k)
+        return live
+
+    # ---- the hot path ---------------------------------------------------
+
+    def search_bucket(self, queries, n_valid: int, k: int
+                      ) -> Tuple[jax.Array, jax.Array]:
+        """Search a padded bucket batch; rows past ``n_valid`` come back
+        masked (id -1 / worst distance) through the integrity mask path."""
+        bucket = queries.shape[0]
+        expects((bucket, k) in self._fns or not self._warmed,
+                f"serving: shape ({bucket}, {k}) is not a warmed bucket")
+        fn = self._obtain(bucket, k)
+        d, i = fn(queries)
+        if n_valid < bucket:
+            d, i = _boundary.mask_search_outputs(
+                d, i, valid_rows_mask(n_valid, bucket),
+                select_min=self.select_min)
+        return d, i
+
+    def pad(self, queries, bucket: int):
+        return pad_rows(queries, bucket)
+
+
+class DistributedExecutor(Executor):
+    """Executor over a :mod:`raft_tpu.distributed.ann` sharded index.
+
+    Always ``warm="jit"`` (shard_map closures are not exportable).  The
+    resilience surface passes through untouched: ``failed_shards`` /
+    fault-plan masking and per-shard status behave exactly as in direct
+    :func:`raft_tpu.distributed.ann.search` calls, and post-load
+    :func:`raft_tpu.distributed.ann.health_check` works on the wrapped
+    index because the executor never copies or re-wraps it.
+    """
+
+    def __init__(self, handle, index, *, ks: Sequence[int] = (10,),
+                 max_batch: int = 1024, search_params=None,
+                 failed_shards: Sequence[int] = ()) -> None:
+        self.handle = handle
+        self.failed_shards = tuple(failed_shards)
+        super().__init__(handle, "ivf_pq", index, ks=ks,
+                         max_batch=max_batch, search_params=search_params,
+                         warm="jit")
+
+    @property
+    def dim(self) -> int:
+        return int(self.index.rotation.shape[2])
+
+    @property
+    def query_dtype(self):
+        return self.index.centers.dtype
+
+    def _aot_fn(self, bucket: int, k: int) -> Callable:
+        raise NotImplementedError("distributed indexes are jit-warmed")
+
+    def _live_fn(self, k: int) -> Callable:
+        from raft_tpu import config
+        from raft_tpu.distributed import ann
+
+        def live(queries):
+            with config.validation_policy("off"):
+                return ann.search(self.handle, self.params, self.index,
+                                  queries, k,
+                                  failed_shards=self.failed_shards)
+        return live

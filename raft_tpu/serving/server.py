@@ -1,0 +1,171 @@
+"""The online serving front end — submit/search over a warmed executor.
+
+Wiring: ``submit()`` boundary-validates the request (per-request batch,
+the PR 4 contract), stamps it with the enqueue time, and offers it to
+the admission queue (shedding / quotas / deadline checks live there).
+The dynamic batcher's dispatcher thread coalesces queued requests into
+padded bucket batches and completes each request's Future.
+
+Lifecycle::
+
+    server = serving.Server(executor, serving.ServerConfig(...))
+    server.start()                      # warms every bucket (AOT)
+    fut = server.submit(q, k=10)        # -> Future[(distances, indices)]
+    d, i = server.search(q, k=10)       # submit + wait
+    server.stop()
+
+Zero-recompile contract: ``start()`` warms every (bucket, k) executable;
+afterwards the ``xla.compiles`` counter stays flat under any traffic mix
+that respects the closed shape set (asserted by the serving bench / CI
+smoke).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from concurrent.futures import Future
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from raft_tpu import observability as obs
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import ensure_array
+from raft_tpu.integrity import boundary as _boundary
+from raft_tpu.resilience.retry import Deadline
+from raft_tpu.serving.admission import AdmissionQueue, Overloaded, Request
+from raft_tpu.serving.batcher import DynamicBatcher
+
+
+@dataclasses.dataclass
+class ServerConfig:
+    """Serving knobs (see docs/api.md "Serving" for sizing guidance).
+
+    ``max_wait_us`` is the latency the batcher may spend waiting to fill
+    a bucket — it bounds added p99; size it well below the latency SLO.
+    ``max_queue_rows`` bounds queue memory and worst-case queueing delay;
+    beyond it, submissions shed with :class:`Overloaded`.
+    ``tenant_quotas`` maps tenant -> (rate_rows_per_s, burst_rows).
+    """
+
+    max_batch: int = 1024
+    max_wait_us: float = 2000.0
+    max_queue_rows: int = 8192
+    tenant_quotas: Optional[Dict[str, Tuple[float, float]]] = None
+    # default per-request deadline (seconds); None = no deadline
+    default_deadline_s: Optional[float] = None
+
+
+class Server:
+    """Online request path over one warmed :class:`Executor`."""
+
+    def __init__(self, executor, config: Optional[ServerConfig] = None
+                 ) -> None:
+        self.executor = executor
+        self.config = config or ServerConfig()
+        expects(self.config.max_batch <= executor.max_batch,
+                "serving: config.max_batch exceeds the executor's bucket set")
+        self.queue = AdmissionQueue(self.config.max_queue_rows,
+                                    self.config.tenant_quotas)
+        self.batcher = DynamicBatcher(self.queue, executor,
+                                      max_batch=self.config.max_batch,
+                                      max_wait_us=self.config.max_wait_us)
+        self._started = False
+
+    # ---- lifecycle ------------------------------------------------------
+
+    def start(self) -> "Server":
+        """Warm every bucket executable, then start dispatching."""
+        with obs.stage("serving.warmup") as st:
+            n = self.executor.warmup()
+            st.fence()
+        if obs.enabled():
+            obs.registry().gauge("serving.warmed_executables").set(n)
+        self.batcher.start()
+        self._started = True
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        self.batcher.stop(drain=drain)
+        self._started = False
+
+    def __enter__(self) -> "Server":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ---- request path ---------------------------------------------------
+
+    def submit(self, queries, k: Optional[int] = None, *,
+               tenant: str = "default",
+               deadline: Optional[Deadline] = None) -> Future:
+        """Enqueue one request; returns a Future resolving to
+        ``(distances, indices)`` of shape (n, k).
+
+        Raises :class:`Overloaded` / :class:`QuotaExceeded` when shed at
+        admission; the Future fails with
+        :class:`~raft_tpu.resilience.retry.DeadlineExceededError` when
+        the deadline expires while queued.  Under validation policy
+        ``mask``, non-finite query rows resolve to id -1 / worst
+        distance (the integrity mask path).
+        """
+        expects(self._started, "serving: server not started")
+        k = int(k) if k is not None else self.executor.ks[0]
+        expects(k in self.executor.ks,
+                f"serving: k={k} is not in the warmed set {self.executor.ks}")
+        # requests stay HOST-side through admission: their shapes are
+        # unbounded, so validation runs the numpy twin of the boundary
+        # guard (host=True) and the batcher assembles the device batch
+        # at the fixed bucket shape — no per-request device work
+        if not isinstance(queries, np.ndarray):
+            queries = ensure_array(queries, "queries")
+        if queries.ndim == 1:
+            queries = queries[None, :]
+        queries = np.asarray(queries)
+        queries, ok_rows = _boundary.check_matrix(
+            queries, "queries", site="serving.submit",
+            dim=self.executor.dim, allow_empty=False, host=True)
+        expects(queries.ndim == 2 and queries.shape[1] == self.executor.dim,
+                "serving.submit: query dim mismatch")
+        n = int(queries.shape[0])
+        if n > self.config.max_batch:
+            raise Overloaded(
+                f"serving: request of {n} rows exceeds max_batch="
+                f"{self.config.max_batch}; split the request")
+        if deadline is None and self.config.default_deadline_s is not None:
+            deadline = Deadline(self.config.default_deadline_s)
+        req = Request(queries=queries, k=k, tenant=tenant,
+                      deadline=deadline, future=Future(), n=n,
+                      t_enqueue=time.monotonic(), ok_rows=ok_rows)
+        self.queue.offer(req)
+        return req.future
+
+    def search(self, queries, k: Optional[int] = None, *,
+               tenant: str = "default",
+               deadline: Optional[Deadline] = None,
+               timeout: Optional[float] = None):
+        """Synchronous convenience: ``submit(...).result(timeout)``."""
+        return self.submit(queries, k, tenant=tenant,
+                           deadline=deadline).result(timeout=timeout)
+
+    # ---- introspection --------------------------------------------------
+
+    def stats(self) -> Dict[str, object]:
+        """Point-in-time serving stats (cheap; registry-backed numbers
+        appear only while collection is enabled)."""
+        snap = obs.snapshot() if obs.enabled() else {}
+        return {
+            "queue_rows": self.queue.rows,
+            "queue_requests": len(self.queue),
+            "buckets": list(self.executor.buckets),
+            "ks": list(self.executor.ks),
+            "counters": {name: v
+                         for name, v in snap.get("counters", {}).items()
+                         if name.startswith(("serving.", "xla."))},
+            "histograms": {name: {q: h[q] for q in ("count", "p50", "p95",
+                                                    "p99")}
+                           for name, h in snap.get("histograms", {}).items()
+                           if name.startswith("serving.")},
+        }

@@ -19,13 +19,20 @@ import pathlib
 import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
-PACKAGES = ("raft_tpu/neighbors", "raft_tpu/cluster")
+# package -> scan mode: "functions" checks module-level entry points
+# only; "all" also checks methods of module-level classes (the serving
+# surface is class-shaped: Server.submit / Server.search)
+PACKAGES = {
+    "raft_tpu/neighbors": "functions",
+    "raft_tpu/cluster": "functions",
+    "raft_tpu/serving": "all",
+}
 
 # entry-point names that take user arrays and must validate them
 GUARDED = {
     "build", "search", "extend", "fit", "predict", "transform",
     "fit_predict", "knn", "knn_query", "all_knn_query", "build_index",
-    "eps_neighbors_l2sq", "refine",
+    "eps_neighbors_l2sq", "refine", "submit",
 }
 VALIDATORS = {"check_matrix", "guard_nonfinite"}
 
@@ -51,9 +58,17 @@ def _local_callees(fn: ast.FunctionDef) -> set:
     return out
 
 
-def check_file(path: pathlib.Path) -> list:
+def check_file(path: pathlib.Path, mode: str = "functions") -> list:
     tree = ast.parse(path.read_text(), filename=str(path))
     fns = {n.name: n for n in tree.body if isinstance(n, ast.FunctionDef)}
+    if mode == "all":
+        # class methods keyed by bare name so delegation resolves
+        # (Server.search -> self.submit matches fns["submit"])
+        for cls in tree.body:
+            if isinstance(cls, ast.ClassDef):
+                for n in cls.body:
+                    if isinstance(n, ast.FunctionDef):
+                        fns.setdefault(n.name, n)
 
     # fixed point: a function is "checked" if it calls a validator, or
     # calls a same-module function that is checked (delegation)
@@ -83,9 +98,9 @@ def check_file(path: pathlib.Path) -> list:
 
 def main() -> int:
     violations = []
-    for pkg in PACKAGES:
+    for pkg, mode in PACKAGES.items():
         for path in sorted((ROOT / pkg).glob("*.py")):
-            violations.extend(check_file(path))
+            violations.extend(check_file(path, mode))
     for v in violations:
         print(v)
     if violations:

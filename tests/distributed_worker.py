@@ -19,19 +19,31 @@ port = sys.argv[3]
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
 
+# the repo root must be importable BEFORE the first raft_tpu import —
+# the launcher does not install the package, and the script-dir default
+# on sys.path is tests/, not the repo root (this ordering bug made the
+# whole test fail with ModuleNotFoundError whenever raft_tpu was not
+# pip-installed)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_cpu_collectives_implementation", "gloo")
-jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
-                           num_processes=n_procs, process_id=proc_id)
+# capability gate: a jax build without gloo CPU collectives (or with a
+# broken multi-controller bootstrap) cannot run this worker at all —
+# report UNSUPPORTED so the launcher skips instead of hard-failing
+try:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                               num_processes=n_procs, process_id=proc_id)
+except (RuntimeError, ValueError, NotImplementedError) as e:
+    print(f"MULTIPROC_UNSUPPORTED: {type(e).__name__}: {e}", flush=True)
+    sys.exit(0)
 
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
 from raft_tpu.core.compat import shard_map  # noqa: E402
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from raft_tpu.comms.comms import op_t  # noqa: E402
 from raft_tpu.comms.session import CommsSession  # noqa: E402

@@ -29,6 +29,11 @@ def test_two_process_bootstrap_and_mnmg_kmeans():
     # the workers set their own JAX env; drop any inherited backend pins
     env.pop("JAX_PLATFORMS", None)
     env.pop("XLA_FLAGS", None)
+    # make raft_tpu importable in the workers regardless of install state
+    # (the worker also self-inserts the repo root, belt and braces)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (root, env.get("PYTHONPATH")) if p)
     procs = [
         subprocess.Popen(
             [sys.executable, _WORKER, str(rank), "2", str(port)],
@@ -45,6 +50,14 @@ def test_two_process_bootstrap_and_mnmg_kmeans():
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    # capability gate: a jax build without multi-controller CPU
+    # collectives reports UNSUPPORTED from inside the worker — skip with
+    # the worker's reason instead of hard-failing the suite
+    for out in outs:
+        if "MULTIPROC_UNSUPPORTED" in out:
+            line = next(ln for ln in out.splitlines()
+                        if "MULTIPROC_UNSUPPORTED" in ln)
+            pytest.skip(f"multi-process collectives unavailable: {line}")
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, (
             f"worker {rank} failed (rc={p.returncode}):\n{out[-4000:]}")

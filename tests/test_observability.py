@@ -52,7 +52,7 @@ class TestRegistry:
         reg.counter("c").inc()
         reg.reset()
         assert reg.snapshot() == {"counters": {}, "gauges": {},
-                                  "timers": {}}
+                                  "timers": {}, "histograms": {}}
 
     def test_get_or_create_identity(self):
         reg = obs.MetricsRegistry()

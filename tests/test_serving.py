@@ -1,0 +1,502 @@
+"""raft_tpu.serving — dynamic batching, admission control, warm executors.
+
+Covers the batcher edge cases the ISSUE names (single in-flight query
+hitting max_wait, queue-full shedding, deadline expiry while queued,
+per-tenant quota exhaustion, padded-row masking through the integrity
+mask path), the zero-recompile steady-state contract, and the
+bucket-keyed AOT executable cache (export→load→search round trip per
+bucket; distinct batch sizes must not collide).
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import observability as obs
+from raft_tpu import serving
+from raft_tpu.core import aot
+from raft_tpu.neighbors import ivf_flat, ivf_pq
+from raft_tpu.resilience.retry import Deadline, DeadlineExceededError
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    obs.disable()
+    obs.reset()
+    yield
+    obs.disable()
+    obs.reset()
+
+
+def _dataset(n=4000, dim=32, seed=0):
+    rng = np.random.default_rng(seed)
+    db = rng.normal(size=(n, dim)).astype(np.float32)
+    q = rng.normal(size=(64, dim)).astype(np.float32)
+    return jnp.asarray(db), jnp.asarray(q)
+
+
+@pytest.fixture(scope="module")
+def pq_setup():
+    from raft_tpu import DeviceResources
+    res = DeviceResources(seed=42)
+    db, q = _dataset()
+    index = ivf_pq.build(
+        res, ivf_pq.IndexParams(n_lists=32, pq_dim=8, kmeans_n_iters=4), db)
+    sp = ivf_pq.SearchParams(n_probes=8)
+    return res, db, q, index, sp
+
+
+def _executor(pq_setup, max_batch=16, ks=(5,), warm="aot"):
+    res, _, _, index, sp = pq_setup
+    return serving.Executor(res, "ivf_pq", index, ks=ks,
+                            max_batch=max_batch, search_params=sp,
+                            warm=warm)
+
+
+# ---------------------------------------------------------------------------
+# buckets
+
+
+class TestBuckets:
+    def test_bucket_sizes_powers_of_two(self):
+        assert serving.bucket_sizes(16) == (1, 2, 4, 8, 16)
+        # non-power max_batch is still included (the peak shape)
+        assert serving.bucket_sizes(24) == (1, 2, 4, 8, 16, 24)
+        assert serving.bucket_sizes(16, min_bucket=4) == (4, 8, 16)
+
+    def test_bucket_for(self):
+        assert serving.bucket_for(1, 16) == 1
+        assert serving.bucket_for(3, 16) == 4
+        assert serving.bucket_for(16, 16) == 16
+        with pytest.raises(Exception):
+            serving.bucket_for(17, 16)
+
+    def test_pad_rows(self):
+        x = jnp.ones((3, 4))
+        p = serving.pad_rows(x, 8)
+        assert p.shape == (8, 4)
+        np.testing.assert_array_equal(np.asarray(p[3:]), 0.0)
+        assert serving.pad_rows(x, 3) is x
+
+
+# ---------------------------------------------------------------------------
+# admission
+
+
+class TestAdmission:
+    def test_token_bucket(self):
+        t = [0.0]
+        tb = serving.TokenBucket(rate=10.0, burst=5.0, clock=lambda: t[0])
+        assert tb.try_acquire(5)
+        assert not tb.try_acquire(1)      # exhausted
+        t[0] += 0.5                       # refills 5 tokens
+        assert tb.try_acquire(5)
+        assert not tb.try_acquire(1)
+
+    def test_queue_full_shed(self, pq_setup):
+        ex = _executor(pq_setup, warm="jit")
+        cfg = serving.ServerConfig(max_batch=16, max_queue_rows=4,
+                                   max_wait_us=50_000)
+        q = pq_setup[2]
+        srv = serving.Server(ex, cfg).start()
+        try:
+            # park the dispatcher so submissions stay queued
+            srv.batcher.stop(drain=False)
+            fut = srv.submit(q[:3], 5)
+            with pytest.raises(serving.Overloaded):
+                srv.submit(q[:3], 5)      # 3 + 3 > 4 -> shed
+            srv.batcher.start()           # resume; queued request completes
+            d, i = fut.result(timeout=30)
+            assert d.shape == (3, 5)
+        finally:
+            srv.stop()
+
+    def test_oversized_request_rejected(self, pq_setup):
+        ex = _executor(pq_setup, warm="jit")
+        with serving.Server(ex, serving.ServerConfig(max_batch=16)) as srv:
+            q = pq_setup[2]
+            with pytest.raises(serving.Overloaded):
+                srv.submit(q[:17], 5)
+
+    def test_tenant_quota_exhaustion(self, pq_setup):
+        ex = _executor(pq_setup, warm="jit")
+        cfg = serving.ServerConfig(
+            max_batch=16, max_wait_us=100.0,
+            tenant_quotas={"metered": (1.0, 4.0)})   # 4-row burst
+        q = pq_setup[2]
+        with serving.Server(ex, cfg) as srv:
+            srv.search(q[:4], 5, tenant="metered")   # spends the burst
+            with pytest.raises(serving.QuotaExceeded):
+                srv.submit(q[:4], 5, tenant="metered")
+            # other tenants are unmetered
+            d, i = srv.search(q[:4], 5, tenant="other")
+            assert d.shape == (4, 5)
+
+    def test_quota_exceeded_is_overloaded(self):
+        assert issubclass(serving.QuotaExceeded, serving.Overloaded)
+
+    def test_expired_deadline_rejected_at_submit(self, pq_setup):
+        ex = _executor(pq_setup, warm="jit")
+        q = pq_setup[2]
+        with serving.Server(ex, serving.ServerConfig(max_batch=16)) as srv:
+            with pytest.raises(serving.Overloaded):
+                srv.submit(q[:2], 5, deadline=Deadline(0.0))
+
+
+# ---------------------------------------------------------------------------
+# batcher
+
+
+class TestBatcher:
+    def test_single_query_hits_max_wait(self, pq_setup):
+        """One in-flight query must dispatch after ~max_wait_us even with
+        no other traffic to fill the bucket."""
+        ex = _executor(pq_setup, warm="jit")
+        cfg = serving.ServerConfig(max_batch=16, max_wait_us=20_000)
+        q = pq_setup[2]
+        with serving.Server(ex, cfg) as srv:
+            srv.search(q[:1], 5)                     # warm the live path
+            t0 = time.monotonic()
+            d, i = srv.submit(q[:1], 5).result(timeout=10)
+            waited = time.monotonic() - t0
+            assert d.shape == (1, 5)
+            # dispatched by the max_wait timer: NOT immediately (the
+            # bucket never fills) and well before the 10s future timeout
+            assert waited < 5.0
+            assert np.asarray(i).min() >= 0
+
+    def test_full_bucket_dispatches_before_max_wait(self, pq_setup):
+        ex = _executor(pq_setup, warm="jit")
+        # absurd max_wait: only the max_batch trigger can dispatch
+        cfg = serving.ServerConfig(max_batch=8, max_wait_us=60_000_000)
+        q = pq_setup[2]
+        with serving.Server(ex, cfg) as srv:
+            futs = [srv.submit(q[j:j + 1], 5) for j in range(8)]
+            outs = [f.result(timeout=30) for f in futs]
+        assert all(o[0].shape == (1, 5) for o in outs)
+
+    def test_deadline_expiry_while_queued(self, pq_setup):
+        """A request whose deadline lapses in the queue fails with
+        DeadlineExceededError at dispatch, and does not poison the batch."""
+        ex = _executor(pq_setup, warm="jit")
+        cfg = serving.ServerConfig(max_batch=16, max_wait_us=200_000)
+        q = pq_setup[2]
+        t = [0.0]
+        clock = lambda: t[0]                          # noqa: E731
+        with serving.Server(ex, cfg) as srv:
+            dead = Deadline(0.05, clock=clock)        # 50 ms budget
+            doomed = srv.submit(q[:2], 5, deadline=dead)
+            t[0] += 1.0                               # budget lapses queued
+            ok = srv.submit(q[:3], 5)
+            d, i = ok.result(timeout=10)
+            assert d.shape == (3, 5)
+            with pytest.raises(DeadlineExceededError):
+                doomed.result(timeout=10)
+
+    def test_batch_coalescing_matches_direct_search(self, pq_setup):
+        res, _, q, index, sp = pq_setup
+        ex = _executor(pq_setup, warm="aot")
+        cfg = serving.ServerConfig(max_batch=16, max_wait_us=50_000)
+        with serving.Server(ex, cfg) as srv:
+            futs = [srv.submit(q[j * 3:(j + 1) * 3], 5) for j in range(4)]
+            outs = [f.result(timeout=30) for f in futs]
+        for j, (d, i) in enumerate(outs):
+            dd, ii = ivf_pq.search(res, sp, index, q[j * 3:(j + 1) * 3], 5)
+            np.testing.assert_array_equal(np.asarray(i), np.asarray(ii))
+            np.testing.assert_allclose(np.asarray(d), np.asarray(dd),
+                                       rtol=1e-5)
+
+    def test_mixed_k_split_into_separate_batches(self, pq_setup):
+        ex = _executor(pq_setup, ks=(5, 10), warm="jit")
+        cfg = serving.ServerConfig(max_batch=16, max_wait_us=10_000)
+        q = pq_setup[2]
+        with serving.Server(ex, cfg) as srv:
+            f5 = srv.submit(q[:2], 5)
+            f10 = srv.submit(q[:2], 10)
+            assert f5.result(timeout=10)[0].shape == (2, 5)
+            assert f10.result(timeout=10)[0].shape == (2, 10)
+
+    def test_unknown_k_rejected(self, pq_setup):
+        ex = _executor(pq_setup, warm="jit")
+        with serving.Server(ex, serving.ServerConfig(max_batch=16)) as srv:
+            with pytest.raises(Exception):
+                srv.submit(pq_setup[2][:2], 7)
+
+
+# ---------------------------------------------------------------------------
+# padded-row masking (the integrity mask path)
+
+
+class TestPaddedRows:
+    def test_padded_rows_masked(self, pq_setup):
+        """Executor-level contract: rows past n_valid return id -1 and
+        the worst distance, exactly like boundary-masked rows."""
+        ex = _executor(pq_setup, warm="aot")
+        ex.warmup()
+        q = pq_setup[2]
+        padded = ex.pad(q[:3], 8)
+        d, i = ex.search_bucket(padded, 3, 5)
+        d, i = np.asarray(d), np.asarray(i)
+        assert (i[3:] == -1).all()
+        assert np.isposinf(d[3:]).all()
+        # real rows untouched
+        assert (i[:3] >= 0).all()
+        assert np.isfinite(d[:3]).all()
+
+    def test_nonfinite_query_rows_masked_under_mask_policy(self, pq_setup):
+        from raft_tpu import config
+        ex = _executor(pq_setup, warm="jit")
+        q = np.asarray(pq_setup[2][:4]).copy()
+        q[1] = np.nan
+        cfg = serving.ServerConfig(max_batch=16, max_wait_us=5_000)
+        with serving.Server(ex, cfg) as srv:
+            with config.validation_policy("mask"):
+                d, i = srv.search(q, 5)
+        d, i = np.asarray(d), np.asarray(i)
+        assert (i[1] == -1).all() and np.isposinf(d[1]).all()
+        assert (i[[0, 2, 3]] >= 0).all()
+
+    def test_nonfinite_rejected_under_raise_policy(self, pq_setup):
+        from raft_tpu import config
+        from raft_tpu.integrity import ValidationError
+        ex = _executor(pq_setup, warm="jit")
+        q = np.asarray(pq_setup[2][:2]).copy()
+        q[0] = np.inf
+        with serving.Server(ex, serving.ServerConfig(max_batch=16)) as srv:
+            with config.validation_policy("raise"):
+                with pytest.raises(ValidationError):
+                    srv.submit(q, 5)
+
+
+# ---------------------------------------------------------------------------
+# warmup / zero-recompile contract
+
+
+class TestWarmExecutors:
+    def test_zero_recompiles_after_warmup(self, pq_setup):
+        ex = _executor(pq_setup, warm="aot")
+        with obs.collecting():
+            srv = serving.Server(
+                ex, serving.ServerConfig(max_batch=16,
+                                         max_wait_us=2_000)).start()
+            # clients submit host data; a device-side q[:m] would itself
+            # compile one slice program per novel m and pollute the count
+            q = np.asarray(pq_setup[2])
+            try:
+                for m in (1, 3, 8, 16, 5, 2):
+                    srv.search(q[:m], 5)
+                c0 = obs.registry().counter("xla.compiles").value
+                for m in (2, 16, 1, 7, 4, 16, 3):
+                    srv.search(q[:m], 5)
+                c1 = obs.registry().counter("xla.compiles").value
+            finally:
+                srv.stop()
+        assert c1 == c0, f"{c1 - c0} recompiles in steady state"
+
+    def test_serving_metrics_recorded(self, pq_setup):
+        ex = _executor(pq_setup, warm="jit")
+        with obs.collecting():
+            cfg = serving.ServerConfig(max_batch=16, max_wait_us=2_000)
+            with serving.Server(ex, cfg) as srv:
+                for m in (1, 3, 5):
+                    srv.search(pq_setup[2][:m], 5)
+            snap = obs.snapshot()
+        assert snap["counters"]["serving.admitted"] == 3
+        assert snap["counters"]["serving.batches"] >= 1
+        assert snap["histograms"]["serving.latency.total"]["count"] == 3
+        h = snap["histograms"]["serving.latency.queue"]
+        assert h["p99"] >= h["p50"] >= 0.0
+
+    def test_ivf_flat_executor(self, pq_setup):
+        res, db, q, _, _ = pq_setup
+        index = ivf_flat.build(
+            res, ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=4), db)
+        sp = ivf_flat.SearchParams(n_probes=8)
+        ex = serving.Executor(res, "ivf_flat", index, ks=(5,), max_batch=8,
+                              search_params=sp)
+        with serving.Server(ex, serving.ServerConfig(max_batch=8)) as srv:
+            d, i = srv.search(q[:3], 5)
+        dd, ii = ivf_flat.search(res, sp, index, q[:3], 5)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(ii))
+
+    def test_brute_force_executor(self, pq_setup):
+        res, db, q, _, _ = pq_setup
+        from raft_tpu.neighbors import brute_force
+        ex = serving.Executor(res, "brute_force", db, ks=(5,), max_batch=8)
+        with serving.Server(ex, serving.ServerConfig(max_batch=8)) as srv:
+            d, i = srv.search(q[:3], 5)
+        dd, ii = brute_force.knn(res, db, q[:3], 5)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(ii))
+
+
+# ---------------------------------------------------------------------------
+# the AOT executable cache (bucket keying)
+
+
+class TestExecutableCache:
+    def test_round_trip_per_bucket(self, pq_setup):
+        """Export→load→search round trip at every bucket size: each
+        bucket's executable accepts exactly its shape and reproduces the
+        direct search."""
+        res, _, q, index, sp = pq_setup
+        cache = aot.ExecutableCache()
+        for batch in (1, 2, 4, 8):
+            g = cache.get("ivf_pq", res, index, batch=batch, k=5,
+                          n_probes=8, scan_mode="recon")
+            d, i = g(q[:batch])
+            dd, ii = ivf_pq.search(res, sp, index, q[:batch], 5)
+            np.testing.assert_array_equal(np.asarray(i), np.asarray(ii))
+            np.testing.assert_allclose(np.asarray(d), np.asarray(dd),
+                                       rtol=1e-5)
+        assert len(cache) == 4
+
+    def test_batch_sizes_do_not_collide(self, pq_setup):
+        """Same index, different batch sizes -> distinct executables;
+        each accepts only its own batch shape."""
+        res, _, q, index, _ = pq_setup
+        cache = aot.ExecutableCache()
+        g2 = cache.get("ivf_pq", res, index, batch=2, k=5, n_probes=8,
+                       scan_mode="recon")
+        g4 = cache.get("ivf_pq", res, index, batch=4, k=5, n_probes=8,
+                       scan_mode="recon")
+        assert g2 is not g4
+        assert g2(q[:2])[0].shape == (2, 5)
+        assert g4(q[:4])[0].shape == (4, 5)
+        with pytest.raises(Exception):
+            jax.block_until_ready(g2(q[:4]))
+        # a repeat lookup is a cache hit
+        assert cache.get("ivf_pq", res, index, batch=2, k=5, n_probes=8,
+                         scan_mode="recon") is g2
+
+    def test_key_includes_k_and_nprobes(self, pq_setup):
+        res, _, q, index, _ = pq_setup
+        cache = aot.ExecutableCache()
+        a = cache.get("ivf_pq", res, index, batch=2, k=5, n_probes=8,
+                      scan_mode="recon")
+        b = cache.get("ivf_pq", res, index, batch=2, k=3, n_probes=8,
+                      scan_mode="recon")
+        c = cache.get("ivf_pq", res, index, batch=2, k=5, n_probes=4,
+                      scan_mode="recon")
+        assert len({id(a), id(b), id(c)}) == 3
+        assert b(q[:2])[0].shape == (2, 3)
+
+    def test_dead_index_never_hits(self, pq_setup):
+        """An id()-recycled dead index must miss, not serve stale
+        executables (the weakref validation)."""
+        res, db, q, _, sp = pq_setup
+        cache = aot.ExecutableCache()
+        index1 = ivf_pq.build(
+            res, ivf_pq.IndexParams(n_lists=16, pq_dim=8,
+                                    kmeans_n_iters=2), db[:2000])
+        g1 = cache.get("ivf_pq", res, index1, batch=2, k=5, n_probes=4,
+                       scan_mode="recon")
+        key = next(iter(cache._entries))
+        # simulate id reuse: a different index object under the same key
+        index2 = ivf_pq.build(
+            res, ivf_pq.IndexParams(n_lists=16, pq_dim=8,
+                                    kmeans_n_iters=2), db[2000:])
+        cache._entries[key] = (cache._entries[key][0], g1)
+        g2 = cache.get("ivf_pq", res, index2, batch=2, k=5, n_probes=4,
+                       scan_mode="recon")
+        assert g2 is not g1
+
+
+# ---------------------------------------------------------------------------
+# histogram metric (observability satellite)
+
+
+class TestHistogram:
+    def test_observe_and_quantiles(self):
+        reg = obs.MetricsRegistry()
+        h = reg.histogram("lat")
+        for v in (0.001, 0.002, 0.004, 0.008, 0.1):
+            h.observe(v)
+        d = h.as_dict()
+        assert d["count"] == 5
+        assert d["min"] == pytest.approx(0.001)
+        assert d["max"] == pytest.approx(0.1)
+        assert 0.0 < d["p50"] <= d["p95"] <= d["p99"] <= 0.1
+
+    def test_custom_bounds_and_overflow(self):
+        reg = obs.MetricsRegistry()
+        h = reg.histogram("x", bounds=(1.0, 2.0, 4.0))
+        for v in (0.5, 1.5, 3.0, 100.0):
+            h.observe(v)
+        d = h.as_dict()
+        assert d["counts"] == [1, 1, 1, 1]     # last = overflow bucket
+        assert d["p99"] <= d["max"] == 100.0
+
+    def test_empty_histogram(self):
+        reg = obs.MetricsRegistry()
+        h = reg.histogram("empty")
+        assert h.quantile(0.99) == 0.0
+        assert h.as_dict()["min"] == 0.0
+
+    def test_get_or_create_identity(self):
+        reg = obs.MetricsRegistry()
+        assert reg.histogram("h") is reg.histogram("h")
+
+    def test_snapshot_and_prometheus_export(self):
+        reg = obs.MetricsRegistry()
+        reg.histogram("serving.latency.total").observe(0.01)
+        snap = reg.snapshot()
+        assert "serving.latency.total" in snap["histograms"]
+        text = obs.to_prometheus(snap)
+        assert "# TYPE raft_tpu_serving_latency_total histogram" in text
+        assert 'raft_tpu_serving_latency_total_bucket{le="+Inf"} 1' in text
+        assert "raft_tpu_serving_latency_total_p99" in text
+        assert "raft_tpu_serving_latency_total_count 1" in text
+
+    def test_json_roundtrip_with_histogram(self):
+        import json
+        reg = obs.MetricsRegistry()
+        reg.histogram("h").observe(1.0)
+        back = json.loads(obs.to_json(reg.snapshot()))
+        assert back == reg.snapshot()
+
+    def test_zero_work_while_disabled(self, pq_setup):
+        """Counter contract: with collection off, serving records no
+        histogram samples (and creates no histograms)."""
+        ex = _executor(pq_setup, warm="jit")
+        obs.disable()
+        obs.reset()
+        with serving.Server(ex,
+                            serving.ServerConfig(max_batch=16)) as srv:
+            srv.search(pq_setup[2][:2], 5)
+        assert obs.snapshot()["histograms"] == {}
+        assert obs.snapshot()["counters"] == {}
+
+
+# ---------------------------------------------------------------------------
+# concurrency smoke
+
+
+class TestConcurrentClients:
+    def test_many_threads_submit(self, pq_setup):
+        res, _, q, index, sp = pq_setup
+        ex = _executor(pq_setup, warm="aot")
+        cfg = serving.ServerConfig(max_batch=16, max_wait_us=1_000,
+                                   max_queue_rows=512)
+        errs, results = [], []
+        with serving.Server(ex, cfg) as srv:
+            def client(j):
+                try:
+                    for _ in range(5):
+                        d, i = srv.search(q[j:j + 2], 5, timeout=30)
+                        results.append(np.asarray(i))
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+            threads = [threading.Thread(target=client, args=(j,))
+                       for j in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        assert not errs, errs
+        assert len(results) == 40
+        for i in results:
+            assert (i >= 0).all()
