@@ -59,6 +59,11 @@ OPERATING_POINTS = (
     # int8 recon cache (1 byte/dim/row)
     dict(n_probes=72, refine_ratio=2, scan_mode="recon8"),
     dict(n_probes=72, refine_ratio=2, scan_mode="recon8", per_probe_topk=4),
+    # round-7 fused in-kernel top-k: scan + extraction in ONE stage,
+    # candidate distance matrices never reach HBM
+    dict(n_probes=72, refine_ratio=2, scan_mode="fused"),
+    dict(n_probes=72, refine_ratio=2, scan_mode="fused", per_probe_topk=4),
+    dict(n_probes=96, refine_ratio=2, scan_mode="fused", per_probe_topk=4),
 )
 MIN_RECALL = 0.95
 # SIFT-like synthetic data: descriptors have low intrinsic dimensionality
@@ -136,6 +141,40 @@ def _print_stage_breakdown(harness: str, index) -> None:
     }}), flush=True)
 
 
+def _search_stage_probe(res, index, queries) -> dict:
+    """One search per scan mode under stage collection — the round-7
+    evidence line: in fused mode the ``code_scan`` (+ in-XLA extraction)
+    stage pair collapses into the single ``fused_scan`` stage, and the
+    ``fused_fallback`` counter says whether the fused kernel actually
+    ran (0 new ticks) or the shape fell back (CPU, unsupported kt/k)."""
+    from raft_tpu import observability as obs
+    from raft_tpu.neighbors import ivf_pq
+
+    def _counts(snap, kind, key=None):
+        return {n: (t["count"] if key is None else t.get(key, 0))
+                for n, t in snap.get(kind, {}).items()}
+
+    out = {}
+    for mode in ("codes", "fused"):
+        sp = ivf_pq.SearchParams(n_probes=72, scan_mode=mode,
+                                 per_probe_topk=4)
+        with obs.collecting() as reg:
+            before = reg.snapshot()
+            _, i = ivf_pq.search(res, sp, index, queries, K)
+            np.asarray(i)
+            after = reg.snapshot()
+        b_t = _counts(before, "timers")
+        stages = sorted(
+            n for n, c in _counts(after, "timers").items()
+            if n.startswith("ivf_pq.search.") and c > b_t.get(n, 0))
+        fb = (after.get("counters", {})
+              .get("ivf_pq.search.fused_fallback", 0)
+              - before.get("counters", {})
+              .get("ivf_pq.search.fused_fallback", 0))
+        out[mode] = {"stages": stages, "fused_fallback_ticks": fb}
+    return out
+
+
 def bench_ivf_pq(res, db, queries, gt_i=None) -> dict:
     from raft_tpu.neighbors import ivf_pq
 
@@ -153,6 +192,8 @@ def bench_ivf_pq(res, db, queries, gt_i=None) -> dict:
         index.list_codes.block_until_ready()
     build_s = time.perf_counter() - t0
     _print_stage_breakdown("ivf_pq", index)
+    stage_probe = _search_stage_probe(res, index, queries)
+    print(json.dumps({"search_stage_probe": stage_probe}), flush=True)
 
     from raft_tpu.neighbors.refine import refine as refine_fn
 
@@ -215,6 +256,7 @@ def bench_ivf_pq(res, db, queries, gt_i=None) -> dict:
                    # decomposition profile measures the same quantities)
                    "scan_bytes_per_row": grouped.scan_traffic(
                        index.rot_dim, index.pq_dim, index.pq_bits),
+                   "search_stage_probe": stage_probe,
                    "operating_point": chosen},
     }
 
@@ -453,7 +495,9 @@ def bench_serving(res, db, queries, *, build_param=None, search_param=None,
         res, ivf_pq.IndexParams(n_lists=bp["nlist"], pq_dim=bp["pq_dim"],
                                 kmeans_n_iters=bp.get("kmeans_n_iters", 10)),
         db)
-    sp = ivf_pq.SearchParams(n_probes=spc["nprobe"])
+    sp = ivf_pq.SearchParams(n_probes=spc["nprobe"],
+                             scan_mode=spc.get("scan_mode", "auto"),
+                             per_probe_topk=spc.get("per_probe_topk", 0))
     q = np.asarray(queries)                 # clients submit host data
     reps = int(np.ceil(max_batch / q.shape[0])) if q.shape[0] < max_batch \
         else 1

@@ -226,17 +226,21 @@ def export_ivf_pq_search(res, index, n_probes: int, k: int, batch: int,
     - ``"recon"`` bakes the bf16 reconstruction cache and exports the
       recon scan (2 bytes/dim/row in the artifact — the fastest live
       formulation, also the largest file).
-    - ``"codes"`` / ``"lut"`` bake only the bit-packed PQ codes +
-      codebooks and export the portable LUT formulation over them
-      (~pq_bits/8 bytes per subspace per row — the compact deployment
-      shape).  The grouped Pallas code-scan kernel itself is a
-      runtime-dispatch path and is not serialized; the exported code
-      program computes the same quantized distances.
+    - ``"codes"`` / ``"lut"`` / ``"fused"`` bake only the bit-packed PQ
+      codes + codebooks and export the portable LUT formulation over
+      them (~pq_bits/8 bytes per subspace per row — the compact
+      deployment shape).  The grouped Pallas kernels — including the
+      fused in-kernel top-k variants — are runtime-dispatch paths and
+      are not serialized (their group count is batch-data-dependent);
+      the exported code program computes the same quantized distances,
+      so an artifact warmed under ``scan_mode="fused"`` answers
+      identically while carrying its own distinct
+      :class:`ExecutableCache` key component.
     """
     from raft_tpu.neighbors import ivf_pq
 
-    expects(scan_mode in ("recon", "codes", "lut"),
-            "aot: scan_mode must be 'recon', 'codes' or 'lut'")
+    expects(scan_mode in ("recon", "codes", "lut", "fused"),
+            "aot: scan_mode must be 'recon', 'codes', 'lut' or 'fused'")
     metric = index.metric
 
     if scan_mode == "recon":

@@ -334,10 +334,27 @@ def search(handle, params: ivf_pq.SearchParams, index: DistributedIndex,
     ``(n_shards,)`` int8 vector, 1 = healthy / 0 = failed-and-skipped.
     Transient faults at entry (site ``distributed.ann.search``) are
     retried under ``retry_policy`` / ``deadline``.
+
+    ``params.scan_mode`` threading: the shard-local scan runs *inside*
+    ``shard_map``, where the grouped Pallas kernels (including the fused
+    in-kernel top-k) cannot dispatch — their group construction is
+    batch-data-dependent and host-driven.  Every mode therefore lowers
+    to the traceable probe-order recon scan here; results are identical
+    in ranking semantics.  An explicit ``scan_mode="fused"`` request is
+    accepted but ticks the ``ivf_pq.search.fused_fallback`` counter so
+    operators can see the sharded path did not hit the fused kernel.
     """
     with named_range("distributed::ivf_pq_search"):
         expects(handle.comms_initialized(),
                 "distributed.ann.search: handle has no comms")
+        mode = getattr(params, "scan_mode", "auto")
+        expects(mode in ivf_pq._SCAN_MODES,
+                f"distributed.ann.search: unknown scan_mode {mode!r}")
+        if mode == "fused":
+            from raft_tpu import observability as obs
+            if obs.enabled():
+                obs.registry().counter(
+                    "ivf_pq.search.fused_fallback").inc()
         comms = handle.get_comms()
         queries = ensure_array(queries, "queries")
         n_probes = min(params.n_probes, index.centers.shape[1])

@@ -1689,11 +1689,11 @@ def _select_parents(buf_d, buf_i, visited, search_width):
 
 @functools.partial(jax.jit, static_argnames=(
     "k", "itopk", "search_width", "max_iterations", "metric", "rerank",
-    "deg", "quant"))
+    "deg", "quant", "fused_hop", "pallas_interpret"))
 def _search_impl_walk(dataset, table, entry_proj, entry_sq, entry_ids,
                       proj, queries, k, itopk, search_width,
                       max_iterations, metric, rerank, deg, quant=False,
-                      scales=None):
+                      scales=None, fused_hop=False, pallas_interpret=False):
     """Greedy walk over the packed neighborhood table.
 
     Walk distances are approximate (exact ||x||², PCA-projected bf16
@@ -1703,6 +1703,12 @@ def _search_impl_walk(dataset, table, entry_proj, entry_sq, entry_ids,
     the module docstring.  ``quant`` selects the int8/uint16 row format
     (see :func:`_build_walk_table_q`); ``scales`` carries its dequant
     constants.
+
+    ``fused_hop`` routes each hop's score + dedupe + merge through the
+    low-batch Pallas kernel (:mod:`raft_tpu.ops.cagra_hop_pallas`):
+    candidate distances stay in VMEM and only the sorted itopk buffer
+    is written back.  Callers gate it on ``supported_hop`` shapes and
+    ids that are exact in f32 (index size < 2^24).
     """
     nq, dim = queries.shape
     n = dataset.shape[0]
@@ -1763,6 +1769,15 @@ def _search_impl_walk(dataset, table, entry_proj, entry_sq, entry_ids,
         nb_p, nb_sq, nb_id = _decode_neighborhood(rows, pdim, deg, quant,
                                                   scales)
         nb_id = jnp.where(parent_ok[:, :, None], nb_id, -1)
+
+        if fused_hop:
+            from raft_tpu.ops import cagra_hop_pallas as chp
+            buf_d, buf_i, visited = chp.fused_hop(
+                qp_t, q_sq, nb_p.reshape(nq, wd, pdim),
+                nb_sq.reshape(nq, wd), nb_id.reshape(nq, wd),
+                buf_d, buf_i, visited, itopk=itopk, ip_metric=ip_metric,
+                interpret=pallas_interpret)
+            return buf_d, buf_i, visited, it + 1
 
         ipx = jnp.einsum("qp,qwdp->qwd", qp_t, nb_p,
                          preferred_element_type=jnp.float32)
@@ -1932,13 +1947,24 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
             rerank = min(itopk,
                          params.rerank_topk or max(32, 2 * k))
             rerank = max(rerank, k)
-            with obs.stage("cagra.search.walk") as st:
+            # low-batch latency path: fuse each hop's score/dedupe/merge
+            # into one Pallas kernel (serving buckets of 1-64; ids must
+            # be f32-exact for the in-kernel id lanes)
+            from raft_tpu.ops import cagra_hop_pallas as chp
+            wd = params.search_width * index.graph_degree
+            fused = (jax.default_backend() == "tpu"
+                     and index.size < (1 << 24)
+                     and chp.supported_hop(queries.shape[0], itopk, wd,
+                                           min(pdim, index.dim)))
+            stage = ("cagra.search.fused_walk" if fused
+                     else "cagra.search.walk")
+            with obs.stage(stage) as st:
                 out = _search_impl_walk(
                     index.dataset, cache.table, cache.entry_proj,
                     cache.entry_sq, cache.entry_ids, cache.proj, queries,
                     k, itopk, params.search_width, max_iter, index.metric,
                     rerank, index.graph_degree, quant=cache.quant,
-                    scales=cache.scales)
+                    scales=cache.scales, fused_hop=fused)
                 st.fence(out)
             return out
 

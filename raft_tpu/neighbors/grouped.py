@@ -157,6 +157,36 @@ def build_groups(probes: jax.Array, n_lists: int, n_groups: int
     return group_list, slot_pairs
 
 
+@functools.partial(jax.jit, static_argnames=("n_lists",))
+def probe_overlap_order(probes: jax.Array, n_lists: int) -> jax.Array:
+    """Probe-overlap query grouping: a permutation of the batch's queries
+    that clusters queries probing the SAME lists.
+
+    Queries sort by their (rank-0, rank-1) probe pair — nearest coarse
+    centers, the strongest overlap signal the probe table carries.
+    Combined with :func:`build_groups`'s (list, pair-index) sort this
+    makes a hot list's pair groups hold runs of CONSECUTIVE queries:
+
+    - adjacent groups of one list keep the same BlockSpec index, so the
+      Pallas pipeline skips the re-DMA and each hot list's data streams
+      from HBM once per BATCH, not once per probing query;
+    - the fused kernels' accumulator one-hots touch a narrow band of
+      query rows per group (the prerequisite for windowed merges).
+
+    Returns ``qorder`` (nq,) int32; callers permute queries/probes by it
+    before grouping and un-permute results with ``argsort(qorder)``.
+    The permutation changes only iteration order — distances and ids
+    are untouched.
+    """
+    n_probes = probes.shape[1]
+    r0 = probes[:, 0].astype(jnp.int32)
+    r1 = probes[:, min(1, n_probes - 1)].astype(jnp.int32)
+    # n_lists^2 fits int32 up to 46k lists; clamp sentinels (>= n_lists,
+    # from super-tile dedupe) into range so the key stays monotone
+    key = jnp.minimum(r0, n_lists) * (n_lists + 1) + jnp.minimum(r1, n_lists)
+    return jnp.argsort(key).astype(jnp.int32)
+
+
 @functools.partial(jax.jit, static_argnames=("factor", "n_super"))
 def dedup_super_probes(probes: jax.Array, factor: int, n_super: int
                        ) -> jax.Array:
@@ -241,7 +271,22 @@ def scan_traffic(rot: int, pq_dim: int = 0, pq_bits: int = 0) -> dict:
     if pq_dim and pq_bits:
         w_bytes = -(-pq_dim * pq_bits // 8)
         out["codes"] = 4 * -(-w_bytes // 4) + base
+    # fused mode streams the same candidate rows as its backing source
+    # (codes when eligible, else recon) — its win is on the OUTPUT side:
+    # the per-pair (vals, ids) round-trip plus scatter and final select
+    # disappear (see pair_output_traffic)
+    out["fused"] = out.get("codes", out["recon"])
     return out
+
+
+def pair_output_traffic(kt: int) -> int:
+    """Per-(query, probe) HBM bytes of the NON-fused epilogue that the
+    fused kernels eliminate: the kernel's (kt f32, kt int32) output
+    write, the scatter's read + packed write, and the final select's
+    read of the (P, 2*kt) buffers.  This is the round-7 column of the
+    decomposition profile."""
+    row = 2 * 4 * kt                  # one (vals, ids) pair row
+    return row * 4                    # write + scatter r/w + select read
 
 
 def block_size(n_groups: int, *per_group_bytes: int,

@@ -130,9 +130,18 @@ class SearchParams:
     #              (1 B/dim/row, in-register dequantization);
     #   "lut"    — the XLA take_along_axis LUT formulation (traceable,
     #              memory-lean; the AOT export path);
+    #   "fused"  — the in-kernel top-k variants of "codes"/"recon": a
+    #              per-query accumulator lives in VMEM across the whole
+    #              scan grid, so candidates never reach HBM and the
+    #              scatter + final-select extraction stage disappears
+    #              (backed by the compact-code kernel when eligible,
+    #              else the recon cache; falls back to the non-fused
+    #              path off-TPU or for unsupported shapes, counted by
+    #              the ivf_pq.search.fused_fallback counter);
     #   "auto"   — "recon" when the index carries the cache, else "codes"
     #              when the kernel supports the index's static config,
-    #              else "lut".
+    #              else "lut" — UPGRADED to the fused kernel whenever
+    #              the batch's shape supports it on TPU.
     scan_mode: str = "auto"
     # Per-(query, probe) candidates kept by the grouped scans before the
     # final merge (the kernel's kt).  0 -> k.  The grouped kernels are
@@ -1189,6 +1198,89 @@ def _search_impl_recon8_grouped(centers, list_recon_i8, list_recon_scale,
                    DistanceType.L2SqrtUnexpanded), select_k)
 
 
+def _fused_epilogue(vals, ids, qorder, nq, k, metric):
+    """Shared tail of the fused scans: column-major (k, nq_pad) kernel
+    output -> (nq, k) rows, finite-worst sentinel -> the public +inf /
+    id -1 contract, sqrt for the sqrt-L2 metrics, and the un-permute of
+    the probe-overlap query order.  Note what is ABSENT: no scatter and
+    no select — the kernel already holds each query's final top-k."""
+    from raft_tpu.ops.pq_group_scan_pallas import _ACC_WORST
+
+    d = vals[:, :nq].T
+    i = ids[:, :nq].T
+    bad = d >= _ACC_WORST / 2
+    d = jnp.where(bad, jnp.inf, d)
+    i = jnp.where(bad, -1, i)
+    if metric in (DistanceType.L2SqrtExpanded,
+                  DistanceType.L2SqrtUnexpanded):
+        d = jnp.sqrt(jnp.maximum(d, 0.0))
+    inv = jnp.argsort(qorder)
+    return d[inv], i[inv]
+
+
+@functools.partial(jax.jit, static_argnames=("k", "kt", "metric", "n_groups",
+                                             "pq_bits", "pallas_interpret"))
+def _search_impl_fused_codes_grouped(centers, codebooks, list_code_lanes,
+                                     list_code_rsq, list_indices, rotation,
+                                     queries, probes, k, kt, metric,
+                                     n_groups, pq_bits,
+                                     pallas_interpret=False):
+    """Fused compact-code scan: the grouped code scan with the per-query
+    top-k folded INTO the kernel (pq_code_scan_pallas
+    ``grouped_code_scan_fused``) — per-pair candidates never reach HBM,
+    and the scatter + final-select stages of
+    :func:`_search_impl_codes_grouped` do not exist here.  Queries are
+    pre-permuted by probe overlap (grouped.probe_overlap_order) so hot
+    lists stream once per batch."""
+    from raft_tpu.neighbors import grouped
+    from raft_tpu.ops import pq_code_scan_pallas as pcs
+
+    nq, n_probes = probes.shape
+    n_lists = centers.shape[0]
+    cap = list_code_lanes.shape[2]
+    qrot = queries.astype(jnp.float32) @ rotation
+    cf = centers.astype(jnp.float32)
+
+    qorder = grouped.probe_overlap_order(probes, n_lists)
+    group_list, slot_pairs = grouped.build_groups(probes[qorder], n_lists,
+                                                  n_groups)
+    kt = min(kt or k, cap)
+    vals, ids = pcs.grouped_code_scan_fused(
+        group_list, slot_pairs, qrot[qorder], cf, list_code_lanes,
+        codebooks, list_code_rsq, list_indices, kt, k, n_probes, pq_bits,
+        interpret=pallas_interpret)
+    return _fused_epilogue(vals, ids, qorder, nq, k, metric)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "kt", "metric", "n_groups",
+                                             "pallas_interpret"))
+def _search_impl_fused_recon_grouped(centers, list_recon, list_recon_sq,
+                                     list_indices, rotation, queries,
+                                     probes, k, kt, metric, n_groups,
+                                     pallas_interpret=False):
+    """Fused recon scan: :func:`_search_impl_recon_grouped`'s Pallas
+    path with the per-query top-k folded into the kernel
+    (pq_group_scan_pallas ``grouped_l2_scan_fused``) — same quantized
+    distances, no scatter, no final select."""
+    from raft_tpu.neighbors import grouped
+    from raft_tpu.ops import pq_group_scan_pallas as pqp
+
+    nq, n_probes = probes.shape
+    n_lists, cap, _ = list_recon.shape
+    qrot = queries.astype(jnp.float32) @ rotation
+    cf = centers.astype(jnp.float32)
+
+    qorder = grouped.probe_overlap_order(probes, n_lists)
+    group_list, slot_pairs = grouped.build_groups(probes[qorder], n_lists,
+                                                  n_groups)
+    kt = min(kt or k, cap)
+    vals, ids = pqp.grouped_l2_scan_fused(
+        group_list, slot_pairs, qrot[qorder], cf, list_recon,
+        list_recon_sq, list_indices, kt, k, n_probes,
+        interpret=pallas_interpret)
+    return _fused_epilogue(vals, ids, qorder, nq, k, metric)
+
+
 # ---------------------------------------------------------------------------
 # search
 # ---------------------------------------------------------------------------
@@ -1278,7 +1370,7 @@ def _search_impl(centers, codebooks, list_codes, list_indices, rotation,
                    DistanceType.L2SqrtUnexpanded), select_k)
 
 
-_SCAN_MODES = ("auto", "codes", "recon", "recon8", "lut")
+_SCAN_MODES = ("auto", "codes", "recon", "recon8", "lut", "fused")
 
 _L2_METRICS = (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
                DistanceType.L2Unexpanded, DistanceType.L2SqrtUnexpanded)
@@ -1346,6 +1438,17 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
         kt_req = int(getattr(params, "per_probe_topk", 0) or 0)
         packed = bool(getattr(params, "packed_extract", False))
 
+        # "fused" and "auto" both resolve to a BACKING mode (codes /
+        # recon / lut) that owns the derived caches and the fallback
+        # path; want_fused marks that the grouped dispatch should
+        # upgrade to the in-kernel top-k variant when the batch's shape
+        # supports it.  Every upgrade miss is counted
+        # (ivf_pq.search.fused_fallback) — the CI tripwire watches it.
+        want_fused = mode in ("auto", "fused")
+        if mode == "fused":
+            mode = ("codes" if _codes_mode_eligible(index)
+                    else "recon" if index.list_recon is not None
+                    else "lut")
         if mode == "auto":
             if index.list_recon is not None:
                 mode = "recon"
@@ -1355,6 +1458,10 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
                 mode = "lut"
         if mode in ("codes", "recon8") and index.metric not in _L2_METRICS:
             mode = "lut" if index.list_recon is None else "recon"
+
+        def note_fused_fallback():
+            if obs.enabled():
+                obs.registry().counter("ivf_pq.search.fused_fallback").inc()
 
         tracing = (isinstance(queries, jax.core.Tracer)
                    or isinstance(index.centers, jax.core.Tracer))
@@ -1392,6 +1499,8 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
             return out
 
         if mode == "lut":
+            if want_fused:
+                note_fused_fallback()
             return lut_scan()
 
         from raft_tpu.neighbors import grouped
@@ -1447,6 +1556,8 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
             # no XLA twin of the codes kernel is worth running (it would
             # re-decode every row anyway) — the LUT formulation computes
             # the same quantized distance
+            if want_fused:
+                note_fused_fallback()
             return lut_scan()
 
         with obs.stage("ivf_pq.search.coarse") as st:
@@ -1474,6 +1585,21 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
             return out
 
         if mode == "codes":
+            if want_fused:
+                if pcs.supported_fused_codes(True, True, cap, rot, kt, k,
+                                             nq, index.pq_dim,
+                                             index.pq_bits):
+                    # one stage where code_scan + extraction used to be
+                    # two: the kernel output IS the final top-k
+                    return run_grouped(
+                        "ivf_pq.search.fused_scan",
+                        lambda ng: _search_impl_fused_codes_grouped(
+                            index.centers, index.codebooks,
+                            index.list_code_lanes, index.list_code_rsq,
+                            index.list_indices, index.rotation, queries,
+                            probes, k, kt, index.metric, ng,
+                            index.pq_bits))
+                note_fused_fallback()
             return run_grouped(
                 "ivf_pq.search.code_scan",
                 lambda ng: _search_impl_codes_grouped(
@@ -1504,6 +1630,20 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
             return run_grouped("ivf_pq.search.recon8_scan", dispatch8)
 
         use_pallas = on_tpu and ids_ok
+
+        if want_fused:
+            from raft_tpu.ops import pq_group_scan_pallas as pqp
+
+            if use_pallas and pqp.supported_fused(
+                    index.metric in _L2_METRICS, cap, rot, kt, k, nq):
+                return run_grouped(
+                    "ivf_pq.search.fused_scan",
+                    lambda ng: _search_impl_fused_recon_grouped(
+                        index.centers, index.list_recon,
+                        index.list_recon_sq, index.list_indices,
+                        index.rotation, queries, probes, k, kt,
+                        index.metric, ng))
+            note_fused_fallback()
 
         def dispatch(ng):
             block = grouped.block_size(

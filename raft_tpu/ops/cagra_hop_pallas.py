@@ -1,0 +1,156 @@
+"""Pallas TPU kernel: fused CAGRA hop (score + dedupe + buffer merge).
+
+The low-batch CAGRA serving path (buckets of 1-64 queries) spends its
+hop not on the fat-row gather — one scattered fetch per parent — but on
+the candidate epilogue: the (q, wd) approximate-distance matrix, the
+membership-mask dedupe, and the bitonic buffer merge all round-trip
+through HBM between XLA fusions, and at nq <= 64 every one of those
+intermediates is a sliver that cannot amortize its traffic.  This kernel
+applies the round-7 IVF-PQ fusion shape (see
+:mod:`raft_tpu.ops.pq_group_scan_pallas`) to the graph walk: one kernel
+invocation per hop scores all ``wd = search_width * graph_degree``
+decoded neighbors against the queries, merges them into the sorted
+``itopk`` buffer, and writes back ONLY the buffer — candidate distances
+never touch HBM.
+
+Dedupe happens *inside* the merge rather than as a pre-pass: each of the
+``itopk`` min-extraction rounds neutralizes every remaining copy of the
+extracted id, which removes candidate-vs-buffer and candidate-vs-self
+duplicates in O(itopk * rows) vector ops instead of the O(wd^2)
+membership masks of :func:`raft_tpu.neighbors.cagra._merge_candidates`.
+Ties select the lowest concatenated row, and buffer rows come first, so
+a candidate duplicating a buffer entry yields to the buffer copy and its
+``visited`` flag — the walk's termination invariant is preserved.
+
+Layout: queries ride the 128-lane axis (padded), buffer / candidate
+slots ride sublanes, and ids + visited flags travel as exact f32 lanes
+(ids < 2^24; the caller gates on index size).  Buffer values may be
+``+inf`` (empty slots, id -1) — safe here because nothing multiplies
+them; the IVF-PQ kernels' finite-sentinel trick is not needed.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# serving-bucket bounds: the fused hop targets the low-latency regime
+_HOP_MAX_BATCH = 64
+_HOP_MAX_ITOPK = 32
+_HOP_MAX_WD = 128
+_HOP_VMEM_BUDGET = 8 << 20
+_LANES = 128
+
+
+def supported_hop(nq: int, itopk: int, wd: int, pdim: int) -> bool:
+    """Static shape gate for the fused hop kernel (VMEM + unroll)."""
+    if not (0 < nq <= _HOP_MAX_BATCH and 0 < itopk <= _HOP_MAX_ITOPK):
+        return False
+    if not (0 < wd <= _HOP_MAX_WD and 0 < pdim <= 256):
+        return False
+    rows = itopk + wd
+    vmem = (wd * pdim * _LANES * 4          # neighbor lanes
+            + (pdim + 1) * _LANES * 4       # qpT + q_sq
+            + 2 * wd * _LANES * 4           # nb_sq / nb_id
+            + 9 * itopk * _LANES * 4        # buffer triple, in + out
+            + 4 * rows * _LANES * 4)        # merge working set
+    return vmem <= _HOP_VMEM_BUDGET
+
+
+def _kernel_hop(qpT_ref, qsq_ref, nbp_ref, nbsq_ref, nbid_ref,
+                bufd_ref, bufi_ref, vis_ref,
+                od_ref, oi_ref, ov_ref, *,
+                itopk: int, wd: int, pdim: int, ip_metric: bool):
+    nq = qpT_ref.shape[1]
+    qpT = qpT_ref[:]                                   # (pdim, nq)
+
+    # ---- score: wd unrolled VPU rows, candidates stay in VMEM ----------
+    ip_rows = []
+    for j in range(wd):
+        nb_j = nbp_ref[j * pdim:(j + 1) * pdim, :]     # (pdim, nq)
+        ip_rows.append(jnp.sum(qpT * nb_j, axis=0, keepdims=True))
+    ip = jnp.concatenate(ip_rows, axis=0)              # (wd, nq)
+    if ip_metric:
+        d = -ip                                        # KEY space
+    else:
+        d = qsq_ref[:] + nbsq_ref[:] - 2.0 * ip
+    cid = nbid_ref[:]                                  # (wd, nq) f32 ids
+    ok = cid >= 0.0
+    d = jnp.where(ok, d, jnp.inf)
+    cid = jnp.where(ok, cid, -1.0)
+
+    # ---- merge with in-pass dedupe -------------------------------------
+    cat_v = jnp.concatenate([bufd_ref[:], d], axis=0)  # (rows, nq)
+    cat_i = jnp.concatenate([bufi_ref[:], cid], axis=0)
+    cat_s = jnp.concatenate([vis_ref[:], jnp.zeros_like(d)], axis=0)
+    rows = itopk + wd
+    riota = jax.lax.broadcasted_iota(jnp.int32, (rows, nq), 0)
+    out_d, out_i, out_s = [], [], []
+    for _ in range(itopk):
+        m = jnp.min(cat_v, axis=0, keepdims=True)
+        hit = cat_v == m
+        rmin = jnp.min(jnp.where(hit, riota, rows), axis=0, keepdims=True)
+        sel = riota == rmin
+        wi = jnp.sum(jnp.where(sel, cat_i, 0.0), axis=0, keepdims=True)
+        ws = jnp.max(jnp.where(sel, cat_s, 0.0), axis=0, keepdims=True)
+        out_d.append(m)
+        out_i.append(wi)
+        out_s.append(ws)
+        # kill the winner AND every other copy of its id: this is the
+        # dedupe — a real id appears at most once in the output buffer
+        kill = sel | ((cat_i == wi) & (wi >= 0.0))
+        cat_v = jnp.where(kill, jnp.inf, cat_v)
+    od_ref[:] = jnp.concatenate(out_d, axis=0)
+    oi_ref[:] = jnp.concatenate(out_i, axis=0)
+    ov_ref[:] = jnp.concatenate(out_s, axis=0)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("itopk", "ip_metric", "interpret"))
+def fused_hop(qp_t, q_sq, nb_p, nb_sq, nb_id, buf_d, buf_i, visited, *,
+              itopk: int, ip_metric: bool, interpret: bool = False
+              ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One fused graph-walk hop.
+
+    Args (natural walk layout, nq rows):
+      qp_t     (nq, pdim) query projections (table scale already folded)
+      q_sq     (nq,) exact query squared norms
+      nb_p     (nq, wd, pdim) decoded neighbor projections
+      nb_sq    (nq, wd) neighbor squared norms
+      nb_id    (nq, wd) int32 neighbor ids, -1 = masked parent slot
+      buf_d / buf_i / visited   (nq, itopk) sorted candidate buffer
+
+    Returns the merged (buf_d, buf_i int32, visited bool), sorted
+    ascending-better, ids deduped — drop-in for the XLA
+    ``_merge_candidates`` + ``_bitonic_merge`` pair.
+    """
+    nq, wd, pdim = nb_p.shape
+    pad = _LANES - nq
+
+    def col(x, fill):
+        x = x.astype(jnp.float32)
+        return jnp.pad(x.T, ((0, 0), (0, pad)), constant_values=fill)
+
+    qpT = col(qp_t, 0.0)                               # (pdim, LANES)
+    qsq = col(q_sq[:, None], 0.0)                      # (1, LANES)
+    nbp = jnp.pad(
+        jnp.transpose(nb_p.astype(jnp.float32), (1, 2, 0)),
+        ((0, 0), (0, 0), (0, pad))).reshape(wd * pdim, _LANES)
+    nbsq = col(nb_sq, 0.0)                             # (wd, LANES)
+    nbid = col(nb_id, -1.0)
+    bufd = col(buf_d, jnp.inf)
+    bufi = col(buf_i, -1.0)
+    vis = col(visited, 1.0)
+
+    out = pl.pallas_call(
+        functools.partial(_kernel_hop, itopk=itopk, wd=wd, pdim=pdim,
+                          ip_metric=ip_metric),
+        out_shape=[jax.ShapeDtypeStruct((itopk, _LANES), jnp.float32)] * 3,
+        interpret=interpret,
+    )(qpT, qsq, nbp, nbsq, nbid, bufd, bufi, vis)
+    od, oi, ov = (o[:, :nq].T for o in out)
+    return od, oi.astype(jnp.int32), ov > 0.5

@@ -52,8 +52,11 @@ from jax.experimental.pallas import tpu as pltpu
 from raft_tpu.neighbors.grouped import GROUP
 from raft_tpu.ops.pq_group_scan_pallas import (_KT_MAX, _KT_UNROLL,
                                                _extract_topk,
+                                               _fused_accumulate,
                                                _gather_queries,
+                                               _gather_queries_masked,
                                                _scratch_shapes)
+from raft_tpu.ops.pq_group_scan_pallas import _ACC_WORST  # noqa: F401 (re-export)
 
 _VMEM_BUDGET = 10 << 20
 
@@ -212,6 +215,101 @@ def _kernel_recon8(gl_ref, slot_ref, qrot_ref, cf_ref, data_ref, scale_ref,
     d = jnp.maximum(d, 0.0)
     _extract(d, ids_ref, vals_ref, ids_out_ref, vscratch, pscratch, kt,
              packed, cap_bits)
+
+
+def _kernel_codes_fused(gl_ref, slot_ref, qrot_ref, cf_ref, codes_ref,
+                        cb_ref, rsq_ref, ids_ref, vals_ref, ids_out_ref,
+                        acc_v, acc_i, *, kt, k, n_probes, P, pq_dim,
+                        pq_bits, n_groups):
+    """Fused compact-code scan: the ``_kernel_codes`` decode + distance
+    block feeding the in-kernel per-query accumulator
+    (pq_group_scan_pallas._fused_accumulate) instead of per-pair output
+    rows — candidates never reach HBM; the final (k, nq_pad) answers
+    flush once on the last grid step."""
+    g = pl.program_id(0)
+
+    @pl.when(g == 0)
+    def _init():
+        acc_v[:] = jnp.full(acc_v.shape, _ACC_WORST, jnp.float32)
+        acc_i[:] = jnp.full(acc_i.shape, -1.0, jnp.float32)
+
+    qv, oh = _gather_queries_masked(slot_ref, qrot_ref, n_probes, P)
+    sub = qv - cf_ref[0, 0][None, :]                     # (G, rot_pad) f32
+    sub_sq = jnp.sum(sub * sub, axis=1)                  # (G,)
+    cap = codes_ref.shape[2]
+    reconT = _decode_reconT(codes_ref, cb_ref, pq_dim, pq_bits,
+                            qrot_ref.shape[1], cap)      # (rot_pad, cap)
+    ip = jax.lax.dot_general(sub.astype(jnp.bfloat16), reconT,
+                             (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    d = sub_sq[:, None] + rsq_ref[0, 0][None, :] - 2.0 * ip
+    d = jnp.maximum(d, 0.0)
+    _fused_accumulate(oh, d, ids_ref[0, 0], acc_v, acc_i, kt)
+
+    @pl.when(g == n_groups - 1)
+    def _flush():
+        vals_ref[:] = acc_v[:]
+        ids_out_ref[:] = acc_i[:].astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("kt", "k", "n_probes",
+                                             "pq_bits", "interpret"))
+def grouped_code_scan_fused(group_list, slot_pairs, qrot, centers_f32,
+                            codes_lanes, codebooks, rsq, list_indices, kt,
+                            k, n_probes, pq_bits, interpret=False):
+    """Fused compact-code scan with IN-KERNEL per-query top-k.
+
+    Inputs as :func:`grouped_code_scan`; output contract as
+    ``pq_group_scan_pallas.grouped_l2_scan_fused`` — the batch's final
+    ``(vals (k, nq_pad) f32, ids (k, nq_pad) int32)``, ascending per
+    column, exhausted ranks at the finite ``_ACC_WORST`` sentinel.
+    """
+    n_groups = group_list.shape[0]
+    nq, rot = qrot.shape
+    _, _, cap = codes_lanes.shape
+    pq_dim, book, pq_len = codebooks.shape
+    Wi = codes_lanes.shape[1]
+    P = nq * n_probes
+    rot_pad = _round_up(rot, 128)
+
+    nq_pad = _round_up(nq + 1, 128)
+    qrot_pad = jnp.zeros((nq_pad, rot_pad), jnp.float32)
+    qrot_pad = qrot_pad.at[:nq, :rot].set(qrot.astype(jnp.float32))
+    cf_pad = _pad_lanes(centers_f32, rot_pad)
+    cbT = jnp.swapaxes(codebooks.astype(jnp.float32), 1, 2)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_groups,),
+        in_specs=[
+            pl.BlockSpec((1, 1, GROUP), lambda g, gl: (g, 0, 0)),
+            pl.BlockSpec((nq_pad, rot_pad), lambda g, gl: (0, 0)),
+            pl.BlockSpec((1, 1, rot_pad), lambda g, gl: (gl[g], 0, 0)),
+            pl.BlockSpec((1, Wi, cap), lambda g, gl: (gl[g], 0, 0)),
+            pl.BlockSpec((pq_dim, pq_len, book), lambda g, gl: (0, 0, 0)),
+            pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
+            pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((k, nq_pad), lambda g, gl: (0, 0)),
+            pl.BlockSpec((k, nq_pad), lambda g, gl: (0, 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((k, nq_pad), jnp.float32),
+                        pltpu.VMEM((k, nq_pad), jnp.float32)],
+    )
+    vals, gids = pl.pallas_call(
+        functools.partial(_kernel_codes_fused, kt=kt, k=k,
+                          n_probes=n_probes, P=P, pq_dim=pq_dim,
+                          pq_bits=pq_bits, n_groups=n_groups),
+        out_shape=[
+            jax.ShapeDtypeStruct((k, nq_pad), jnp.float32),
+            jax.ShapeDtypeStruct((k, nq_pad), jnp.int32),
+        ],
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(group_list, slot_pairs[:, None, :], qrot_pad, cf_pad[:, None, :],
+      codes_lanes, cbT, rsq[:, None, :], list_indices[:, None, :])
+    return vals, gids
 
 
 def _pad_lanes(x, width):
@@ -380,6 +478,24 @@ def supported_codes(metric_is_l2: bool, per_subspace: bool, cap: int,
             + 2 * GROUP * cap * 4)              # distances + extraction
     return (cap % 16 == 0 and GROUP % 16 == 0 and _extract_ok(kt, packed)
             and nq <= 6144 and vmem <= _VMEM_BUDGET)
+
+
+def supported_fused_codes(metric_is_l2: bool, per_subspace: bool, cap: int,
+                          rot: int, kt: int, k: int, nq: int, pq_dim: int,
+                          pq_bits: int) -> bool:
+    """Shapes the FUSED code-scan kernel handles: the static
+    :func:`supported_codes` preconditions (generic extraction — the
+    packed-key variant has no fused twin) plus the (k, nq_pad)
+    accumulator pair in the VMEM budget and k bounded to the unrolled
+    merge regime."""
+    if not supported_codes(metric_is_l2, per_subspace, cap, rot, kt, nq,
+                           pq_dim, pq_bits, packed=False):
+        return False
+    nq_pad = _round_up(nq + 1, 128)
+    acc = (2 * k * nq_pad * 4                 # accumulator rows
+           + 4 * (k + kt) * GROUP * 4)        # gather/merge temps
+    return (0 < kt <= _KT_UNROLL and 0 < k <= _KT_UNROLL
+            and acc <= (2 << 20))
 
 
 def supported_recon8(metric_is_l2: bool, cap: int, rot: int, kt: int,

@@ -47,6 +47,14 @@ from raft_tpu.neighbors.grouped import GROUP
 _KT_UNROLL = 64
 _KT_MAX = 128
 
+# Finite "worst distance" sentinel of the fused accumulator.  The
+# accumulator is read and written through one-hot f32 contractions, and
+# IEEE 0 * inf = nan would leak a +inf sentinel into every gathered row
+# — so the fused kernels keep exhausted slots at a large FINITE value
+# and the epilogue maps values past _ACC_WORST/2 to the public
+# +inf / id -1 contract.
+_ACC_WORST = 3.0e38
+
 
 def _scratch_shapes(kt):
     if kt <= _KT_UNROLL:
@@ -136,6 +144,223 @@ def _extract_topk(d, ids_row, vals_ref, ids_out_ref, vscratch, pscratch,
         jax.lax.fori_loop(0, kt, body, neg, unroll=False)
         vals_ref[0] = vscratch[:kt, :].T
         ids_out_ref[0] = pscratch[:kt, :].T
+
+
+# ---------------------------------------------------------------------------
+# fused in-kernel top-k: candidates never touch HBM
+# ---------------------------------------------------------------------------
+#
+# The non-fused kernels emit (n_groups, GROUP, kt) per-pair winners that
+# the XLA side scatters into (P, kt) buffers and reduces with a final
+# select — at bench shapes that round-trip plus the select is the
+# dominant remaining cost (PERFORMANCE.md round 6: ~3.3 us per kept
+# candidate).  The fused variants exploit the TPU grid's SEQUENTIAL
+# execution: a (k, nq_pad) per-query accumulator lives in VMEM scratch
+# across ALL grid steps, each group's local top-kt is merged into its
+# queries' rows in-kernel, and only the final (k, nq_pad) answer is
+# written to HBM on the last step.  No scatter, no final select — the
+# extraction stage disappears from the profile.
+#
+# The accumulator is addressed by query id through the SAME one-hot
+# matrix the query gather builds (rows are gathered by
+# ``onehot @ acc`` and written back as ``acc*(1-cover) + onehotT @
+# merged``).  Every slot of a group holds a DISTINCT query (a group is
+# one list; a query probes each list at most once), so the write-back
+# touches each row through exactly one one-hot lane — the update is
+# EXACT in f32, and candidate ids ride along as exact-below-2^24 f32
+# lanes just like the id mapping of the non-fused extraction.
+
+
+def _gather_queries_masked(slot_ref, q_ref, n_probes, P):
+    """Query gather that also returns the validity-masked one-hot used
+    to address the fused accumulator.  Sentinel slots have an all-zero
+    one-hot row: they gather the zero query row AND are excluded from
+    the accumulator write-back (their merged columns are discarded)."""
+    nq_pad = q_ref.shape[0]
+    slot = slot_ref[0, 0]                              # (G,) int32 pair ids
+    valid = slot < P
+    qid = jnp.where(valid, slot // n_probes, 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (GROUP, nq_pad), 1)
+    oh = ((cols == qid[:, None]) & valid[:, None]).astype(jnp.float32)
+    qv = jax.lax.dot_general(oh, q_ref[:], (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    return qv, oh
+
+
+def _topk_rows(d, ids_row, kt):
+    """Local top-kt of a (G, cap) distance block as sublane-stacked
+    (kt, G) value/id rows — the fused twin of :func:`_extract_topk`
+    (same max / where-iota argmin / masked-id-reduce passes), except
+    results stay in registers for the in-kernel merge and exhausted
+    slots carry the finite ``_ACC_WORST`` instead of +inf."""
+    invalid = (ids_row < 0)[None, :]
+    neg = jnp.where(invalid, -jnp.inf, -d)
+    cap = neg.shape[1]
+    col = jax.lax.broadcasted_iota(jnp.int32, neg.shape, 1)
+    ids_f = ids_row.astype(jnp.float32)                # exact below 2^24
+    vs, gs = [], []
+    for _ in range(kt):
+        m = jnp.max(neg, axis=1)                       # (G,)
+        p = jnp.min(jnp.where(neg == m[:, None], col, cap), axis=1)
+        p = jnp.minimum(p, cap - 1)                    # all -inf row guard
+        sel = col == p[:, None]
+        gid = jnp.max(jnp.where(sel, ids_f[None, :], -jnp.inf), axis=1)
+        v = jnp.where(jnp.isinf(m), _ACC_WORST, -m)
+        vs.append(v[None, :])
+        gs.append(gid[None, :])
+        neg = jnp.where(sel, -jnp.inf, neg)
+    return jnp.concatenate(vs, 0), jnp.concatenate(gs, 0)   # (kt, G)
+
+
+def _merge_topk(cat_v, cat_i, k):
+    """k selection passes over sublane-stacked (rows, G) candidates:
+    merge of the accumulator's sorted k rows with a group's local kt
+    rows.  Cross-SUBLANE reduces (rows <= k + kt, tiny) — the lane axis
+    stays the 128 pair slots."""
+    rows_n = cat_v.shape[0]
+    rows = jax.lax.broadcasted_iota(jnp.int32, cat_v.shape, 0)
+    out_v, out_i = [], []
+    for _ in range(k):
+        m = jnp.min(cat_v, axis=0)                     # (G,)
+        p = jnp.min(jnp.where(cat_v == m[None, :], rows, rows_n), axis=0)
+        p = jnp.minimum(p, rows_n - 1)
+        sel = rows == p[None, :]
+        gi = jnp.max(jnp.where(sel, cat_i, -jnp.inf), axis=0)
+        out_v.append(m[None, :])
+        out_i.append(gi[None, :])
+        cat_v = jnp.where(sel, _ACC_WORST, cat_v)
+    return jnp.concatenate(out_v, 0), jnp.concatenate(out_i, 0)  # (k, G)
+
+
+def _fused_accumulate(oh, d, ids_row, acc_v, acc_i, kt):
+    """Merge one group's (G, cap) distances into the per-query
+    accumulator: local top-kt, gather the slots' accumulator rows via
+    the one-hot, merge sorted k+kt candidates per slot, write back.
+    The one-hot write-back is exact (each real row is covered by at
+    most one slot; sentinel slots have all-zero one-hot rows)."""
+    k = acc_v.shape[0]
+    new_v, new_i = _topk_rows(d, ids_row, kt)          # (kt, G)
+    old_v = jax.lax.dot_general(acc_v[:], oh, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+    old_i = jax.lax.dot_general(acc_i[:], oh, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+    mer_v, mer_i = _merge_topk(jnp.concatenate([old_v, new_v], 0),
+                               jnp.concatenate([old_i, new_i], 0), k)
+    cover = jnp.sum(oh, axis=0)                        # (nq_pad,) 0/1
+    keep = (1.0 - cover)[None, :]
+    acc_v[:] = acc_v[:] * keep + jax.lax.dot_general(
+        mer_v, oh, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    acc_i[:] = acc_i[:] * keep + jax.lax.dot_general(
+        mer_i, oh, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _kernel_fused(gl_ref, slot_ref, qrot_ref, cf_ref, data_ref, rsq_ref,
+                  ids_ref, vals_ref, ids_out_ref, acc_v, acc_i, *, kt, k,
+                  n_probes, P, n_groups):
+    """Fused recon scan: the non-fused ``_kernel`` distance block plus
+    the in-kernel accumulator merge; outputs are the FINAL per-query
+    (k, nq_pad) answers, flushed once on the last grid step."""
+    g = pl.program_id(0)
+
+    @pl.when(g == 0)
+    def _init():
+        acc_v[:] = jnp.full(acc_v.shape, _ACC_WORST, jnp.float32)
+        acc_i[:] = jnp.full(acc_i.shape, -1.0, jnp.float32)
+
+    qv, oh = _gather_queries_masked(slot_ref, qrot_ref, n_probes, P)
+    sub = qv - cf_ref[0, 0][None, :]                   # (G, rot) f32
+    sub_sq = jnp.sum(sub * sub, axis=1)                # (G,)
+    data = data_ref[0]                                 # (cap, rot) bf16
+    ip = jax.lax.dot_general(sub.astype(jnp.bfloat16), data,
+                             (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    d = sub_sq[:, None] + rsq_ref[0, 0][None, :] - 2.0 * ip
+    d = jnp.maximum(d, 0.0)
+    _fused_accumulate(oh, d, ids_ref[0, 0], acc_v, acc_i, kt)
+
+    @pl.when(g == n_groups - 1)
+    def _flush():
+        vals_ref[:] = acc_v[:]
+        ids_out_ref[:] = acc_i[:].astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("kt", "k", "n_probes",
+                                             "interpret"))
+def grouped_l2_scan_fused(group_list, slot_pairs, qrot, centers_f32,
+                          list_recon, rec_sq, list_indices, kt, k, n_probes,
+                          interpret=False):
+    """Fused grouped recon scan with IN-KERNEL per-query top-k.
+
+    Inputs as :func:`grouped_l2_scan`; instead of per-pair winners the
+    kernel returns the batch's FINAL per-query answers —
+    ``(vals (k, nq_pad) f32, ids (k, nq_pad) int32)`` sorted ascending
+    per column, query q in column q.  Exhausted ranks carry values at
+    the finite ``_ACC_WORST`` sentinel (callers map values past
+    ``_ACC_WORST/2`` to +inf / id -1).  ``kt`` bounds the per-(query,
+    probe) keep-set exactly like the non-fused path: each group
+    contributes at most its local top-kt per pair before the merge, so
+    results match the scatter+select reference at matched kt.
+    """
+    n_groups = group_list.shape[0]
+    nq, rot = qrot.shape
+    _, cap, _ = list_recon.shape
+    P = nq * n_probes
+
+    nq_pad = -(-(nq + 1) // 128) * 128
+    qrot_pad = jnp.zeros((nq_pad, rot), jnp.float32)
+    qrot_pad = qrot_pad.at[:nq].set(qrot.astype(jnp.float32))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_groups,),
+        in_specs=[
+            pl.BlockSpec((1, 1, GROUP), lambda g, gl: (g, 0, 0)),
+            pl.BlockSpec((nq_pad, rot), lambda g, gl: (0, 0)),
+            pl.BlockSpec((1, 1, rot), lambda g, gl: (gl[g], 0, 0)),
+            pl.BlockSpec((1, cap, rot), lambda g, gl: (gl[g], 0, 0)),
+            pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
+            pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((k, nq_pad), lambda g, gl: (0, 0)),
+            pl.BlockSpec((k, nq_pad), lambda g, gl: (0, 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((k, nq_pad), jnp.float32),
+                        pltpu.VMEM((k, nq_pad), jnp.float32)],
+    )
+    vals, gids = pl.pallas_call(
+        functools.partial(_kernel_fused, kt=kt, k=k, n_probes=n_probes,
+                          P=P, n_groups=n_groups),
+        out_shape=[
+            jax.ShapeDtypeStruct((k, nq_pad), jnp.float32),
+            jax.ShapeDtypeStruct((k, nq_pad), jnp.int32),
+        ],
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(group_list, slot_pairs[:, None, :], qrot_pad,
+      centers_f32[:, None, :], list_recon, rec_sq[:, None, :],
+      list_indices[:, None, :])
+    return vals, gids
+
+
+def supported_fused(metric_is_l2: bool, cap: int, rot: int, kt: int,
+                    k: int, nq: int, data_elem_bytes: int = 2) -> bool:
+    """Shapes the fused recon kernel handles.  Beyond :func:`supported`:
+    the (k, nq_pad) accumulator pair joins the VMEM budget, and both kt
+    and k are bounded to the unrolled-extraction regime (the merge and
+    local passes are Python-unrolled)."""
+    nq_pad = -(-(nq + 1) // 128) * 128
+    vmem = (2 * nq_pad * rot * 4              # query table + one-hot
+            + cap * rot * data_elem_bytes     # per-list data block
+            + 2 * GROUP * cap * 4             # distances + local passes
+            + 2 * k * nq_pad * 4              # accumulator rows
+            + 4 * (k + kt) * GROUP * 4)       # gather/merge temps
+    return (metric_is_l2 and rot % 128 == 0 and cap % 16 == 0
+            and GROUP % 16 == 0 and 0 < kt <= _KT_UNROLL
+            and 0 < k <= _KT_UNROLL
+            and nq <= 6144 and vmem <= (10 << 20))
 
 
 def _kernel_flat(gl_ref, slot_ref, q_ref, data_ref, dsq_ref, ids_ref,

@@ -135,7 +135,7 @@ class Executor:
         if self.kind == "ivf_pq":
             n_probes = min(self.params.n_probes, self.index.n_lists)
             mode = getattr(self.params, "scan_mode", "auto")
-            if mode not in ("recon", "codes", "lut"):
+            if mode not in ("recon", "codes", "lut", "fused"):
                 mode = ("recon" if self.index.list_recon is not None
                         else "lut")
             return cache.get("ivf_pq", self.res, self.index, batch=bucket,

@@ -448,3 +448,133 @@ class TestMergeRefineDebugChecks:
                                              False, chunk=32,
                                              first_d=bad, with_d=True)
         assert out.shape == (32, kg)
+
+
+class TestFusedHop:
+    """Round-7 low-batch fused hop kernel (ops/cagra_hop_pallas):
+    score + dedupe + merge in one VMEM-resident pass, parity with the
+    XLA _merge_candidates/_bitonic_merge pair."""
+
+    def _hop_inputs(self):
+        rng = np.random.default_rng(0)
+        nq, itopk, wd, pdim = 5, 16, 24, 16
+        qp = rng.normal(size=(nq, pdim)).astype(np.float32)
+        qsq = (rng.random(nq) * 3).astype(np.float32)
+        nbp = rng.normal(size=(nq, wd, pdim)).astype(np.float32)
+        nbsq = (rng.random((nq, wd)) * 3).astype(np.float32)
+        nbid = rng.integers(0, 40, size=(nq, wd)).astype(np.int32)
+        nbid[0, :4] = -1                       # masked parent slots
+        nbid[1, 5] = nbid[1, 6]                # self-dup
+        # walk invariant: every copy of an id decodes the SAME table
+        # row, so dup slots must carry identical (proj, sq) payloads
+        for r in range(nq):
+            first = {}
+            for j in range(wd):
+                cid = int(nbid[r, j])
+                if cid < 0:
+                    continue
+                if cid in first:
+                    nbp[r, j] = nbp[r, first[cid]]
+                    nbsq[r, j] = nbsq[r, first[cid]]
+                else:
+                    first[cid] = j
+        d_c = (qsq[:, None] + nbsq
+               - 2.0 * np.einsum("qp,qwp->qw", qp, nbp)).astype(np.float32)
+        # sorted buffer, inf tail, ids disjoint from candidates (100+)
+        # except dups carrying the candidate's exact key (same formula
+        # on both sides in the real walk)
+        bufd = np.sort(rng.random((nq, itopk)).astype(np.float32) * 2,
+                       axis=1)
+        bufd[:, itopk - 3:] = np.inf
+        bufi = np.zeros((nq, itopk), np.int32)
+        for r in range(nq):
+            bufi[r] = np.random.default_rng(r).permutation(100)[:itopk]
+            bufi[r] += 100
+            for slot, j in ((2, 1), (5, 7)):
+                if nbid[r, j] >= 0:
+                    bufi[r, slot] = nbid[r, j]
+                    bufd[r, slot] = d_c[r, j]
+        order = np.argsort(bufd, axis=1)
+        bufd = np.take_along_axis(bufd, order, axis=1)
+        bufi = np.take_along_axis(bufi, order, axis=1)
+        bufi[bufd == np.inf] = -1
+        vis = np.asarray(np.random.default_rng(9)
+                         .random((nq, itopk)) < 0.3)
+        vis[bufd == np.inf] = False
+        return qp, qsq, nbp, nbsq, nbid, d_c, bufd, bufi, vis, itopk
+
+    def test_merge_parity_with_reference(self):
+        from raft_tpu.ops.cagra_hop_pallas import fused_hop
+        (qp, qsq, nbp, nbsq, nbid, d_c, bufd, bufi, vis,
+         itopk) = self._hop_inputs()
+        fd, fi, fv = fused_hop(
+            jnp.asarray(qp), jnp.asarray(qsq), jnp.asarray(nbp),
+            jnp.asarray(nbsq), jnp.asarray(nbid), jnp.asarray(bufd),
+            jnp.asarray(bufi), jnp.asarray(vis), itopk=itopk,
+            ip_metric=False, interpret=True)
+        d_ref = jnp.where(jnp.asarray(nbid) >= 0, jnp.asarray(d_c),
+                          jnp.inf)
+        rd, ri, rv = cagra._merge_candidates(
+            jnp.asarray(bufd), jnp.asarray(bufi), jnp.asarray(vis),
+            d_ref, jnp.asarray(nbid), itopk)
+        fd, fi, fv = map(np.asarray, (fd, fi, fv))
+        rd, ri, rv = map(np.asarray, (rd, ri, rv))
+        for r in range(fd.shape[0]):
+            finite = np.isfinite(rd[r])
+            np.testing.assert_array_equal(np.isfinite(fd[r]), finite)
+            np.testing.assert_allclose(fd[r][finite], rd[r][finite],
+                                       rtol=1e-5, atol=1e-5)
+            np.testing.assert_array_equal(fi[r][finite], ri[r][finite])
+            np.testing.assert_array_equal(fv[r][finite], rv[r][finite])
+            assert (fi[r][~finite] == -1).all()
+
+    def test_fused_walk_matches_reference_walk(self, res, dataset, index):
+        db, q = dataset
+        q = q[:8]
+        pdim = cagra._auto_pdim(index)
+        pdim, quant = cagra._search_table_format(index, pdim)
+        cache = cagra._walk_cache(res, index, pdim, 64, quant=quant)
+        k, itopk, sw = 5, 16, 1
+        args = (index.dataset, cache.table, cache.entry_proj,
+                cache.entry_sq, cache.entry_ids, cache.proj,
+                jnp.asarray(q), k, itopk, sw, 24, index.metric, 10,
+                index.graph_degree)
+        d0, i0 = cagra._search_impl_walk(*args, quant=cache.quant,
+                                         scales=cache.scales)
+        d1, i1 = cagra._search_impl_walk(*args, quant=cache.quant,
+                                         scales=cache.scales,
+                                         fused_hop=True,
+                                         pallas_interpret=True)
+        d0, i0, d1, i1 = map(np.asarray, (d0, i0, d1, i1))
+        ov = np.mean([len(set(i0[r]) & set(i1[r])) / k
+                      for r in range(len(q))])
+        assert ov >= 0.9
+        same = i0 == i1
+        np.testing.assert_allclose(d0[same], d1[same], rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_fused_walk_single_query(self, res, dataset, index):
+        db, q = dataset
+        q = q[:1]
+        pdim = cagra._auto_pdim(index)
+        pdim, quant = cagra._search_table_format(index, pdim)
+        cache = cagra._walk_cache(res, index, pdim, 64, quant=quant)
+        d, i = cagra._search_impl_walk(
+            index.dataset, cache.table, cache.entry_proj, cache.entry_sq,
+            cache.entry_ids, cache.proj, jnp.asarray(q), 5, 16, 1, 24,
+            index.metric, 10, index.graph_degree, quant=cache.quant,
+            scales=cache.scales, fused_hop=True, pallas_interpret=True)
+        d, i = np.asarray(d), np.asarray(i)
+        assert d.shape == (1, 5) and i.shape == (1, 5)
+        assert (np.diff(d, axis=1) >= -1e-5).all()
+        assert (i >= 0).all() and len(set(i[0])) == 5
+
+    def test_supported_hop_gate(self):
+        from raft_tpu.ops.cagra_hop_pallas import supported_hop
+        # serving buckets of 1-64 at low itopk pass
+        assert supported_hop(1, 16, 32, 32)
+        assert supported_hop(64, 32, 64, 64)
+        # throughput shapes do not
+        assert not supported_hop(5000, 32, 64, 64)
+        assert not supported_hop(64, 64, 64, 64)
+        assert not supported_hop(64, 16, 256, 64)

@@ -422,6 +422,143 @@ class TestCodeScan:
         assert abs(recalls["recon8"] - recalls["recon"]) < 0.05, recalls
 
 
+class TestFusedScan:
+    """Fused in-kernel top-k parity (interpret mode on CPU): the
+    per-query accumulator kernels must reproduce the scatter + select
+    reference path at matched kt — same candidates kept per (query,
+    probe), same final ids and distances."""
+
+    @pytest.mark.parametrize("kt", [0, 4])
+    def test_fused_codes_matches_reference_at_same_kt(self, scan_index,
+                                                      kt):
+        q, built = scan_index
+        index, probes, ng, _, _ = built[8]
+        rd, ri = ivf_pq._search_impl_codes_grouped(
+            index.centers, index.codebooks, index.list_code_lanes,
+            index.list_code_rsq, index.list_indices, index.rotation,
+            q, probes, 10, kt, index.metric, ng, index.pq_bits,
+            pallas_interpret=True)
+        fd, fi = ivf_pq._search_impl_fused_codes_grouped(
+            index.centers, index.codebooks, index.list_code_lanes,
+            index.list_code_rsq, index.list_indices, index.rotation,
+            q, probes, 10, kt, index.metric, ng, index.pq_bits,
+            pallas_interpret=True)
+        rd, ri = np.asarray(rd), np.asarray(ri)
+        fd, fi = np.asarray(fd), np.asarray(fi)
+        assert _overlap(fi, ri) > 0.95
+        fin = np.isfinite(rd) & np.isfinite(fd)
+        np.testing.assert_array_equal(np.isfinite(rd), np.isfinite(fd))
+        np.testing.assert_allclose(fd[fin], rd[fin], rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("pq_bits", [8, 4])
+    def test_fused_recon_matches_reference(self, scan_index, pq_bits):
+        q, built = scan_index
+        index, probes, ng, rd, ri = built[pq_bits]
+        fd, fi = ivf_pq._search_impl_fused_recon_grouped(
+            index.centers, index.list_recon, index.list_recon_sq,
+            index.list_indices, index.rotation, q, probes, 10,
+            0, index.metric, ng, pallas_interpret=True)
+        fd, fi = np.asarray(fd), np.asarray(fi)
+        assert _overlap(fi, ri) > 0.95
+        fin = np.isfinite(rd) & np.isfinite(fd)
+        np.testing.assert_allclose(fd[fin], rd[fin], rtol=1e-4, atol=1e-4)
+
+    def test_fused_kt_exceeds_list_length(self, scan_index):
+        """kt past the list capacity clips to cap — every candidate of
+        every probed list survives to the merge, so the fused result is
+        the exact union top-k."""
+        q, built = scan_index
+        index, probes, ng, _, _ = built[8]
+        cap = index.capacity
+        rd, ri = ivf_pq._search_impl_recon_grouped(
+            index.centers, index.list_recon, index.list_recon_sq,
+            index.list_indices, index.rotation, q, probes, 10,
+            index.metric, ng, 64, kt=cap + 7)
+        fd, fi = ivf_pq._search_impl_fused_recon_grouped(
+            index.centers, index.list_recon, index.list_recon_sq,
+            index.list_indices, index.rotation, q, probes, 10,
+            cap + 7, index.metric, ng, pallas_interpret=True)
+        assert _overlap(np.asarray(fi), np.asarray(ri)) > 0.95
+        fin = np.isfinite(np.asarray(rd))
+        np.testing.assert_allclose(np.asarray(fd)[fin],
+                                   np.asarray(rd)[fin],
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_fused_sentinel_rows_stay_masked(self, scan_index):
+        """id -1 rows (the integrity mask / tombstone contract) must
+        never surface from the fused kernel: zapped candidates drop out
+        and exhausted ranks keep id -1 / worst (+inf) distance."""
+        q, built = scan_index
+        index, probes, ng, _, _ = built[8]
+        zapped = jnp.asarray(
+            np.where(np.arange(index.capacity)[None, :] % 2 == 0,
+                     np.asarray(index.list_indices), -1))
+        fd, fi = ivf_pq._search_impl_fused_recon_grouped(
+            index.centers, index.list_recon, index.list_recon_sq,
+            zapped, index.rotation, q, probes, 10, 0, index.metric,
+            ng, pallas_interpret=True)
+        fd, fi = np.asarray(fd), np.asarray(fi)
+        surviving = set(np.asarray(zapped)[np.asarray(zapped) >= 0])
+        assert all(int(i) in surviving for i in fi[fi >= 0])
+        # exhausted ranks: -1 id paired with +inf distance, never a
+        # finite distance with a stale id
+        np.testing.assert_array_equal(fi == -1, ~np.isfinite(fd))
+
+    def test_fused_mode_recall_matches_recon_mode(self, res, dataset):
+        """Public search(): scan_mode="fused" lands the same recall as
+        "recon" at identical operating points (on CPU it falls back to
+        the non-fused backing path — same results either way)."""
+        db, q = dataset
+        params = ivf_pq.IndexParams(n_lists=16, pq_dim=32,
+                                    kmeans_n_iters=10)
+        index = ivf_pq.build(res, params, db)
+        _, ti = naive_knn(db, q, 10)
+        sp_r = ivf_pq.SearchParams(n_probes=16, scan_mode="recon")
+        _, i_r = ivf_pq.search(res, sp_r, index, q, 10)
+        sp_f = ivf_pq.SearchParams(n_probes=16, scan_mode="fused")
+        _, i_f = ivf_pq.search(res, sp_f, index, q, 10)
+        r_recon = recall(np.asarray(i_r), ti)
+        r_fused = recall(np.asarray(i_f), ti)
+        assert r_recon >= 0.9
+        assert abs(r_fused - r_recon) < 0.05, (r_fused, r_recon)
+
+    def test_fused_fallback_is_counted(self, res, dataset):
+        """The CI tripwire's sensor: every dispatch that asked for the
+        fused kernel but ran a fallback must tick
+        ivf_pq.search.fused_fallback (on CPU that is every fused/auto
+        dispatch — on TPU at the flagship shape the counter must stay
+        flat, which bench.py asserts at runtime)."""
+        from raft_tpu import observability as obs
+
+        db, q = dataset
+        params = ivf_pq.IndexParams(n_lists=16, pq_dim=32,
+                                    kmeans_n_iters=2)
+        index = ivf_pq.build(res, params, db)
+        obs.enable()
+        try:
+            c0 = obs.registry().counter("ivf_pq.search.fused_fallback").value
+            sp = ivf_pq.SearchParams(n_probes=8, scan_mode="fused")
+            ivf_pq.search(res, sp, index, q, 10)
+            c1 = obs.registry().counter("ivf_pq.search.fused_fallback").value
+        finally:
+            obs.disable()
+        assert c1 == c0 + 1
+
+    def test_fused_supported_at_flagship_shape(self):
+        """Static tripwire: the fused kernels must accept the flagship
+        bench geometry (1M x 128, 4096 lists, pq_dim 64, kt 16, batch
+        5000).  If a VMEM-budget or gate edit regresses this,
+        scan_mode=auto would silently fall off the fused kernel at the
+        headline operating point — fail HERE, not in the QPS number."""
+        from raft_tpu.ops import pq_code_scan_pallas as pcs
+        from raft_tpu.ops import pq_group_scan_pallas as pqp
+
+        cap = -(-int(1_000_000 / 4096 * 1.35) // 32) * 32
+        assert pcs.supported_fused_codes(True, True, cap, 128, 16, 10,
+                                         5000, 64, 8)
+        assert pqp.supported_fused(True, cap, 128, 16, 10, 5000)
+
+
 class TestListDataHelpers:
     """Public list-data helpers (reference: ivf_pq_helpers.cuh)."""
 

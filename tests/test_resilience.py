@@ -581,6 +581,28 @@ class TestDistributedResilience:
         assert c.get("resilience.retry.distributed.ann.search") == 1
         assert "resilience.giveup.distributed.ann.search" not in c
 
+    def test_transient_fault_retried_at_fused_operating_point(
+            self, handle, dist_index):
+        """Round-7 CI operating point: scan_mode="fused" through the
+        sharded path — retried faults replay identically, and the
+        documented shard_map lowering (traceable probe-order recon) is
+        visible as fused_fallback counter ticks."""
+        ann, ivf_pq, idx, q = dist_index
+        sp = ivf_pq.SearchParams(n_probes=4, scan_mode="fused",
+                                 per_probe_topk=4)
+        d0, i0 = ann.search(handle, sp, idx, q, 5)
+        obs.reset()
+        with obs.collecting():
+            with inject("distributed.ann.search", times=1,
+                        exc=TransientFault):
+                d1, i1 = ann.search(handle, sp, idx, q, 5)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_allclose(np.asarray(d0), np.asarray(d1))
+        c = obs.snapshot()["counters"]
+        assert c.get(
+            "resilience.fault.injected.distributed.ann.search") == 1
+        assert c.get("ivf_pq.search.fused_fallback", 0) >= 1
+
     def test_degraded_search_masks_failed_shards(self, handle, dist_index):
         ann, ivf_pq, idx, q = dist_index
         sp = ivf_pq.SearchParams(n_probes=4)

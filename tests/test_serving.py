@@ -297,6 +297,48 @@ class TestWarmExecutors:
                 srv.stop()
         assert c1 == c0, f"{c1 - c0} recompiles in steady state"
 
+    def test_zero_recompiles_after_warmup_fused(self, pq_setup):
+        """Round-7: scan_mode="fused" rides the same AOT bucket-warmup
+        contract — its executables carry a distinct ExecutableCache key
+        component and steady state stays recompile-free."""
+        res, _, q, index, _ = pq_setup
+        sp = ivf_pq.SearchParams(n_probes=8, scan_mode="fused",
+                                 per_probe_topk=4)
+        ex = serving.Executor(res, "ivf_pq", index, ks=(5,),
+                              max_batch=16, search_params=sp, warm="aot")
+        with obs.collecting():
+            srv = serving.Server(
+                ex, serving.ServerConfig(max_batch=16,
+                                         max_wait_us=2_000)).start()
+            q = np.asarray(q)
+            try:
+                for m in (1, 3, 8, 16, 5, 2):
+                    srv.search(q[:m], 5)
+                c0 = obs.registry().counter("xla.compiles").value
+                for m in (2, 16, 1, 7, 4, 16, 3):
+                    srv.search(q[:m], 5)
+                c1 = obs.registry().counter("xla.compiles").value
+            finally:
+                srv.stop()
+        assert c1 == c0, f"{c1 - c0} recompiles in steady state"
+
+    def test_fused_prewarm_distinct_cache_key(self, pq_setup):
+        """Fused-mode bucket executables must not collide with lut/codes
+        entries — scan_mode is part of the ExecutableCache key."""
+        res, _, q, index, _ = pq_setup
+        from raft_tpu.core.aot import ExecutableCache
+        cache = ExecutableCache()
+        f1 = cache.get("ivf_pq", res, index, batch=8, k=5, n_probes=8,
+                       scan_mode="fused")
+        f2 = cache.get("ivf_pq", res, index, batch=8, k=5, n_probes=8,
+                       scan_mode="lut")
+        f3 = cache.get("ivf_pq", res, index, batch=8, k=5, n_probes=8,
+                       scan_mode="fused")
+        assert f1 is f3
+        assert f1 is not f2
+        d, i = f1(jnp.asarray(np.asarray(q)[:8]))
+        assert d.shape == (8, 5) and i.shape == (8, 5)
+
     def test_serving_metrics_recorded(self, pq_setup):
         ex = _executor(pq_setup, warm="jit")
         with obs.collecting():
