@@ -653,6 +653,172 @@ def run_serving(conf_path: str) -> int:
     return 1 if failures else 0
 
 
+MUTATION_CHURN = 0.01          # writer deletes AND extends 1% per cycle
+
+
+def bench_mutation(res, db, queries, *, build_param=None, search_param=None,
+                   k=SERVING_K, max_batch=SERVING_MAX_BATCH,
+                   max_wait_us=1000.0, clients=8, request_rows=32,
+                   duration_s=2.0, churn_fraction=MUTATION_CHURN,
+                   churn_interval_s=0.25) -> list:
+    """Serving under mutation churn at the flagship operating point.
+
+    A background writer repeatedly deletes ``churn_fraction`` of the
+    index and extends the same fraction of fresh rows, publishing each
+    new generation through ``Server.swap_index`` (full re-warm, atomic
+    publish).  Closed-loop clients run the whole time; the bench emits
+
+    - ``mutation_qps_sustained`` — sustained rows/s with the writer
+      active, ``vs_baseline`` = fraction of the same closed loop with no
+      writer (acceptance bar: >= 0.8x);
+    - ``mutation_p99_ms`` — client-observed p99 under churn.
+
+    Recompiles are attributed per swap: the writer samples the
+    ``xla.compiles`` counter around each ``swap_index`` call, so
+    ``recompiles_steady`` counts only compiles OUTSIDE swap re-warms —
+    the zero-steady-state contract between generation swaps.
+    """
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu import observability as obs
+    from raft_tpu import serving
+    from raft_tpu.neighbors import ivf_pq
+
+    bp = build_param or {"nlist": 1024, "pq_dim": 32}
+    spc = search_param or {"nprobe": 32}
+    index = ivf_pq.build(
+        res, ivf_pq.IndexParams(n_lists=bp["nlist"], pq_dim=bp["pq_dim"],
+                                kmeans_n_iters=bp.get("kmeans_n_iters", 10)),
+        db)
+    sp = ivf_pq.SearchParams(n_probes=spc["nprobe"],
+                             scan_mode=spc.get("scan_mode", "auto"),
+                             per_probe_topk=spc.get("per_probe_topk", 0))
+    q = np.asarray(queries)
+    if q.shape[0] < max_batch:
+        q = np.concatenate([q] * int(np.ceil(max_batch / q.shape[0])))
+    db_h = np.asarray(db)
+    n = db_h.shape[0]
+    step = max(1, int(n * churn_fraction))
+
+    ex = serving.Executor(res, "ivf_pq", index, ks=(k,),
+                          max_batch=max_batch, search_params=sp)
+    out = []
+    with obs.collecting():
+        cfg = serving.ServerConfig(max_batch=max_batch,
+                                   max_wait_us=max_wait_us,
+                                   max_queue_rows=max_batch * 16)
+        with serving.Server(ex, cfg) as srv:
+            for m in (1, request_rows, max_batch):
+                srv.search(q[:m], k)
+
+            def closed_loop(dur, lats=None):
+                done = [0] * clients
+                stop_at = time.perf_counter() + dur
+
+                def client(j):
+                    base = (j * 131) % max(1, q.shape[0] - request_rows)
+                    sub = q[base:base + request_rows]
+                    while time.perf_counter() < stop_at:
+                        t0 = time.perf_counter()
+                        srv.search(sub, k)
+                        if lats is not None:
+                            lats.append(time.perf_counter() - t0)
+                        done[j] += sub.shape[0]
+
+                ts = [threading.Thread(target=client, args=(j,))
+                      for j in range(clients)]
+                t0 = time.perf_counter()
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                return sum(done) / (time.perf_counter() - t0)
+
+            # ---- no-writer baseline, same loop -----------------------
+            baseline_qps = closed_loop(duration_s)
+
+            # ---- writer: delete 1% + extend 1% + swap per cycle ------
+            state = {"index": index, "next_del": 0, "next_id": n,
+                     "swaps": 0, "swap_compiles": 0, "errors": 0}
+            stop_writer = threading.Event()
+            compiles = obs.registry().counter("xla.compiles")
+
+            def writer():
+                while not stop_writer.wait(churn_interval_s):
+                    try:
+                        # the whole cycle's compiles (delete/extend traces
+                        # + swap re-warm) belong to the writer; what's
+                        # left over is the READER steady state, which the
+                        # generation-keyed warm tables must keep at zero
+                        c0 = compiles.value
+                        idx = state["index"]
+                        lo = state["next_del"]
+                        doomed = np.arange(lo, lo + step, dtype=np.int64)
+                        idx = ivf_pq.delete(res, idx, doomed)
+                        rows = db_h[lo % n:(lo % n) + step]
+                        if rows.shape[0] < step:        # wrap the slice
+                            rows = db_h[:step]
+                        ids = np.arange(state["next_id"],
+                                        state["next_id"] + rows.shape[0],
+                                        dtype=np.int64)
+                        idx = ivf_pq.extend(res, idx, jnp.asarray(rows),
+                                            ids)
+                        srv.swap_index(idx)
+                        state["swap_compiles"] += compiles.value - c0
+                        state["index"] = idx
+                        state["next_del"] = lo + step
+                        state["next_id"] += rows.shape[0]
+                        state["swaps"] += 1
+                    except Exception:  # noqa: BLE001 - bench keeps serving
+                        state["errors"] += 1
+
+            lats = []
+            c_start = compiles.value
+            wt = threading.Thread(target=writer, daemon=True)
+            wt.start()
+            mutation_qps = closed_loop(duration_s, lats)
+            stop_writer.set()
+            wt.join(timeout=60.0)
+            recompiles_steady = (compiles.value - c_start
+                                 - state["swap_compiles"])
+
+    from raft_tpu.neighbors import mutate as _mutate
+    frac = mutation_qps / max(baseline_qps, 1e-9)
+    p50, p95, p99 = (float(v) * 1e3
+                     for v in np.percentile(lats, [50, 95, 99]))
+    out.append({
+        "metric": "mutation_qps_sustained",
+        "value": round(mutation_qps, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(frac, 3),
+        "detail": {"baseline_qps_no_writer": round(baseline_qps, 1),
+                   "fraction_of_baseline": round(frac, 3),
+                   "recompiles_steady": int(recompiles_steady),
+                   "writer_compiles": int(state["swap_compiles"]),
+                   "generation_swaps": state["swaps"],
+                   "writer_errors": state["errors"],
+                   "churn_fraction": churn_fraction,
+                   "churn_rows_per_cycle": step,
+                   "dead_fraction_final": round(
+                       _mutate.dead_fraction(state["index"]), 4),
+                   "clients": clients, "request_rows": request_rows,
+                   "max_batch": max_batch},
+    })
+    out.append({
+        "metric": "mutation_p99_ms",
+        "value": round(p99, 3),
+        "unit": "ms",
+        "vs_baseline": 1.0,
+        "detail": {"p50_ms": round(p50, 3), "p95_ms": round(p95, 3),
+                   "requests": len(lats),
+                   "generation_swaps": state["swaps"]},
+    })
+    return out
+
+
 PAIRWISE_N, PAIRWISE_DIM = 5000, 50
 
 
@@ -1002,6 +1168,9 @@ def main() -> None:
     # online serving over a 100k slice of the same dataset (the CI
     # smoke runs the conf/serving-smoke.json variant of this)
     for line in bench_serving(res, db[:SERVING_N], queries[:2048]):
+        print(json.dumps(line), flush=True)
+    # the same serving stack under 1% delete + 1% extend mutation churn
+    for line in bench_mutation(res, db[:SERVING_N], queries[:2048]):
         print(json.dumps(line), flush=True)
     print(json.dumps({"integrity_counters": _integrity_counters()}),
           flush=True)

@@ -163,8 +163,13 @@ class ExecutableCache:
         the exporter (and keyed on, sorted by name).
         """
         extra = tuple(sorted(export_kwargs.items()))
-        key = (kind, id(index), int(batch), int(k), int(n_probes),
-               scan_mode, extra)
+        # generation rides in the key alongside the id()+weakref identity
+        # check: a mutated index is a NEW object (delete/extend/compact
+        # return fresh snapshots), but keying the generation explicitly
+        # keeps a recycled id() from ever pairing a stale executable with
+        # a newer generation, and makes swap-time invalidation exact
+        key = (kind, id(index), int(getattr(index, "generation", 0) or 0),
+               int(batch), int(k), int(n_probes), scan_mode, extra)
         with self._lock:
             hit = self._entries.get(key)
             if hit is not None and hit[0]() is index:

@@ -35,6 +35,7 @@ from raft_tpu.core.tracing import range as named_range
 from raft_tpu.distance.types import DistanceType
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.neighbors import ivf_pq
+from raft_tpu.neighbors import mutate as _mutate
 from raft_tpu.resilience import faults
 from raft_tpu.resilience import retry as _retry
 
@@ -372,6 +373,37 @@ def search(handle, params: ivf_pq.SearchParams, index: DistributedIndex,
         status = np.ones(index.n_shards, np.int8)
         status[list(failed)] = 0
         return d, i, jnp.asarray(status)
+
+
+def delete(handle, index: DistributedIndex, ids, *,
+           retry_policy: Optional[_retry.RetryPolicy] = None,
+           deadline: Optional[_retry.Deadline] = None) -> DistributedIndex:
+    """Tombstone delete over the sharded index (ids are GLOBAL).
+
+    One sharding-preserving elementwise rewrite of the stacked
+    ``list_indices`` leaf — matching slots flip to the tombstone
+    encoding (see :mod:`raft_tpu.neighbors.mutate`), which the
+    shard-local recon scan already masks (it keeps ``>= 0`` slots only).
+    Every other leaf is shared with the parent; the returned snapshot is
+    generation-bumped.  Transient faults at entry (site
+    ``distributed.ann.delete``) are retried under ``retry_policy`` /
+    ``deadline``."""
+    return _entry("distributed.ann.delete",
+                  lambda: _delete_impl(index, ids), retry_policy, deadline)
+
+
+def _delete_impl(index: DistributedIndex, ids) -> DistributedIndex:
+    with named_range("distributed::ivf_pq_delete"):
+        ids = ensure_array(ids, "ids")
+        expects(ids.ndim == 1, "distributed.ann.delete: 1-D ids required")
+        new_li, _ = _mutate.tombstone(index.list_indices, ids)
+        leaves, aux = index.tree_flatten()
+        leaves = list(leaves)
+        leaves[3] = new_li
+        out = DistributedIndex.tree_unflatten(aux, tuple(leaves))
+        out.shard_canaries = index.shard_canaries
+        _mutate.next_generation(index, out)
+        return out
 
 
 # ---------------------------------------------------------------------------

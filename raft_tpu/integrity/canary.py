@@ -112,11 +112,24 @@ def _search_canaries(res, index, cs: CanarySet) -> np.ndarray:
 
 
 def measure(res, index, cs: CanarySet) -> float:
-    """Canary recall of ``index`` against the stored ground truth."""
+    """Canary recall of ``index`` against the stored ground truth.
+
+    Deleted rows (tombstones in the IVF ``list_indices``, or a graph
+    index's ``deleted_ids`` mask) are excluded from both the per-query
+    ground-truth sets and the denominator: a delete legitimately removes
+    stored neighbors, and counting them as misses would fail the floor
+    for a perfectly healthy index.  An index whose every ground-truth id
+    was deleted measures 1.0 (nothing left to find)."""
+    from raft_tpu.neighbors import mutate as _mutate
+
     found = _search_canaries(res, index, cs)
-    hits = sum(len(set(f.tolist()) & set(t.tolist()))
-               for f, t in zip(found, cs.gt_ids))
-    return hits / cs.gt_ids.size
+    dropped = _mutate.deleted_ids(index)
+    hits = total = 0
+    for f, t in zip(found, cs.gt_ids):
+        gt = set(t.tolist()) - dropped if dropped else set(t.tolist())
+        total += len(gt)
+        hits += len(set(f.tolist()) & gt)
+    return hits / total if total else 1.0
 
 
 def health_check(res, index, *, raise_on_fail: bool = True

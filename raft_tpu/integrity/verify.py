@@ -74,21 +74,31 @@ def _verify_ivf_lists(kind: str, list_indices: np.ndarray,
               f"list {li} has size {int(list_sizes[li])} outside "
               f"[0, {capacity}]", coord=(li,))
 
-    # slot validity must match the size vector exactly: ids >= 0 in the
-    # first `size` slots of each list, -1 in the padding
+    # slot occupancy must match the size vector exactly: each list's
+    # first `size` slots hold a live id (>= 0) or a tombstone (<= -2,
+    # see neighbors/mutate), the padding holds -1.  A tombstone outside
+    # the occupied prefix, or a -1 inside it, is corruption.
     slot = np.arange(capacity)[None, :]
     should_be_valid = slot < list_sizes[:, None]
     valid = list_indices >= 0
-    mism = valid != should_be_valid
+    tomb = list_indices <= -2
+    mism = (valid | tomb) != should_be_valid
     if mism.any():
         li, sl = _first_bad(mism)
-        state = "valid id" if valid[li, sl] else "empty slot (-1)"
+        state = ("valid id" if valid[li, sl]
+                 else "tombstone" if tomb[li, sl]
+                 else "empty slot (-1)")
         want = int(list_sizes[li])
         _fail(f"{kind}.list_sizes.slots",
               f"list {li} slot {sl} holds a {state} but list size is "
-              f"{want} — sizes and slot validity disagree (stale size "
-              f"after extend?)", coord=(li, sl))
+              f"{want} — sizes and slot occupancy disagree (stale size "
+              f"after extend/delete?)", coord=(li, sl))
 
+    # uniqueness is enforced among LIVE ids only: a tombstone sharing an
+    # id with a live slot is the legitimate delete -> re-insert pattern
+    # (the rebalancer's recluster step tombstones a row and re-extends it
+    # under the same id), and stale tombstones carry no search-visible
+    # state — they are garbage pending compaction, not invariants
     ids = list_indices[valid]
     if ids.size:
         uniq, counts = np.unique(ids, return_counts=True)
@@ -96,8 +106,9 @@ def _verify_ivf_lists(kind: str, list_indices: np.ndarray,
             dup = int(uniq[np.argmax(counts > 1)])
             li, sl = _first_bad(list_indices == dup)
             _fail(f"{kind}.ids.unique",
-                  f"source id {dup} appears {int(counts.max())} times "
-                  f"(first at list {li} slot {sl})", coord=(li, sl))
+                  f"live source id {dup} appears {int(counts.max())} "
+                  f"times (first at list {li} slot {sl})",
+                  coord=(li, sl))
 
 
 def _verify_ids_in_range(kind: str, list_indices: np.ndarray,
@@ -105,13 +116,20 @@ def _verify_ids_in_range(kind: str, list_indices: np.ndarray,
     """Default id-space convention: source ids are ``0..n_rows-1`` with
     ``n_rows = sum(list_sizes)`` (what ``build(add_data_on_build=True)``
     produces).  Indexes extended with a custom sparse id space pass their
-    true universe size via ``verify(..., n_rows=)``."""
-    valid = list_indices >= 0
-    too_big = valid & (list_indices >= n_rows)
+    true universe size via ``verify(..., n_rows=)`` — in particular
+    after delete + compact, which makes the live id space sparse while
+    shrinking ``sum(list_sizes)``."""
+    # decoded view: tombstones (<= -2, neighbors/mutate) map back to the
+    # original source id so deleted rows stay range-checked too
+    dec = np.where(list_indices <= -2,
+                   -list_indices.astype(np.int64) - 2,
+                   list_indices.astype(np.int64))
+    occupied = (list_indices >= 0) | (list_indices <= -2)
+    too_big = occupied & (dec >= n_rows)
     if too_big.any():
         li, sl = _first_bad(too_big)
         _fail(f"{kind}.ids.range",
-              f"source id {int(list_indices[li, sl])} at list {li} slot "
+              f"source id {int(dec[li, sl])} at list {li} slot "
               f"{sl} is >= the index's {n_rows} rows", coord=(li, sl))
 
 

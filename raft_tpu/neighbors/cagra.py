@@ -76,6 +76,7 @@ from raft_tpu.matrix import ops as matrix_ops
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.utils.precision import get_matmul_precision
 from raft_tpu.core.outputs import auto_convert_output
+from raft_tpu.neighbors import mutate as _mutate
 
 
 @dataclasses.dataclass
@@ -154,6 +155,9 @@ class Index:
     # metadata, deliberately NOT a pytree leaf (aux must stay hashable),
     # so jax transforms drop it; build/serialize carry it explicitly.
     canaries: Optional[object] = None
+    # Mutation-generation counter (see neighbors.mutate): host-side like
+    # canaries, bumped by delete(); readers snapshot by object identity.
+    generation: int = 0
 
     @property
     def size(self) -> int:
@@ -1966,7 +1970,7 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
                     rerank, index.graph_degree, quant=cache.quant,
                     scales=cache.scales, fused_hop=fused)
                 st.fence(out)
-            return out
+            return _mask_deleted(index, *out)
 
         # direct exact walk: probe 4×itopk random nodes (min 128) and
         # keep the best itopk — the reference's random-sampling buffer
@@ -1983,6 +1987,59 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
                                seed_ids, k, itopk, params.search_width,
                                max_iter, index.metric)
             st.fence(out)
+        return _mask_deleted(index, *out)
+
+
+def _mask_deleted(index: Index, dist, ids) -> Tuple[jax.Array, jax.Array]:
+    """Post-filter for the graph delete shim: results whose id is in the
+    index's ``deleted_ids`` mask take worst distance / id -1 and sink to
+    the end of their row (stable re-sort by distance).  A no-op (zero
+    dispatches) for indexes with no recorded deletions."""
+    dropped = getattr(index, "deleted_ids", None)
+    if not dropped:
+        return dist, ids
+    del_arr = jnp.asarray(sorted(dropped), jnp.int32)
+    select_min = index.metric != DistanceType.InnerProduct
+    worst = jnp.asarray(jnp.inf if select_min else -jnp.inf, dist.dtype)
+    hit = jnp.isin(ids, del_arr) & (ids >= 0)
+    dist = jnp.where(hit, worst, dist)
+    ids = jnp.where(hit, -1, ids)
+    order = jnp.argsort(dist if select_min else -dist, axis=1,
+                        stable=True)
+    return (jnp.take_along_axis(dist, order, axis=1),
+            jnp.take_along_axis(ids, order, axis=1))
+
+
+def delete(res, index: Index, ids) -> Index:
+    """Delete-mask shim for the graph index (tentpole parity with the
+    IVF ``delete``): rows stay in the dataset and graph — the greedy walk
+    may still traverse them as waypoints — but they are excluded from
+    every search result by :func:`_mask_deleted` and from canary
+    ground truth by ``integrity.canary.measure``.
+
+    Returns a new generation-bumped :class:`Index` snapshot sharing the
+    dataset/graph arrays; the ``deleted_ids`` frozenset is host-side
+    metadata (like canaries, dropped by jax transforms and not
+    serialized).  Reclaiming the rows for real requires a rebuild."""
+    with named_range("cagra::delete"):
+        ids = ensure_array(ids, "ids")
+        expects(ids.ndim == 1, "cagra.delete: 1-D ids required")
+        prior = getattr(index, "deleted_ids", None) or frozenset()
+        dropped = frozenset(prior) | {
+            int(v) for v in np.asarray(ids).tolist()}
+        out = Index(dataset=index.dataset, graph=index.graph,
+                    metric=index.metric)
+        out.canaries = index.canaries
+        out.deleted_ids = dropped
+        # the walk tables depend only on dataset/graph (both shared) —
+        # carry them so a delete-mask costs no table rebuild
+        for attr in ("_walk_auto_pdim", "_walk_calib_vecs",
+                     "_walk_quant_ok", "_walk_tables", "_walk_entries"):
+            if hasattr(index, attr):
+                object.__setattr__(out, attr, getattr(index, attr))
+        _mutate.next_generation(index, out)
+        if index.canaries is not None:
+            _canary.auto_check(res, out, site="delete")
         return out
 
 

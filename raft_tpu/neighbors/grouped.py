@@ -178,7 +178,11 @@ def probe_overlap_order(probes: jax.Array, n_lists: int) -> jax.Array:
     The permutation changes only iteration order — distances and ids
     are untouched.
     """
-    n_probes = probes.shape[1]
+    nq, n_probes = probes.shape
+    if n_probes == 0:
+        # degenerate batch (no probes — e.g. every list emptied by
+        # delete/compaction upstream): identity order, nothing to cluster
+        return jnp.arange(nq, dtype=jnp.int32)
     r0 = probes[:, 0].astype(jnp.int32)
     r1 = probes[:, min(1, n_probes - 1)].astype(jnp.int32)
     # n_lists^2 fits int32 up to 46k lists; clamp sentinels (>= n_lists,
@@ -225,6 +229,12 @@ def finalize_topk(outd: jax.Array, outi: jax.Array, nq: int, k: int,
                          constant_values=worst)
         best_i = jnp.pad(best_i, ((0, 0), (0, k - kf)),
                          constant_values=-1)
+    # tombstoned slots (neighbors/mutate: id <= -2) carry worst-sentinel
+    # distances through every scan, but when k exceeds the valid
+    # candidate count their ENCODED ids can survive the select — clamp
+    # every negative id to the public -1 sentinel here, the one epilogue
+    # all probe-order and grouped scans share
+    best_i = jnp.maximum(best_i, -1)
     if sqrt:
         best_d = jnp.sqrt(jnp.maximum(best_d, 0.0))
     return best_d, best_i
@@ -296,7 +306,10 @@ def block_size(n_groups: int, *per_group_bytes: int,
     per = max(sum(per_group_bytes), 1)
     b = budget // per
     b = max(quantum, b - b % quantum)
-    return min(b, n_groups)
+    # floor at 1: n_groups == 0 (every probed list empty after
+    # delete/compaction) must not produce a zero block size — the scan
+    # driver guards the empty case itself
+    return min(b, max(n_groups, 1))
 
 
 def scan_and_scatter(group_list, slot_pairs, P, cap, k, select_min, block,
@@ -318,8 +331,14 @@ def scan_and_scatter(group_list, slot_pairs, P, cap, k, select_min, block,
     worst = jnp.inf if select_min else -jnp.inf
     # kt (SearchParams.per_probe_topk) narrows the per-pair keep-set below
     # k; 0 keeps the exact-merge default
-    kt = min(kt or k, cap)
+    kt = min(kt or k, cap) if cap else (kt or k)
 
+    if n_groups == 0 or block <= 0 or cap == 0:
+        # nothing to scan (all probed lists empty — possible after
+        # delete/compaction empties the index): every pair is exhausted
+        return (jnp.full((P, kt), worst, jnp.float32),
+                jnp.full((P, kt), -1, jnp.int32))
+    block = min(block, n_groups)
     n_blocks = -(-n_groups // block)
     block_starts = jnp.minimum(jnp.arange(n_blocks) * block,
                                n_groups - block)

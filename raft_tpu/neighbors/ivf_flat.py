@@ -39,6 +39,7 @@ from raft_tpu.core.tracing import range as named_range
 from raft_tpu import observability as obs
 from raft_tpu.integrity import boundary as _boundary
 from raft_tpu.integrity import canary as _canary
+from raft_tpu.neighbors import mutate as _mutate
 from raft_tpu.distance.types import DistanceType
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.utils.precision import get_matmul_precision
@@ -105,6 +106,10 @@ class Index:
     # metadata, deliberately NOT a pytree leaf (aux must stay hashable),
     # so jax transforms drop it; build/extend/serialize carry it.
     canaries: Optional[object] = None
+    # Mutation generation counter (see neighbors/mutate): host-side like
+    # canaries — a leaf would be wrong and aux would force a retrace per
+    # mutation.  extend/delete/compact stamp parent+1 on the new index.
+    generation: int = 0
 
     @property
     def n_lists(self) -> int:
@@ -346,6 +351,7 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
                         metric=index.metric,
                         adaptive_centers=index.adaptive_centers,
                         list_data_sq=data_sq)
+            _mutate.next_generation(index, out)
             if index.canaries is not None:
                 out.canaries = index.canaries
                 _canary.auto_check(res, out, site="extend")
@@ -390,9 +396,81 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
                     list_indices=list_idx, list_sizes=sizes,
                     metric=index.metric,
                     adaptive_centers=index.adaptive_centers)
+        _mutate.next_generation(index, out)
         if index.canaries is not None:
             out.canaries = index.canaries
             _canary.auto_check(res, out, site="extend")
+        return out
+
+
+def delete(res, index: Index, ids) -> Index:
+    """Tombstone-delete rows by source id (the online mutation layer —
+    see :mod:`raft_tpu.neighbors.mutate` for the encoding).
+
+    Every slot whose id is in ``ids`` is rewritten in ``list_indices``
+    to a tombstone; all scan paths (XLA and Pallas, fused included)
+    already mask negative ids to the worst-distance sentinel, so
+    deleted rows disappear from search results immediately at zero
+    per-search cost.  Storage is reclaimed by :func:`compact`, not
+    here.  Ids not present in the index match nothing.
+
+    Returns a NEW index — the next generation — sharing every array
+    except ``list_indices`` with its parent; readers pinned on the
+    parent are unaffected.
+    """
+    with named_range("ivf_flat::delete"):
+        ids = ensure_array(ids, "ids")
+        expects(ids.ndim == 1, "ivf_flat.delete: 1-D ids required")
+        new_li, _ = _mutate.tombstone(index.list_indices, ids)
+        out = Index(centers=index.centers, list_data=index.list_data,
+                    list_indices=new_li, list_sizes=index.list_sizes,
+                    metric=index.metric,
+                    adaptive_centers=index.adaptive_centers,
+                    list_data_sq=index.list_data_sq)
+        out.canaries = index.canaries
+        _mutate.next_generation(index, out)
+        if index.canaries is not None:
+            _canary.auto_check(res, out, site="delete")
+        return out
+
+
+def compact(res, index: Index) -> Index:
+    """Reclaim tombstoned slots: stable-partition each list's live rows
+    to the front, drop every tombstone, and shrink the shared capacity
+    to fit the fullest surviving list (aligned, with the same one-row
+    headroom the extend repack keeps).  O(n_lists * capacity) — the
+    rebalancer calls this past its dead-fraction threshold rather than
+    on every delete.  Returns a new generation sharing ``centers`` with
+    its parent."""
+    with named_range("ivf_flat::compact"):
+        order, sizes = _mutate.compaction_order(index.list_indices)
+        max_size = int(jnp.max(sizes)) if index.n_lists else 0
+        capacity = _round_up(max(max_size + 1, _LIST_ALIGN), _LIST_ALIGN)
+        capacity = min(capacity, max(index.capacity, _LIST_ALIGN))
+
+        li = jnp.take_along_axis(index.list_indices, order, axis=1)
+        data = jnp.take_along_axis(index.list_data, order[:, :, None],
+                                   axis=1)
+        li, data = li[:, :capacity], data[:, :capacity]
+        live = (jnp.arange(capacity, dtype=jnp.int32)[None, :]
+                < sizes[:, None])
+        li = jnp.where(live, li, -1)
+        data = jnp.where(live[:, :, None], data, 0)
+        data_sq = None
+        if index.list_data_sq is not None:
+            data_sq = jnp.take_along_axis(index.list_data_sq, order,
+                                          axis=1)[:, :capacity]
+            data_sq = jnp.where(live, data_sq, 0)
+
+        out = Index(centers=index.centers, list_data=data,
+                    list_indices=li, list_sizes=sizes,
+                    metric=index.metric,
+                    adaptive_centers=index.adaptive_centers,
+                    list_data_sq=data_sq)
+        out.canaries = index.canaries
+        _mutate.next_generation(index, out)
+        if index.canaries is not None:
+            _canary.auto_check(res, out, site="compact")
         return out
 
 

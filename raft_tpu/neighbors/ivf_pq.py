@@ -54,6 +54,7 @@ from raft_tpu.integrity import boundary as _boundary
 from raft_tpu.integrity import canary as _canary
 from raft_tpu.distance.types import DistanceType
 from raft_tpu.matrix.select_k import select_k
+from raft_tpu.neighbors import mutate as _mutate
 from raft_tpu.neighbors.ivf_flat import (_append_lists_multi, _pack_lists,
                                          _round_up, _LIST_ALIGN)
 from raft_tpu.utils.precision import get_matmul_precision
@@ -222,6 +223,10 @@ class Index:
     # aux must stay hashable for jit caching), so it does not survive
     # jax transforms; build/extend/serialize carry it explicitly.
     canaries: Optional[object] = None
+    # Mutation generation counter (see neighbors/mutate): host-side like
+    # canaries — a leaf would be wrong and aux would force a retrace per
+    # mutation.  extend/delete/compact stamp parent+1 on the new index.
+    generation: int = 0
 
     @property
     def n_lists(self) -> int:
@@ -704,6 +709,7 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
             # scale was chosen from the pre-extend residual range, so
             # appended rows could overflow it — the next recon8 search
             # re-quantizes lazily (integrity.verify flags a stale copy)
+            _mutate.next_generation(index, out)
             if index.canaries is not None:
                 out.canaries = index.canaries
                 _canary.auto_check(res, out, site="extend")
@@ -747,9 +753,91 @@ def extend(res, index: Index, new_vectors, new_indices=None) -> Index:
             out = _with_code_lanes(out)
         if index.list_recon_i8 is not None:
             out = _with_recon8(out)
+        _mutate.next_generation(index, out)
         if index.canaries is not None:
             out.canaries = index.canaries
             _canary.auto_check(res, out, site="extend")
+        return out
+
+
+def delete(res, index: Index, ids) -> Index:
+    """Tombstone-delete rows by source id (the online mutation layer —
+    see :mod:`raft_tpu.neighbors.mutate` for the encoding).
+
+    Rewrites the matching ``list_indices`` slots to tombstones; every
+    scan formulation (recon/codes/recon8/lut and the fused Pallas
+    kernels) already masks negative ids to the worst-distance sentinel,
+    so deleted rows vanish from results immediately without touching
+    the codes, any derived cache, or fused-path eligibility.  Storage
+    is reclaimed by :func:`compact`.  Ids not present match nothing.
+
+    Returns a NEW index — the next generation — sharing every array
+    except ``list_indices`` with its parent; readers pinned on the
+    parent are unaffected.
+    """
+    with named_range("ivf_pq::delete"):
+        ids = ensure_array(ids, "ids")
+        expects(ids.ndim == 1, "ivf_pq.delete: 1-D ids required")
+        new_li, _ = _mutate.tombstone(index.list_indices, ids)
+        out = Index(
+            centers=index.centers, codebooks=index.codebooks,
+            list_codes=index.list_codes, list_indices=new_li,
+            list_sizes=index.list_sizes, rotation=index.rotation,
+            metric=index.metric, codebook_kind=index.codebook_kind,
+            pq_bits=index.pq_bits, pq_dim_=index.pq_dim,
+            list_recon=index.list_recon,
+            list_recon_sq=index.list_recon_sq,
+            list_code_lanes=index.list_code_lanes,
+            list_code_rsq=index.list_code_rsq,
+            list_recon_i8=index.list_recon_i8,
+            list_recon_scale=index.list_recon_scale,
+            list_recon_i8_sq=index.list_recon_i8_sq)
+        out.canaries = index.canaries
+        _mutate.next_generation(index, out)
+        if index.canaries is not None:
+            _canary.auto_check(res, out, site="delete")
+        return out
+
+
+def compact(res, index: Index) -> Index:
+    """Reclaim tombstoned slots: stable-partition each list's live rows
+    to the front, drop every tombstone, shrink the shared capacity to
+    fit the fullest surviving list, and rebuild whichever derived scan
+    caches the parent carried from the fresh codes (compaction moves
+    rows, so the caches cannot be permuted in place safely at 3
+    different layouts).  Returns a new generation sharing
+    ``centers``/``codebooks``/``rotation`` with its parent."""
+    with named_range("ivf_pq::compact"):
+        order, sizes = _mutate.compaction_order(index.list_indices)
+        max_size = int(jnp.max(sizes)) if index.n_lists else 0
+        capacity = _round_up(max(max_size + 1, _LIST_ALIGN), _LIST_ALIGN)
+        capacity = min(capacity, max(index.capacity, _LIST_ALIGN))
+
+        li = jnp.take_along_axis(index.list_indices, order,
+                                 axis=1)[:, :capacity]
+        codes = jnp.take_along_axis(index.list_codes, order[:, :, None],
+                                    axis=1)[:, :capacity]
+        live = (jnp.arange(capacity, dtype=jnp.int32)[None, :]
+                < sizes[:, None])
+        li = jnp.where(live, li, -1)
+        codes = jnp.where(live[:, :, None], codes, 0)
+
+        out = Index(
+            centers=index.centers, codebooks=index.codebooks,
+            list_codes=codes, list_indices=li, list_sizes=sizes,
+            rotation=index.rotation, metric=index.metric,
+            codebook_kind=index.codebook_kind, pq_bits=index.pq_bits,
+            pq_dim_=index.pq_dim)
+        if index.list_recon is not None:
+            out = _with_recon(res, out)
+        if index.list_code_lanes is not None:
+            out = _with_code_lanes(out)
+        if index.list_recon_i8 is not None:
+            out = _with_recon8(out)
+        out.canaries = index.canaries
+        _mutate.next_generation(index, out)
+        if index.canaries is not None:
+            _canary.auto_check(res, out, site="compact")
         return out
 
 

@@ -1,0 +1,131 @@
+"""Shared tombstone / generation helpers for the mutable IVF indexes.
+
+The online mutation layer encodes per-row state entirely inside
+``list_indices`` — the one array every scan formulation already masks on:
+
+- slot value ``>= 0``  — live row (the value is the source id);
+- slot value ``-1``    — never-filled padding (the pre-existing contract);
+- slot value ``<= -2`` — tombstoned row: original id ``v`` is stored as
+  ``-(v + 2)`` (decode with :func:`decode_tombstones`).
+
+Every scan path — the probe-order XLA scans, the grouped XLA distance
+blocks, and the Pallas kernels including the fused in-kernel top-k
+variants — masks candidates with ``id < 0`` to the worst-distance
+sentinel, so tombstoned rows vanish from search results through the exact
+same mechanism as capacity padding: zero kernel changes, zero per-search
+cost, and no effect on fused-path shape eligibility.  The one id that a
+mask cannot fix — a tombstone *encoding* surfacing when ``k`` exceeds the
+valid candidate count — is clamped to the public ``-1`` sentinel in
+``grouped.finalize_topk`` (the shared epilogue) and mapped by the fused
+kernels' sentinel-distance epilogue.
+
+Mutations never edit an index in place: ``delete`` / ``compact`` /
+``extend`` return a NEW Index (the next *generation*) sharing every
+unchanged array with its parent, so in-flight readers pinned on the
+parent are never corrupted.  The ``generation`` counter is a plain
+host-side attribute — deliberately neither a pytree leaf nor aux data
+(aux participation would force a retrace per mutation) — that orders the
+snapshots and keys the serving tier's warmed-executable cache
+(``core/aot.ExecutableCache``).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def generation(index) -> int:
+    """The index's generation counter (0 for a freshly built index or
+    any index predating the mutation layer)."""
+    return int(getattr(index, "generation", 0) or 0)
+
+
+def next_generation(parent, child):
+    """Stamp ``child`` as the generation after ``parent``; returns
+    ``child``.  Called by every mutation (extend/delete/compact) on the
+    new index it is about to return."""
+    child.generation = generation(parent) + 1
+    return child
+
+
+def encode_tombstones(ids: jax.Array) -> jax.Array:
+    """Id ``v`` -> tombstone slot value ``-(v + 2)``."""
+    return -(ids + 2)
+
+
+def tombstone(list_indices: jax.Array, ids) -> Tuple[jax.Array, jax.Array]:
+    """Rewrite every live slot whose id is in ``ids`` to its tombstone
+    encoding.  Returns ``(new_list_indices, hit_mask)``; ids not present
+    in the index simply match nothing.  Pure elementwise — O(slots)
+    regardless of how many ids are deleted, no repacking."""
+    ids = jnp.asarray(ids, jnp.int32).reshape(-1)
+    hit = jnp.isin(list_indices, ids) & (list_indices >= 0)
+    return jnp.where(hit, encode_tombstones(list_indices), list_indices), hit
+
+
+def decode_tombstones(list_indices) -> np.ndarray:
+    """Host-side decode of every tombstoned id in ``list_indices``."""
+    a = np.asarray(list_indices).reshape(-1)
+    enc = a[a <= -2]
+    return (-enc.astype(np.int64) - 2)
+
+
+def deleted_ids(index) -> frozenset:
+    """The set of deleted source ids, host-side.
+
+    Graph indexes (CAGRA) carry an explicit ``deleted_ids`` attribute
+    (the delete-mask shim); IVF indexes decode it from the tombstones in
+    ``list_indices``.  An id that is tombstoned in one slot but live in
+    another (the delete -> re-insert pattern the rebalancer's recluster
+    step produces) is NOT deleted — the live copy answers searches.  Used
+    by the canary recall measurement to exclude deleted rows from the
+    ground-truth sets."""
+    ext = getattr(index, "deleted_ids", None)
+    if ext is not None:
+        return frozenset(int(v) for v in ext)
+    li = getattr(index, "list_indices", None)
+    if li is None:
+        return frozenset()
+    a = np.asarray(li).reshape(-1)
+    dead = frozenset(int(v) for v in decode_tombstones(a))
+    if not dead:
+        return dead
+    live = frozenset(int(v) for v in a[a >= 0])
+    return dead - live
+
+
+def live_sizes(list_indices: jax.Array) -> jax.Array:
+    """Per-list live-row counts (tombstones and padding excluded)."""
+    return jnp.sum(list_indices >= 0, axis=1).astype(jnp.int32)
+
+
+def live_count(index) -> int:
+    """Total live rows (one tiny host sync)."""
+    return int(jnp.sum(index.list_indices >= 0))
+
+
+def dead_fraction(index) -> float:
+    """Tombstoned fraction of occupied slots: ``dead / (live + dead)``
+    (0.0 for an empty index).  Tombstones cost scan work — every probe
+    still streams and masks them — so the rebalancer compacts past a
+    configurable threshold of this number."""
+    li = index.list_indices
+    live = int(jnp.sum(li >= 0))
+    dead = int(jnp.sum(li <= -2))
+    total = live + dead
+    return (dead / total) if total else 0.0
+
+
+def compaction_order(list_indices: jax.Array
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """Stable live-rows-first permutation of each list + live sizes.
+
+    ``jnp.argsort`` is stable, so live rows keep their relative order —
+    compaction permutes but never reorders survivors, which keeps
+    results (and the canary ground truth) comparable across the swap."""
+    order = jnp.argsort((list_indices < 0).astype(jnp.int32), axis=1)
+    return order, live_sizes(list_indices)

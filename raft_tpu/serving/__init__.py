@@ -20,7 +20,13 @@ RAFT-class deployment interposes between users and the GPU/TPU):
   degraded-mode shard masking and ``health_check`` compose unchanged);
 - :mod:`~raft_tpu.serving.server` — the ``Server`` front end:
   ``submit() -> Future``, boundary validation per request, serving
-  counters + latency histograms at enqueue→dispatch→complete.
+  counters + latency histograms at enqueue→dispatch→complete;
+- :mod:`~raft_tpu.serving.rebalancer` — crash-safe background index
+  maintenance for the mutable IVF indexes: overfull-list re-clustering
+  + tombstone compaction, checkpointed stages
+  (``resilience.CheckpointManager``), every swap-in gated behind
+  ``integrity.verify`` + the recall canary, atomic generation swaps
+  through ``Server.swap_index``.
 
 Quick tour::
 
@@ -49,6 +55,10 @@ from raft_tpu.serving.executor import (  # noqa: F401
     DistributedExecutor,
     Executor,
 )
+from raft_tpu.serving.rebalancer import (  # noqa: F401
+    RebalanceConfig,
+    Rebalancer,
+)
 from raft_tpu.serving.server import Server, ServerConfig  # noqa: F401
 
 __all__ = [
@@ -58,6 +68,8 @@ __all__ = [
     "Executor",
     "Overloaded",
     "QuotaExceeded",
+    "RebalanceConfig",
+    "Rebalancer",
     "Request",
     "Server",
     "ServerConfig",

@@ -75,9 +75,12 @@ class Executor:
 
     @property
     def dim(self) -> int:
+        return self._index_dim(self.index)
+
+    def _index_dim(self, index) -> int:
         if self.kind == "brute_force":
-            return int(self.index.shape[1])
-        return int(self.index.dim)
+            return int(index.shape[1])
+        return int(index.dim)
 
     @property
     def select_min(self) -> bool:
@@ -116,47 +119,56 @@ class Executor:
         fn = self._fns.get(key)
         if fn is not None:
             return fn
+        fn = self._build_fn(self.index, bucket, k)
+        self._fns[key] = fn
+        return fn
+
+    def _build_fn(self, index, bucket: int, k: int) -> Callable:
+        """One bucket executable against an EXPLICIT index — the builder
+        :meth:`swap_index` uses to assemble a replacement table without
+        touching the published one."""
         fn = None
         if self.warm == "aot":
             try:
-                fn = self._aot_fn(bucket, k)
+                fn = self._aot_fn(index, bucket, k)
             except Exception as e:  # noqa: BLE001 - exporter refusal
                 warnings.warn(
                     f"serving: AOT export failed for {self.kind} bucket "
                     f"({bucket}, {k}) — falling back to live search: {e}",
                     stacklevel=2)
         if fn is None:
-            fn = self._live_fn(k)
-        self._fns[key] = fn
+            fn = self._live_fn(index, k)
         return fn
 
-    def _aot_fn(self, bucket: int, k: int) -> Callable:
+    def _aot_fn(self, index, bucket: int, k: int) -> Callable:
         cache = _aot_executables()
         if self.kind == "ivf_pq":
-            n_probes = min(self.params.n_probes, self.index.n_lists)
+            n_probes = min(self.params.n_probes, index.n_lists)
             mode = getattr(self.params, "scan_mode", "auto")
             if mode not in ("recon", "codes", "lut", "fused"):
-                mode = ("recon" if self.index.list_recon is not None
+                mode = ("recon" if index.list_recon is not None
                         else "lut")
-            return cache.get("ivf_pq", self.res, self.index, batch=bucket,
+            return cache.get("ivf_pq", self.res, index, batch=bucket,
                              k=k, n_probes=n_probes, scan_mode=mode)
         if self.kind == "ivf_flat":
-            n_probes = min(self.params.n_probes, self.index.n_lists)
-            return cache.get("ivf_flat", self.res, self.index, batch=bucket,
+            n_probes = min(self.params.n_probes, index.n_lists)
+            return cache.get("ivf_flat", self.res, index, batch=bucket,
                              k=k, n_probes=n_probes)
         if self.kind == "brute_force":
-            return cache.get("brute_force", self.res, self.index,
+            return cache.get("brute_force", self.res, index,
                              batch=bucket, k=k)
         # cagra: export when the packed walk calibrates, else live
         itopk = max(getattr(self.params, "itopk_size", 64), k)
         width = getattr(self.params, "search_width", 1)
-        return cache.get("cagra", self.res, self.index, batch=bucket, k=k,
+        return cache.get("cagra", self.res, index, batch=bucket, k=k,
                          itopk=itopk, search_width=width)
 
-    def _live_fn(self, k: int) -> Callable:
+    def _live_fn(self, index, k: int) -> Callable:
         # live module entry points under validation policy "off": the
         # server already boundary-checked each request at submit, and
-        # padded zero rows must not be re-flagged
+        # padded zero rows must not be re-flagged.  The closure captures
+        # the index ARGUMENT (not self.index) so a built fn table stays
+        # pinned to the generation it was built against.
         from raft_tpu import config
 
         if self.kind == "ivf_pq":
@@ -170,14 +182,46 @@ class Executor:
 
             def bf(queries):
                 with config.validation_policy("off"):
-                    return brute_force.knn(self.res, self.index, queries, k)
+                    return brute_force.knn(self.res, index, queries, k)
             return bf
 
         def live(queries):
             with config.validation_policy("off"):
-                return mod.search(self.res, self.params, self.index,
+                return mod.search(self.res, self.params, index,
                                   queries, k)
         return live
+
+    # ---- generation swap ------------------------------------------------
+
+    def swap_index(self, new_index) -> int:
+        """Swap in a new index generation without a serving gap.
+
+        Builds a COMPLETE replacement executable table against
+        ``new_index`` and (when the executor was warmed) warms every
+        (bucket, k) with a zero batch before anything is published; the
+        swap itself is one tuple assignment of ``(index, _fns)``, atomic
+        under the GIL.  In-flight :meth:`search_bucket` calls captured
+        the old table on entry and finish on the generation they started
+        on; calls arriving after the swap see only the new one — no
+        reader ever observes a mixed table, and steady-state traffic
+        after the swap recompiles nothing.  Returns the number of bucket
+        executables built."""
+        expects(new_index is not None, "serving: swap_index needs an index")
+        dim = self._index_dim(new_index)
+        expects(dim == self.dim,
+                f"serving: swap_index dim mismatch ({dim} != {self.dim})")
+        fns: Dict[Tuple[int, int], Callable] = {}
+        for b in self.buckets:
+            for k in self.ks:
+                fn = self._build_fn(new_index, b, k)
+                if self._warmed:
+                    zeros = jnp.zeros((b, dim), self.query_dtype)
+                    jax.block_until_ready(fn(zeros))
+                fns[(b, k)] = fn
+        self.index, self._fns = new_index, fns
+        if obs.enabled():
+            obs.registry().counter("serving.generation_swaps").inc()
+        return len(fns)
 
     # ---- the hot path ---------------------------------------------------
 
@@ -186,9 +230,15 @@ class Executor:
         """Search a padded bucket batch; rows past ``n_valid`` come back
         masked (id -1 / worst distance) through the integrity mask path."""
         bucket = queries.shape[0]
-        expects((bucket, k) in self._fns or not self._warmed,
+        # one capture of the published table: a concurrent swap_index
+        # replaces self._fns wholesale, so everything below dispatches
+        # against a single consistent generation
+        fns = self._fns
+        fn = fns.get((bucket, k))
+        expects(fn is not None or not self._warmed,
                 f"serving: shape ({bucket}, {k}) is not a warmed bucket")
-        fn = self._obtain(bucket, k)
+        if fn is None:
+            fn = self._obtain(bucket, k)
         d, i = fn(queries)
         if n_valid < bucket:
             d, i = _boundary.mask_search_outputs(
@@ -220,24 +270,23 @@ class DistributedExecutor(Executor):
                          max_batch=max_batch, search_params=search_params,
                          warm="jit")
 
-    @property
-    def dim(self) -> int:
-        return int(self.index.rotation.shape[2])
+    def _index_dim(self, index) -> int:
+        return int(index.rotation.shape[2])
 
     @property
     def query_dtype(self):
         return self.index.centers.dtype
 
-    def _aot_fn(self, bucket: int, k: int) -> Callable:
+    def _aot_fn(self, index, bucket: int, k: int) -> Callable:
         raise NotImplementedError("distributed indexes are jit-warmed")
 
-    def _live_fn(self, k: int) -> Callable:
+    def _live_fn(self, index, k: int) -> Callable:
         from raft_tpu import config
         from raft_tpu.distributed import ann
 
         def live(queries):
             with config.validation_policy("off"):
-                return ann.search(self.handle, self.params, self.index,
+                return ann.search(self.handle, self.params, index,
                                   queries, k,
                                   failed_shards=self.failed_shards)
         return live

@@ -96,6 +96,20 @@ class Server:
     def __exit__(self, *exc) -> None:
         self.stop()
 
+    def swap_index(self, new_index) -> int:
+        """Swap the executor onto a new index generation while serving.
+
+        Delegates to :meth:`Executor.swap_index`: a complete replacement
+        executable table is built and warmed against ``new_index`` before
+        one atomic publish, so requests in flight finish on the
+        generation they started on, later requests see only the new one,
+        and steady-state traffic after the swap triggers zero recompiles.
+        Returns the number of bucket executables built."""
+        with obs.stage("serving.generation_swap") as st:
+            n = self.executor.swap_index(new_index)
+            st.fence()
+        return n
+
     # ---- request path ---------------------------------------------------
 
     def submit(self, queries, k: Optional[int] = None, *,

@@ -32,6 +32,15 @@ def _clean_registry():
     obs.reset()
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _drop_compile_caches():
+    # bucket warm-ups and generation swaps compile many executables;
+    # release them at teardown so later modules in a full-suite run
+    # don't inherit the accumulated JIT code mappings
+    yield
+    jax.clear_caches()
+
+
 def _dataset(n=4000, dim=32, seed=0):
     rng = np.random.default_rng(seed)
     db = rng.normal(size=(n, dim)).astype(np.float32)
@@ -445,6 +454,95 @@ class TestExecutableCache:
         g2 = cache.get("ivf_pq", res, index2, batch=2, k=5, n_probes=4,
                        scan_mode="recon")
         assert g2 is not g1
+
+
+# ---------------------------------------------------------------------------
+# generation swaps (mutation satellite)
+
+
+class TestGenerationSwap:
+    """extend/delete land on readers only through ``swap_index``: after a
+    swap, every bucket executable serves the fresh generation (zero
+    wrong-generation executions) and steady state stays recompile-free."""
+
+    def _far_point(self, dim=32):
+        # a row far outside the data cloud: its own nearest neighbor by a
+        # huge margin, so any request still served by the OLD generation's
+        # executables is caught by a single top-1 check
+        return np.full((1, dim), 50.0, np.float32)
+
+    def test_extend_then_swap_hits_fresh_index_every_bucket(self,
+                                                            pq_setup):
+        res, db, _, index, sp = pq_setup
+        new_id = int(db.shape[0])
+        ex = _executor(pq_setup, warm="aot")
+        cfg = serving.ServerConfig(max_batch=16, max_wait_us=2_000)
+        probe = self._far_point()
+        with serving.Server(ex, cfg) as srv:
+            _, before = srv.search(probe, 5)
+            assert new_id not in np.asarray(before)
+            extended = ivf_pq.extend(
+                res, index, jnp.asarray(probe),
+                np.asarray([new_id], np.int64))
+            n_fns = srv.swap_index(extended)
+            assert n_fns == len(ex.buckets) * len(ex.ks)
+            assert ex.index is extended
+            # every bucket size must route to the new generation: pad the
+            # probe into requests landing in each bucket
+            for m in (1, 2, 3, 8, 16):
+                q = np.repeat(probe, m, axis=0)
+                _, ids = srv.search(q, 5)
+                ids = np.asarray(ids)
+                assert (ids[:, 0] == new_id).all(), (m, ids[:, 0])
+
+    def test_zero_steady_state_recompiles_across_swap(self, pq_setup):
+        res, db, _, index, _ = pq_setup
+        ex = _executor(pq_setup, warm="aot")
+        cfg = serving.ServerConfig(max_batch=16, max_wait_us=2_000)
+        q = np.asarray(pq_setup[2])
+        with obs.collecting():
+            with serving.Server(ex, cfg) as srv:
+                for m in (1, 3, 8, 16, 5, 2):
+                    srv.search(q[:m], 5)
+                mutated = ivf_pq.delete(res, index, [0, 1, 2])
+                srv.swap_index(mutated)   # re-warm happens HERE, not later
+                c0 = obs.registry().counter("xla.compiles").value
+                for m in (2, 16, 1, 7, 4, 16, 3):
+                    srv.search(q[:m], 5)
+                c1 = obs.registry().counter("xla.compiles").value
+                swaps = obs.registry().counter(
+                    "serving.generation_swaps").value
+        assert c1 == c0, f"{c1 - c0} recompiles in post-swap steady state"
+        assert swaps == 1
+
+    def test_cache_keys_generations_apart(self, pq_setup):
+        """Same index object, different generation stamp -> distinct
+        executables (the rebalancer mutates and re-serves the same
+        logical index; a stale hit would serve deleted rows)."""
+        res, _, q, index, _ = pq_setup
+        cache = aot.ExecutableCache()
+        a = cache.get("ivf_pq", res, index, batch=2, k=5, n_probes=8,
+                      scan_mode="recon")
+        gen0 = getattr(index, "generation", 0)
+        try:
+            index.generation = gen0 + 1
+            b = cache.get("ivf_pq", res, index, batch=2, k=5, n_probes=8,
+                          scan_mode="recon")
+            assert b is not a
+            # same generation again -> cache hit
+            assert cache.get("ivf_pq", res, index, batch=2, k=5,
+                             n_probes=8, scan_mode="recon") is b
+        finally:
+            index.generation = gen0
+
+    def test_swap_rejects_dim_mismatch(self, pq_setup):
+        res, db, _, index, sp = pq_setup
+        ex = _executor(pq_setup, warm="jit")
+        narrow = ivf_pq.build(
+            res, ivf_pq.IndexParams(n_lists=8, pq_dim=4, kmeans_n_iters=2),
+            np.asarray(db)[:500, :16])
+        with pytest.raises(Exception, match="dim"):
+            ex.swap_index(narrow)
 
 
 # ---------------------------------------------------------------------------
