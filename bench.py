@@ -911,6 +911,85 @@ def bench_mnmg(res) -> dict:
     }
 
 
+DIST_ROWS_PER_DEV = 131_072     # ~1M across a v5e-8
+DIST_DIM = 96
+DIST_N_LISTS = 512
+DIST_N_PROBES = 32
+
+
+def bench_distributed(res) -> list:
+    """Round-8 grid: routed (``placement="by_list"``) vs data-parallel
+    sharded IVF-PQ search over the available devices, emitting
+    ``dist_qps_routed`` / ``dist_qps_dataparallel`` plus the per-query
+    candidate-exchange bytes and the per-shard scanned-row ratio — the
+    numbers PERFORMANCE.md's per-chip work / gather-bytes model
+    predicts (routed scan work ~1/n_shards, gather fixed at (k, nq)
+    pairs per shard for BOTH modes; the routed win is the scan)."""
+    import jax
+
+    from raft_tpu.comms.session import CommsSession
+    from raft_tpu.distributed import ann as dist_ann
+    from raft_tpu.neighbors import ivf_pq
+
+    n_dev = len(jax.devices())
+    n = DIST_ROWS_PER_DEV * n_dev
+    db, queries = _make_dataset({"n_db": n, "dim": DIST_DIM,
+                                 "latent_dim": 32, "n_queries": 1000})
+    nq, k = queries.shape[0], K
+    params = ivf_pq.IndexParams(n_lists=DIST_N_LISTS, pq_dim=DIST_DIM // 2,
+                                kmeans_n_iters=5,
+                                cache_reconstructions=True)
+    sp = ivf_pq.SearchParams(n_probes=DIST_N_PROBES)
+    out = []
+    session = CommsSession().init()
+    try:
+        handle = session.worker_handle()
+
+        def qps(index):
+            i = dist_ann.search(handle, sp, index, queries, k)[1]  # warm
+            np.asarray(i)
+            t0 = time.perf_counter()
+            for _ in range(RUNS):
+                i = dist_ann.search(handle, sp, index, queries, k)[1]
+            np.asarray(i)
+            return nq / ((time.perf_counter() - t0) / RUNS)
+
+        dp = dist_ann.build(handle, params, db)
+        dp_qps = qps(dp)
+        _, _, dp_stats = dist_ann.search(handle, sp, dp, queries, k,
+                                         return_stats=True)
+        routed = dist_ann.build(handle, params, db, placement="by_list")
+        routed_qps = qps(routed)
+        _, _, r_stats = dist_ann.search(handle, sp, routed, queries, k,
+                                        return_stats=True)
+    finally:
+        session.destroy()
+    # the candidate exchange: each shard contributes (nq, k) f32+i32
+    # pairs regardless of placement — fixed, not index-size-dependent
+    gather_bytes = n_dev * nq * k * 8
+    scan_ratio = (float(r_stats["scanned_rows"].max())
+                  / max(float(dp_stats["scanned_rows"].max()), 1.0))
+    shape = f"{n // 1_000_000}Mx{DIST_DIM}_{n_dev}dev"
+    out.append({
+        "metric": f"dist_qps_routed_{shape}",
+        "value": round(routed_qps, 1), "unit": "qps",
+        "vs_baseline": round(routed_qps / max(dp_qps, 1e-9), 3),
+        "detail": {"n_probes": DIST_N_PROBES, "k": k, "batch": nq,
+                   "gather_bytes": gather_bytes,
+                   "scanned_rows_max": int(r_stats["scanned_rows"].max()),
+                   "scan_ratio_vs_dataparallel": round(scan_ratio, 4)},
+    })
+    out.append({
+        "metric": f"dist_qps_dataparallel_{shape}",
+        "value": round(dp_qps, 1), "unit": "qps",
+        "vs_baseline": 1.0,
+        "detail": {"n_probes": DIST_N_PROBES, "k": k, "batch": nq,
+                   "gather_bytes": gather_bytes,
+                   "scanned_rows_max": int(dp_stats["scanned_rows"].max())},
+    })
+    return out
+
+
 # ---------------------------------------------------------------------------
 # conf-driven multi-algo harness (reference: cpp/bench/ann/conf/*.json
 # workloads + eval.pl summary conditions "QPS at recall=0.9/0.95",
@@ -1165,6 +1244,8 @@ def main() -> None:
     print(json.dumps(bench_ivf_pq(res, db, queries, gt_i)), flush=True)
     print(json.dumps(bench_kmeans(res, db[:KMEANS_N])), flush=True)
     print(json.dumps(bench_mnmg(res)), flush=True)
+    for line in bench_distributed(res):
+        print(json.dumps(line), flush=True)
     # online serving over a 100k slice of the same dataset (the CI
     # smoke runs the conf/serving-smoke.json variant of this)
     for line in bench_serving(res, db[:SERVING_N], queries[:2048]):

@@ -167,9 +167,15 @@ class ExecutableCache:
         # check: a mutated index is a NEW object (delete/extend/compact
         # return fresh snapshots), but keying the generation explicitly
         # keeps a recycled id() from ever pairing a stale executable with
-        # a newer generation, and makes swap-time invalidation exact
+        # a newer generation, and makes swap-time invalidation exact.
+        # by_list indexes additionally key their PLACEMENT generation: a
+        # rebalance that moves lists between shards invalidates every
+        # per-shard executable even if no row was mutated
+        placement_gen = int(getattr(getattr(index, "placement", None),
+                                    "generation", 0) or 0)
         key = (kind, id(index), int(getattr(index, "generation", 0) or 0),
-               int(batch), int(k), int(n_probes), scan_mode, extra)
+               placement_gen, int(batch), int(k), int(n_probes),
+               scan_mode, extra)
         with self._lock:
             hit = self._entries.get(key)
             if hit is not None and hit[0]() is index:
@@ -284,6 +290,57 @@ def export_ivf_pq_search(res, index, n_probes: int, k: int, batch: int,
 
     example_q = jax.ShapeDtypeStruct((batch, index.dim),
                                      index.centers.dtype)
+    buf = io.BytesIO()
+    save_search_fn(buf, fn, arrays, example_q)
+    buf.seek(0)
+    return buf
+
+
+def export_ivf_pq_routed_search(res, index, shard: int, n_probes: int,
+                                k: int, batch: int) -> io.BytesIO:
+    """Export ONE shard's routed (``placement="by_list"``) search
+    program at fixed (batch, k, n_probes): replicated coarse routing +
+    ownership mask + the recon scan over the shard's owned lists +
+    shard-local top-k.  The artifact is the per-chip deployment unit of
+    an index-parallel mesh — each chip loads its own shard's program,
+    and the k-bounded candidate exchange/merge stays in the (tiny)
+    runtime layer.  Merging every shard's exported outputs with
+    ``grouped.finalize_topk`` reproduces the live
+    :func:`raft_tpu.distributed.ann.search` answer exactly (the
+    hierarchical-top-k argument; asserted in tests).
+
+    ``shard_map`` itself is not exportable — this bakes the shard's
+    leaves plus the replicated routing arrays (coarse centers, rotation,
+    owner, local_slot) into a single-device program instead."""
+    from raft_tpu.neighbors import ivf_pq
+
+    expects(getattr(index, "placement", None) is not None,
+            "aot: export_ivf_pq_routed_search needs a RoutedIndex "
+            "(placement='by_list')")
+    expects(0 <= shard < index.n_shards,
+            f"aot: shard {shard} out of range for {index.n_shards}")
+    metric = index.metric
+    dummy = int(index.local_centers.shape[1]) - 1
+
+    def fn(coarse, rotation, owner, local_slot, local_centers,
+           list_recon, list_recon_sq, list_indices, queries):
+        probes = ivf_pq._select_clusters(coarse, rotation, queries,
+                                         n_probes, metric)
+        owned = owner[probes] == shard
+        local_probes = jax.numpy.where(owned, local_slot[probes],
+                                       dummy).astype(jax.numpy.int32)
+        return ivf_pq._search_impl_recon(
+            local_centers, list_recon, list_indices, rotation, queries,
+            k=k, n_probes=n_probes, metric=metric, probes=local_probes,
+            list_recon_sq=list_recon_sq)
+
+    arrays = tuple(jax.device_get(a) for a in (
+        index.coarse_centers, index.rotation, index.owner,
+        index.local_slot, index.local_centers[shard],
+        index.list_recon[shard], index.list_recon_sq[shard],
+        index.list_indices[shard]))
+    example_q = jax.ShapeDtypeStruct((batch, index.dim),
+                                     index.coarse_centers.dtype)
     buf = io.BytesIO()
     save_search_fn(buf, fn, arrays, example_q)
     buf.seek(0)

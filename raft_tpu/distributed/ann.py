@@ -16,6 +16,25 @@ detail/cagra/graph_core.cuh:333-369).  raft_tpu provides the full algorithm:
 
 This is the same shard → local select_k → all_gather → merge shape as
 :mod:`raft_tpu.distributed.knn`, applied to the compressed index.
+
+Two placements coexist (round 8):
+
+- ``placement="by_row"`` (the original data-parallel mode above): every
+  shard scans its whole local index for every query — per-chip scan work
+  is constant in the chip count.
+- ``placement="by_list"`` (index-parallel, :class:`RoutedIndex`): ONE
+  global coarse quantizer, replicated on every chip, with the IVF lists
+  partitioned across shards balanced by live list size
+  (:func:`compute_placement`).  Search *routes* each query's ``n_probes``
+  probe set: a shard scans only the probed lists it owns (unowned probes
+  lower to an always-empty dummy list slot — the same ``id < 0`` /
+  worst-distance padded-row path tombstones ride, zero kernel changes),
+  then the k-bounded candidate exchange — per-shard local top-k,
+  fixed-size ``all_gather`` of (q, k) pairs, replicated
+  ``grouped.finalize_topk`` merge — replaces the full-index gather.
+  Per-chip candidate work drops by ~``n_shards`` at identical results:
+  any global top-k candidate is in its owning shard's local top-k, so
+  the routed search is exactly the single-index search.
 """
 
 from __future__ import annotations
@@ -28,18 +47,29 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu.core import serialize as ser
 from raft_tpu.core.compat import shard_map
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.core.tracing import range as named_range
 from raft_tpu.distance.types import DistanceType
 from raft_tpu.matrix.select_k import select_k
+from raft_tpu.neighbors import grouped
 from raft_tpu.neighbors import ivf_pq
 from raft_tpu.neighbors import mutate as _mutate
 from raft_tpu.resilience import faults
 from raft_tpu.resilience import retry as _retry
 
 P = jax.sharding.PartitionSpec
+
+# per-shard status codes (the ``return_status=True`` vector).  OK_FALLBACK
+# marks a LIVE shard whose requested ``scan_mode`` could not run under
+# ``shard_map`` and was lowered to the traceable recon scan — previously
+# only visible as a counter tick, now explicit per shard (results are
+# still correct; only the formulation differs).
+SHARD_FAILED = 0
+SHARD_OK = 1
+SHARD_OK_FALLBACK = 2
 
 
 def _entry(site, fn, retry_policy, deadline):
@@ -59,6 +89,39 @@ def _degraded_set(n_shards: int, failed_shards: Sequence[int]
     ``fail_shards``, clipped to range and sorted (a static jit key)."""
     flagged = {int(s) for s in failed_shards if 0 <= int(s) < n_shards}
     return tuple(sorted(flagged | set(faults.failed_shards(n_shards))))
+
+
+def _status_vector(n_shards: int, failed: Tuple[int, ...],
+                   lowered: bool) -> jax.Array:
+    """(n_shards,) int8 per-shard status: failed shards report
+    :data:`SHARD_FAILED`; live shards report :data:`SHARD_OK_FALLBACK`
+    when the requested scan mode was lowered, else :data:`SHARD_OK`."""
+    status = np.full(n_shards,
+                     SHARD_OK_FALLBACK if lowered else SHARD_OK, np.int8)
+    status[list(failed)] = SHARD_FAILED
+    return jnp.asarray(status)
+
+
+def _scan_mode_lowered(params) -> bool:
+    """Validate ``params.scan_mode`` and report whether the sharded
+    search lowers it.  Under ``shard_map`` the grouped Pallas kernels
+    (fused included) cannot dispatch — their group construction is
+    batch-data-dependent and host-driven — so every mode lowers to the
+    traceable probe-order recon scan.  An explicit non-recon request
+    ticks the counters so operators see the lowering."""
+    mode = getattr(params, "scan_mode", "auto")
+    expects(mode in ivf_pq._SCAN_MODES,
+            f"distributed.ann.search: unknown scan_mode {mode!r}")
+    lowered = mode not in ("auto", "recon")
+    if lowered:
+        from raft_tpu import observability as obs
+        if obs.enabled():
+            obs.registry().counter(
+                "distributed.ann.scan_mode_lowered").inc()
+            if mode == "fused":
+                obs.registry().counter(
+                    "ivf_pq.search.fused_fallback").inc()
+    return lowered
 
 
 @jax.tree_util.register_pytree_node_class
@@ -96,23 +159,37 @@ class DistributedIndex:
 
 
 def build(handle, params: ivf_pq.IndexParams, dataset, *,
+          placement: str = "by_row",
           retry_policy: Optional[_retry.RetryPolicy] = None,
-          deadline: Optional[_retry.Deadline] = None) -> DistributedIndex:
-    """Shard rows over the handle's mesh and build one local index per
-    shard (ids globally offset).  ``params.n_lists`` is per shard.
+          deadline: Optional[_retry.Deadline] = None):
+    """Build a sharded IVF-PQ index over the handle's mesh.
 
-    PER_SUBSPACE builds run as ONE two-phase ``shard_map`` — every
-    shard's k-means, codebook training and encoding execute SPMD across
-    the mesh simultaneously, with a single tiny host sync (the global
-    max list size) between encoding and list packing.  The round-3
-    host loop built shards one after another — 8x the build latency on
-    a v5e-8 for no reason (VERDICT r3).  Other codebook kinds and
-    mesocluster-scale n_lists fall back to the sequential per-shard
-    loop.
+    ``placement="by_row"`` (default): rows are split across shards and
+    each shard trains its own local index (ids globally offset);
+    ``params.n_lists`` is per shard.  PER_SUBSPACE builds run as ONE
+    two-phase ``shard_map`` — every shard's k-means, codebook training
+    and encoding execute SPMD across the mesh simultaneously, with a
+    single tiny host sync (the global max list size) between encoding
+    and list packing.  The round-3 host loop built shards one after
+    another — 8x the build latency on a v5e-8 for no reason (VERDICT
+    r3).  Other codebook kinds and mesocluster-scale n_lists fall back
+    to the sequential per-shard loop.
+
+    ``placement="by_list"``: ONE global index is trained (so
+    ``params.n_lists`` is GLOBAL) and its lists are partitioned across
+    shards balanced by list size — returns a :class:`RoutedIndex` whose
+    search routes probes to owning shards (see module docstring).
 
     Transient faults at entry (site ``distributed.ann.build``) are
     retried under ``retry_policy`` / ``deadline``.
     """
+    expects(placement in ("by_row", "by_list"),
+            f"distributed.ann.build: placement must be 'by_row' or "
+            f"'by_list', got {placement!r}")
+    if placement == "by_list":
+        return _entry("distributed.ann.build",
+                      lambda: _build_by_list(handle, params, dataset),
+                      retry_policy, deadline)
     return _entry("distributed.ann.build",
                   lambda: _build_impl(handle, params, dataset),
                   retry_policy, deadline)
@@ -318,21 +395,32 @@ def _dist_search(index_leaves, queries, k, n_probes, metric, axis_name,
     return run(index_leaves, queries)
 
 
-def search(handle, params: ivf_pq.SearchParams, index: DistributedIndex,
-           queries, k: int, *,
+def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
            failed_shards: Sequence[int] = (),
            return_status: bool = False,
+           return_stats: bool = False,
            retry_policy: Optional[_retry.RetryPolicy] = None,
            deadline: Optional[_retry.Deadline] = None):
     """Sharded search + merge; returns replicated (distances, global ids)
-    of shape (q, k).
+    of shape (q, k).  Accepts both placements: a
+    :class:`DistributedIndex` (data-parallel full-shard scan) or a
+    :class:`RoutedIndex` (routed-probe scan over owned lists only).
 
     Degraded mode: shards listed in ``failed_shards`` (or flagged by the
     active fault plan's ``fail_shards``) are masked out of the merge —
     the query still answers with the live shards' top-k, the tail padded
     with ``(inf, -1)`` when fewer than ``k`` live candidates exist.
-    With ``return_status=True`` a third output is appended: an
-    ``(n_shards,)`` int8 vector, 1 = healthy / 0 = failed-and-skipped.
+    Under ``by_list`` a lost shard drops only its *owned* lists — recall
+    degrades by roughly the failed shard's probed share instead of a
+    full replica vanishing.  With ``return_status=True`` a status output
+    is appended: an ``(n_shards,)`` int8 vector of
+    :data:`SHARD_FAILED` / :data:`SHARD_OK` / :data:`SHARD_OK_FALLBACK`
+    (live, but the requested ``scan_mode`` was lowered — see below).
+    With ``return_stats=True`` a host-side dict is appended (after the
+    status vector when both are requested) with the per-shard
+    ``scanned_rows`` counter, the fixed candidate-exchange
+    ``gather_shape``, and the effective ``scan_mode`` — the observability
+    surface the placement-balance tripwire asserts on.
     Transient faults at entry (site ``distributed.ann.search``) are
     retried under ``retry_policy`` / ``deadline``.
 
@@ -341,38 +429,61 @@ def search(handle, params: ivf_pq.SearchParams, index: DistributedIndex,
     in-kernel top-k) cannot dispatch — their group construction is
     batch-data-dependent and host-driven.  Every mode therefore lowers
     to the traceable probe-order recon scan here; results are identical
-    in ranking semantics.  An explicit ``scan_mode="fused"`` request is
-    accepted but ticks the ``ivf_pq.search.fused_fallback`` counter so
-    operators can see the sharded path did not hit the fused kernel.
+    in ranking semantics.  An explicit non-recon request is accepted but
+    reported: live shards answer :data:`SHARD_OK_FALLBACK` in the status
+    vector, the ``distributed.ann.scan_mode_lowered`` counter ticks, and
+    ``scan_mode="fused"`` additionally ticks
+    ``ivf_pq.search.fused_fallback`` (the pre-round-8 signal).
     """
     with named_range("distributed::ivf_pq_search"):
         expects(handle.comms_initialized(),
                 "distributed.ann.search: handle has no comms")
-        mode = getattr(params, "scan_mode", "auto")
-        expects(mode in ivf_pq._SCAN_MODES,
-                f"distributed.ann.search: unknown scan_mode {mode!r}")
-        if mode == "fused":
-            from raft_tpu import observability as obs
-            if obs.enabled():
-                obs.registry().counter(
-                    "ivf_pq.search.fused_fallback").inc()
+        lowered = _scan_mode_lowered(params)
         comms = handle.get_comms()
         queries = ensure_array(queries, "queries")
-        n_probes = min(params.n_probes, index.centers.shape[1])
-        leaves = (index.centers, index.list_indices, index.rotation,
-                  index.list_recon)
         failed = _degraded_set(index.n_shards, failed_shards)
-        d, i = _entry(
-            "distributed.ann.search",
-            lambda: _dist_search(leaves, queries, int(k), n_probes,
-                                 index.metric, comms.axis_name,
-                                 handle.mesh, failed=failed),
-            retry_policy, deadline)
-        if not return_status:
-            return d, i
-        status = np.ones(index.n_shards, np.int8)
-        status[list(failed)] = 0
-        return d, i, jnp.asarray(status)
+        if isinstance(index, RoutedIndex):
+            n_probes = min(params.n_probes, index.n_lists)
+            sharded = (index.local_centers, index.list_recon,
+                       index.list_recon_sq, index.list_indices)
+            replicated = (index.coarse_centers, index.rotation,
+                          index.owner, index.local_slot)
+            d, i, scanned = _entry(
+                "distributed.ann.search",
+                lambda: _dist_search_routed(
+                    sharded, replicated, queries, int(k), n_probes,
+                    index.metric, comms.axis_name, handle.mesh,
+                    failed=failed),
+                retry_policy, deadline)
+        else:
+            n_probes = min(params.n_probes, index.centers.shape[1])
+            leaves = (index.centers, index.list_indices, index.rotation,
+                      index.list_recon)
+            scanned = None
+            d, i = _entry(
+                "distributed.ann.search",
+                lambda: _dist_search(leaves, queries, int(k), n_probes,
+                                     index.metric, comms.axis_name,
+                                     handle.mesh, failed=failed),
+                retry_policy, deadline)
+        out = [d, i]
+        if return_status:
+            out.append(_status_vector(index.n_shards, failed, lowered))
+        if return_stats:
+            if scanned is None:
+                # data-parallel: every live shard scans its whole local
+                # index for every probe — n_probes lists of cap rows
+                cap = index.list_recon.shape[2]
+                per = np.full(index.n_shards,
+                              queries.shape[0] * n_probes * cap, np.int64)
+                per[list(failed)] = 0
+                gather = (index.n_shards, int(queries.shape[0]), int(k))
+            else:
+                per = np.asarray(scanned, np.int64)
+                gather = (index.n_shards, int(queries.shape[0]), int(k))
+            out.append({"scanned_rows": per, "gather_shape": gather,
+                        "scan_mode": "recon", "n_probes": int(n_probes)})
+        return tuple(out) if len(out) > 2 else (d, i)
 
 
 def delete(handle, index: DistributedIndex, ids, *,
@@ -392,11 +503,17 @@ def delete(handle, index: DistributedIndex, ids, *,
                   lambda: _delete_impl(index, ids), retry_policy, deadline)
 
 
-def _delete_impl(index: DistributedIndex, ids) -> DistributedIndex:
+def _delete_impl(index, ids):
     with named_range("distributed::ivf_pq_delete"):
         ids = ensure_array(ids, "ids")
         expects(ids.ndim == 1, "distributed.ann.delete: 1-D ids required")
         new_li, _ = _mutate.tombstone(index.list_indices, ids)
+        if isinstance(index, RoutedIndex):
+            # sharding-preserving elementwise rewrite of the stacked
+            # (n_dev, L+1, cap) leaf; placement and canaries carry over
+            out = dataclasses.replace(index, list_indices=new_li)
+            _mutate.next_generation(index, out)
+            return out
         leaves, aux = index.tree_flatten()
         leaves = list(leaves)
         leaves[3] = new_li
@@ -404,6 +521,428 @@ def _delete_impl(index: DistributedIndex, ids) -> DistributedIndex:
         out.shard_canaries = index.shard_canaries
         _mutate.next_generation(index, out)
         return out
+
+
+# ---------------------------------------------------------------------------
+# Index-parallel sharding (placement="by_list"): routed probes + matched
+# candidate gather
+# ---------------------------------------------------------------------------
+
+_PLACEMENT_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Placement:
+    """List → shard ownership map for ``placement="by_list"`` indexes.
+
+    ``owner[g]`` is the shard owning global IVF list ``g``;
+    ``local_slot[g]`` is that list's slot in the owner's stacked local
+    leaves.  ``n_local`` is the per-shard slot count *excluding* the
+    dummy slot (every shard's slot ``n_local`` is an always-empty list
+    that unowned probes lower to).  ``generation`` counts placement
+    recomputations — it keys the serving tier's executable cache
+    alongside the index mutation generation."""
+
+    owner: np.ndarray       # (n_lists,) int32
+    local_slot: np.ndarray  # (n_lists,) int32
+    n_shards: int
+    n_local: int
+    generation: int = 0
+
+    @property
+    def n_lists(self) -> int:
+        return int(self.owner.shape[0])
+
+    def shard_lists(self, s: int) -> np.ndarray:
+        """Global list ids owned by shard ``s``, in local-slot order."""
+        owned = np.nonzero(self.owner == s)[0]
+        return owned[np.argsort(self.local_slot[owned], kind="stable")]
+
+
+def compute_placement(list_sizes, n_shards: int, *,
+                      generation: int = 0) -> Placement:
+    """Balanced list partition: LPT greedy — lists sorted by (live) size
+    descending, each assigned to the least-loaded shard (ties broken by
+    fewest lists, then lowest shard id, so the result is deterministic
+    and slot counts stay even under uniform sizes).  LPT is a 4/3
+    approximation to the optimal makespan, which bounds the worst
+    shard's scan work — the property the placement-balance tripwire
+    (``(probed_rows / n_shards) * 1.5``) rides on."""
+    sizes = np.asarray(list_sizes, np.int64).reshape(-1)
+    n_lists = sizes.shape[0]
+    expects(n_shards >= 1, "compute_placement: n_shards must be >= 1")
+    expects(n_lists >= n_shards,
+            f"compute_placement: need n_lists ({n_lists}) >= n_shards "
+            f"({n_shards}) to give every shard at least one list")
+    owner = np.zeros(n_lists, np.int32)
+    local_slot = np.zeros(n_lists, np.int32)
+    load = np.zeros(n_shards, np.int64)
+    count = np.zeros(n_shards, np.int64)
+    # stable argsort on -sizes: equal-size lists keep ascending id order
+    for g in np.argsort(-sizes, kind="stable"):
+        s = int(np.lexsort((count, load))[0])
+        owner[g] = s
+        local_slot[g] = count[s]
+        load[s] += int(sizes[g])
+        count[s] += 1
+    return Placement(owner=owner, local_slot=local_slot,
+                     n_shards=int(n_shards), n_local=int(count.max()),
+                     generation=int(generation))
+
+
+def placement_to_stream(res, stream, placement: Placement) -> None:
+    """CRC32-enveloped dump of the placement map (rides inside the
+    routed index envelope; also usable standalone)."""
+    with ser.enveloped_writer(stream) as body:
+        ser.serialize_scalar(res, body, np.int32(_PLACEMENT_VERSION))
+        ser.serialize_scalar(res, body, np.int32(placement.n_shards))
+        ser.serialize_scalar(res, body, np.int32(placement.n_local))
+        ser.serialize_scalar(res, body, np.int64(placement.generation))
+        ser.serialize_mdspan(res, body, placement.owner)
+        ser.serialize_mdspan(res, body, placement.local_slot)
+
+
+def placement_from_stream(res, stream) -> Placement:
+    body = ser.open_envelope(stream)
+    version = int(ser.deserialize_scalar(res, body))
+    if version != _PLACEMENT_VERSION:
+        raise ValueError(
+            f"placement serialization version mismatch: got {version}, "
+            f"expected {_PLACEMENT_VERSION}")
+    n_shards = int(ser.deserialize_scalar(res, body))
+    n_local = int(ser.deserialize_scalar(res, body))
+    generation = int(ser.deserialize_scalar(res, body))
+    owner = np.asarray(ser.deserialize_mdspan(res, body), np.int32)
+    local_slot = np.asarray(ser.deserialize_mdspan(res, body), np.int32)
+    return Placement(owner=owner, local_slot=local_slot,
+                     n_shards=n_shards, n_local=n_local,
+                     generation=generation)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class RoutedIndex:
+    """Index-parallel (``placement="by_list"``) IVF-PQ: one global
+    coarse quantizer + rotation replicated on every chip, the IVF lists
+    partitioned across shards.  Shard ``s``'s local leaves hold its
+    owned lists at slots ``0..n_owned-1`` plus a terminal dummy slot
+    (all ids ``-1``) that unowned probes lower to — the scan kernel's
+    existing padded-row mask makes those probes contribute nothing, so
+    routing needs zero kernel changes."""
+
+    coarse_centers: jax.Array  # (n_lists, rot_dim) — replicated
+    rotation: jax.Array        # (dim, rot_dim) — replicated
+    owner: jax.Array           # (n_lists,) int32 — replicated
+    local_slot: jax.Array      # (n_lists,) int32 — replicated
+    local_centers: jax.Array   # (n_dev, L+1, rot_dim) — sharded
+    list_recon: jax.Array      # (n_dev, L+1, cap, rot_dim) bf16 — sharded
+    list_recon_sq: jax.Array   # (n_dev, L+1, cap) — sharded
+    list_indices: jax.Array    # (n_dev, L+1, cap) — sharded
+    list_sizes: jax.Array      # (n_dev, L+1) — sharded
+    metric: int = DistanceType.L2Expanded
+    size: int = 0
+    # host-side metadata, NOT pytree leaves (transforms drop them; the
+    # host wrappers carry them explicitly, like shard_canaries above)
+    placement: Optional[Placement] = None
+    canaries: Optional[object] = None
+
+    @property
+    def n_shards(self) -> int:
+        return self.local_centers.shape[0]
+
+    @property
+    def n_lists(self) -> int:
+        return self.coarse_centers.shape[0]
+
+    @property
+    def capacity(self) -> int:
+        return self.list_indices.shape[2]
+
+    @property
+    def dim(self) -> int:
+        return self.rotation.shape[0]
+
+    def tree_flatten(self):
+        return ((self.coarse_centers, self.rotation, self.owner,
+                 self.local_slot, self.local_centers, self.list_recon,
+                 self.list_recon_sq, self.list_indices, self.list_sizes),
+                (self.metric, self.size))
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves, metric=aux[0], size=aux[1])
+
+
+def _mesh_layout(handle):
+    """Mesh geometry without the by_row row-divisibility constraint
+    (by_list shards lists, not rows)."""
+    comms = handle.get_comms()
+    mesh = handle.mesh
+    axis = comms.axis_name
+    expects(mesh.devices.ndim == 1,
+            "distributed.ann: a 1-D mesh is required (reshape 2D grids "
+            "to the data axis for index sharding)")
+    return comms, mesh, axis, mesh.shape[axis], mesh.devices.ravel()
+
+
+def _replicate(arr, mesh):
+    return jax.device_put(arr, jax.sharding.NamedSharding(
+        mesh, P(*([None] * jnp.ndim(arr)))))
+
+
+def _place_lists(handle, global_leaves, rotation, placement: Placement,
+                 metric, size) -> RoutedIndex:
+    """Assemble a :class:`RoutedIndex` from global per-list arrays
+    (centers, recon, recon_sq, indices, sizes) under ``placement``."""
+    centers, recon, rsq, li, sizes = global_leaves
+    comms, mesh, axis, n_dev, devs = _mesh_layout(handle)
+    expects(placement.n_shards == n_dev,
+            f"distributed.ann: placement maps {placement.n_shards} "
+            f"shards but the mesh has {n_dev} devices")
+    slots = placement.n_local + 1  # terminal dummy slot
+
+    per_shard = []
+    for s in range(n_dev):
+        owned = jnp.asarray(placement.shard_lists(s), jnp.int32)
+
+        def pad(a, fill, owned=owned):
+            sel = jnp.take(a, owned, axis=0)
+            width = ((0, slots - sel.shape[0]),) + ((0, 0),) * (a.ndim - 1)
+            return jnp.pad(sel, width, constant_values=fill)
+
+        per_shard.append((pad(centers, 0), pad(recon, 0), pad(rsq, 0),
+                          pad(li, -1), pad(sizes, 0)))
+    placed = _stack_leaves(per_shard, mesh, axis, devs)
+    return RoutedIndex(
+        coarse_centers=_replicate(centers, mesh),
+        rotation=_replicate(rotation, mesh),
+        owner=_replicate(jnp.asarray(placement.owner), mesh),
+        local_slot=_replicate(jnp.asarray(placement.local_slot), mesh),
+        local_centers=placed[0], list_recon=placed[1],
+        list_recon_sq=placed[2], list_indices=placed[3],
+        list_sizes=placed[4], metric=metric, size=size,
+        placement=placement)
+
+
+def shard_by_list(handle, index, *,
+                  placement: Optional[Placement] = None) -> RoutedIndex:
+    """Partition a single-chip IVF-PQ index's lists across the mesh.
+
+    The index must carry the reconstruction cache (the shard-local scan
+    is the recon formulation).  ``placement`` defaults to an LPT balance
+    over *live* list sizes (tombstones excluded — dead rows cost scan
+    work but a rebalance pass compacts them away, so balancing on live
+    rows keeps the placement stable across compactions)."""
+    with named_range("distributed::shard_by_list"):
+        expects(handle.comms_initialized(),
+                "distributed.ann.shard_by_list: handle has no comms")
+        expects(getattr(index, "list_recon", None) is not None,
+                "distributed.ann.shard_by_list: index must carry the "
+                "reconstruction cache (build with "
+                "cache_reconstructions=True)")
+        comms, mesh, axis, n_dev, devs = _mesh_layout(handle)
+        if placement is None:
+            live = _mutate.live_sizes(index.list_indices)
+            placement = compute_placement(np.asarray(live), n_dev)
+        rsq = index.list_recon_sq
+        if rsq is None:
+            rsq = ivf_pq._recon_sq(index.list_recon)
+        size = int(jnp.sum(index.list_sizes))
+        out = _place_lists(
+            handle, (index.centers, index.list_recon, rsq,
+                     index.list_indices, index.list_sizes),
+            index.rotation, placement, index.metric, size)
+        out.canaries = getattr(index, "canaries", None)
+        out.generation = _mutate.generation(index)
+        return out
+
+
+def _build_by_list(handle, params: ivf_pq.IndexParams,
+                   dataset) -> RoutedIndex:
+    with named_range("distributed::ivf_pq_build_by_list"):
+        expects(handle.comms_initialized(),
+                "distributed.ann.build: handle has no comms (use "
+                "CommsSession.worker_handle())")
+        expects(params.cache_reconstructions,
+                "distributed.ann: the routed search kernel runs the "
+                "reconstruction path; cache_reconstructions must be True")
+        dataset = ensure_array(dataset, "dataset")
+        comms, mesh, axis, n_dev, devs = _mesh_layout(handle)
+        expects(params.n_lists >= n_dev,
+                f"distributed.ann: by_list needs n_lists "
+                f"({params.n_lists}, GLOBAL in this mode) >= the "
+                f"{n_dev}-device mesh")
+        # ONE global quantizer/codebook train — the coarse structure is
+        # tiny and replicated; only the lists are partitioned
+        base = ivf_pq.build(handle, params, dataset)
+        return shard_by_list(handle, base)
+
+
+def _gather_global(index: RoutedIndex):
+    """Reassemble the global per-list arrays from the stacked shards
+    (admin path: rebalance / serialization — one cross-device gather of
+    each leaf, never on the serving path)."""
+    own = jnp.asarray(np.asarray(index.owner), jnp.int32)
+    slot = jnp.asarray(np.asarray(index.local_slot), jnp.int32)
+    centers = index.local_centers[own, slot]
+    recon = index.list_recon[own, slot]
+    rsq = index.list_recon_sq[own, slot]
+    li = index.list_indices[own, slot]
+    sizes = index.list_sizes[own, slot]
+    return centers, recon, rsq, li, sizes
+
+
+@functools.partial(jax.jit, static_argnames=("k", "n_probes", "metric",
+                                             "axis_name", "mesh", "failed"))
+def _dist_search_routed(sharded, replicated, queries, k, n_probes, metric,
+                        axis_name, mesh, failed=()):
+    sspecs = tuple(P(axis_name, *([None] * (leaf.ndim - 1)))
+                   for leaf in sharded)
+    rspecs = tuple(P() for _ in replicated)
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(sspecs, rspecs, P()),
+                       out_specs=(P(), P(), P()),
+                       check_vma=False)
+    def run(sl, rl, q):
+        local_centers, list_recon, list_recon_sq, list_indices = sl
+        coarse, rot, owner, local_slot = rl
+        s = jax.lax.axis_index(axis_name)
+        cap = list_recon.shape[2]
+        # replicated coarse routing: every shard ranks the SAME probe
+        # set deterministically, so ownership tests need no exchange
+        probes = ivf_pq._select_clusters(coarse, rot, q, n_probes, metric)
+        owned = owner[probes] == s                       # (q, n_probes)
+        dummy = local_centers.shape[1] - 1               # static slot L
+        local_probes = jnp.where(owned, local_slot[probes],
+                                 dummy).astype(jnp.int32)
+        # unowned probes point at the dummy slot: all-(-1) ids lower to
+        # the worst-distance padded-row path inside the scan — the same
+        # mask tombstones ride, so this is the existing kernel untouched
+        ld, li = ivf_pq._search_impl_recon(
+            local_centers[0], list_recon[0], list_indices[0], rot, q,
+            k, n_probes, metric, probes=local_probes,
+            list_recon_sq=list_recon_sq[0])
+        select_min = metric != DistanceType.InnerProduct
+        scanned = (jnp.sum(owned.astype(jnp.int32)) * cap).astype(
+            jnp.int32)
+        if failed:
+            bad = jnp.any(jnp.asarray(failed, jnp.int32) == s)
+            sentinel = jnp.inf if select_min else -jnp.inf
+            ld = jnp.where(bad, jnp.full_like(ld, sentinel), ld)
+            li = jnp.where(bad, jnp.full_like(li, -1), li)
+            scanned = jnp.where(bad, 0, scanned)
+        # the k-bounded candidate exchange: exactly (q, k) pairs per
+        # shard regardless of index size — the payload the data-parallel
+        # path also ships, but here each pair was mined from 1/n_shards
+        # of the probed rows
+        all_d = jax.lax.all_gather(ld, axis_name)        # (n_dev, q, k)
+        all_i = jax.lax.all_gather(li, axis_name)
+        all_scanned = jax.lax.all_gather(scanned, axis_name)  # (n_dev,)
+        nq = q.shape[0]
+        # hierarchical exactness: a global top-k candidate is in its
+        # owning shard's local top-k, so the replicated merge over the
+        # (n_dev * k)-wide survivors equals the single-index search.
+        # sqrt=False: the shard-local epilogue already applied it for
+        # the sqrt metrics, and the merge is monotone
+        md, mi = grouped.finalize_topk(
+            jnp.transpose(all_d, (1, 0, 2)),
+            jnp.transpose(all_i, (1, 0, 2)),
+            nq, k, select_min, False, select_k)
+        return md, mi, all_scanned
+
+    return run(sharded, replicated, queries)
+
+
+def rebalance_placement(handle, index: RoutedIndex, *,
+                        placement: Optional[Placement] = None
+                        ) -> RoutedIndex:
+    """Recompute the list partition from *live* row counts and re-shard.
+
+    The swap is a single global generation bump — the barrier the
+    serving tier needs: the new pytree is assembled completely (every
+    shard's leaves) before anything is published, and
+    ``Executor.swap_index`` installs it with one atomic reference swap
+    after warming, so no reader ever sees shard ``a`` at placement ``g``
+    and shard ``b`` at ``g+1``.  The placement generation advances with
+    it, invalidating placement-keyed cache entries."""
+    with named_range("distributed::rebalance_placement"):
+        expects(index.placement is not None,
+                "distributed.ann.rebalance_placement: index carries no "
+                "placement map")
+        centers, recon, rsq, li, sizes = _gather_global(index)
+        if placement is None:
+            live = jnp.sum(li >= 0, axis=1).astype(jnp.int32)
+            placement = compute_placement(
+                np.asarray(live), index.n_shards,
+                generation=index.placement.generation + 1)
+        out = _place_lists(handle, (centers, recon, rsq, li, sizes),
+                           index.rotation, placement, index.metric,
+                           index.size)
+        out.canaries = index.canaries
+        _mutate.next_generation(index, out)
+        return out
+
+
+_ROUTED_SERIALIZATION_VERSION = 1
+
+
+def serialize_routed(res, stream, index: RoutedIndex) -> None:
+    """CRC32-enveloped dump of a routed index: the placement map rides
+    in the envelope next to the global per-list arrays (reassembled from
+    the shards), so a reload lands lists on the same owners.  The bf16
+    recon cache is stored as uint16 views (the npy format carries no
+    bfloat16 descr — same trick :mod:`raft_tpu.core.aot` uses)."""
+    expects(index.placement is not None,
+            "distributed.ann.serialize_routed: index carries no "
+            "placement map")
+    centers, recon, rsq, li, sizes = _gather_global(index)
+    with ser.enveloped_writer(stream) as body:
+        ser.serialize_scalar(
+            res, body, np.int32(_ROUTED_SERIALIZATION_VERSION))
+        ser.serialize_scalar(res, body, np.int32(index.metric))
+        ser.serialize_scalar(res, body, np.int64(index.size))
+        ser.serialize_scalar(
+            res, body, np.int64(_mutate.generation(index)))
+        placement_to_stream(res, body, index.placement)
+        ser.serialize_mdspan(res, body, centers)
+        ser.serialize_mdspan(
+            res, body, np.asarray(jax.device_get(recon)).view(np.uint16))
+        ser.serialize_mdspan(res, body, rsq)
+        ser.serialize_mdspan(res, body, li)
+        ser.serialize_mdspan(res, body, sizes)
+        ser.serialize_mdspan(res, body, index.rotation)
+        from raft_tpu.integrity import canary as _canary
+        _canary.to_stream(res, body, index.canaries)
+
+
+def deserialize_routed(handle, stream) -> RoutedIndex:
+    """Reload a routed index onto the handle's mesh under its stored
+    placement (the mesh must match the stored shard count)."""
+    body = ser.open_envelope(stream)
+    version = int(ser.deserialize_scalar(handle, body))
+    if version != _ROUTED_SERIALIZATION_VERSION:
+        raise ValueError(
+            f"routed serialization version mismatch: got {version}, "
+            f"expected {_ROUTED_SERIALIZATION_VERSION}")
+    metric = int(ser.deserialize_scalar(handle, body))
+    size = int(ser.deserialize_scalar(handle, body))
+    generation = int(ser.deserialize_scalar(handle, body))
+    placement = placement_from_stream(handle, body)
+    centers = jnp.asarray(ser.deserialize_mdspan(handle, body))
+    recon = jnp.asarray(
+        ser.deserialize_mdspan(handle, body).view(jnp.bfloat16))
+    rsq = jnp.asarray(ser.deserialize_mdspan(handle, body))
+    li = jnp.asarray(ser.deserialize_mdspan(handle, body))
+    sizes = jnp.asarray(ser.deserialize_mdspan(handle, body))
+    rotation = jnp.asarray(ser.deserialize_mdspan(handle, body))
+    from raft_tpu.integrity import canary as _canary
+    canaries = _canary.from_stream(handle, body)
+    out = _place_lists(handle, (centers, recon, rsq, li, sizes),
+                       rotation, placement, metric, size)
+    out.canaries = canaries
+    out.generation = generation
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -794,6 +1333,15 @@ def health_check(handle, index, *, raise_on_fail: bool = True):
     the shard in its message."""
     from raft_tpu.integrity import IntegrityError
     from raft_tpu.integrity import canary as _canary
+    if isinstance(index, RoutedIndex):
+        # routed indexes carry ONE global canary set (the quantizer is
+        # global); the routed search is globally exact, so the standard
+        # single-index health check applies — it dispatches the search
+        # through this module (canary._search_canaries)
+        if index.canaries is None:
+            return None
+        return [_canary.health_check(handle, index,
+                                     raise_on_fail=raise_on_fail)]
     cans = getattr(index, "shard_canaries", None)
     if cans is None:
         return None

@@ -105,6 +105,13 @@ def _search_canaries(res, index, cs: CanarySet) -> np.ndarray:
     elif isinstance(index, cagra.Index):
         _, ids = raw(cagra.search)(res, cagra.SearchParams(), index, q,
                                    cs.k)
+    elif type(index).__name__ == "RoutedIndex":
+        # by_list distributed index (lazy import: integrity must not pull
+        # the comms fabric in); ``res`` is the worker handle here — the
+        # routed health check passes it through
+        from raft_tpu.distributed import ann as _dann
+        p = ivf_pq.SearchParams(n_probes=min(32, index.n_lists))
+        _, ids = _dann.search(res, p, index, q, cs.k)
     else:
         raise TypeError(
             f"health_check: unsupported index type {type(index).__name__}")

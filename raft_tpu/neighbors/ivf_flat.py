@@ -434,6 +434,28 @@ def delete(res, index: Index, ids) -> Index:
         return out
 
 
+def upsert(res, index: Index, ids, vectors) -> Index:
+    """Replace-or-insert rows under explicit source ids: tombstone any
+    existing rows with these ids, then append ``vectors`` under the same
+    ids — one logical mutation, ONE generation bump (a churn loop of
+    upserts advances the counter like a single ``extend`` per batch, so
+    generation-keyed caches see one swap, not two).  Ids not present
+    simply insert; duplicate live ids are all tombstoned first, so each
+    id resolves to exactly one live row."""
+    with named_range("ivf_flat::upsert"):
+        ids = ensure_array(ids, "ids")
+        vectors = ensure_array(vectors, "vectors")
+        expects(ids.ndim == 1 and ids.shape[0] == vectors.shape[0],
+                "ivf_flat.upsert: ids must be 1-D, one per vector")
+        parent_gen = _mutate.generation(index)
+        out = extend(res, delete(res, index, ids), vectors,
+                     new_indices=ids)
+        out.generation = parent_gen + 1
+        if obs.enabled():
+            obs.registry().counter("ivf_flat.upserts").inc()
+        return out
+
+
 def compact(res, index: Index) -> Index:
     """Reclaim tombstoned slots: stable-partition each list's live rows
     to the front, drop every tombstone, and shrink the shared capacity

@@ -58,6 +58,7 @@ from raft_tpu.serving.executor import (  # noqa: F401
 from raft_tpu.serving.rebalancer import (  # noqa: F401
     RebalanceConfig,
     Rebalancer,
+    rebalance_routed,
 )
 from raft_tpu.serving.server import Server, ServerConfig  # noqa: F401
 
@@ -70,6 +71,7 @@ __all__ = [
     "QuotaExceeded",
     "RebalanceConfig",
     "Rebalancer",
+    "rebalance_routed",
     "Request",
     "Server",
     "ServerConfig",

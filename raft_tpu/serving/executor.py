@@ -251,14 +251,22 @@ class Executor:
 
 
 class DistributedExecutor(Executor):
-    """Executor over a :mod:`raft_tpu.distributed.ann` sharded index.
+    """Executor over a :mod:`raft_tpu.distributed.ann` sharded index —
+    both placements: the data-parallel :class:`DistributedIndex` and the
+    routed-probe :class:`RoutedIndex` (``placement="by_list"``), whose
+    search routes each query's probes to owning shards via the
+    replicated placement map.
 
     Always ``warm="jit"`` (shard_map closures are not exportable).  The
     resilience surface passes through untouched: ``failed_shards`` /
     fault-plan masking and per-shard status behave exactly as in direct
     :func:`raft_tpu.distributed.ann.search` calls, and post-load
     :func:`raft_tpu.distributed.ann.health_check` works on the wrapped
-    index because the executor never copies or re-wraps it.
+    index because the executor never copies or re-wraps it.  Under
+    ``by_list`` a ``swap_index`` to a rebalanced snapshot is the global
+    generation barrier: the warmed fn table is rebuilt completely
+    against the new placement before the single atomic swap, so no
+    request ever mixes placements.
     """
 
     def __init__(self, handle, index, *, ks: Sequence[int] = (10,),
@@ -271,11 +279,16 @@ class DistributedExecutor(Executor):
                          warm="jit")
 
     def _index_dim(self, index) -> int:
-        return int(index.rotation.shape[2])
+        # rotation is (n_dev, dim, rot_dim) stacked (by_row) or
+        # (dim, rot_dim) replicated (by_list) — [-2] is dim in both
+        return int(index.rotation.shape[-2])
 
     @property
     def query_dtype(self):
-        return self.index.centers.dtype
+        centers = getattr(self.index, "coarse_centers", None)
+        if centers is None:
+            centers = self.index.centers
+        return centers.dtype
 
     def _aot_fn(self, index, bucket: int, k: int) -> Callable:
         raise NotImplementedError("distributed indexes are jit-warmed")

@@ -363,3 +363,110 @@ class Rebalancer:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+
+# ---------------------------------------------------------------------------
+# routed (placement="by_list") distributed indexes: per-shard passes +
+# a global generation barrier
+# ---------------------------------------------------------------------------
+
+def rebalance_routed(handle, index, *,
+                     config: Optional[RebalanceConfig] = None,
+                     server=None):
+    """One maintenance pass over a routed distributed index
+    (:class:`raft_tpu.distributed.ann.RoutedIndex`): per-shard
+    compaction passes followed by a placement recompute, published
+    under ONE global generation bump.
+
+    **Per-shard passes**: each shard's owned lists are examined
+    independently — only shards whose owned tombstone fraction reaches
+    ``config.dead_fraction`` get their lists rewritten (stable
+    live-rows-first, dead slots dropped from the occupied prefix); a
+    healthy shard's leaves pass through untouched, so the pass cost
+    scales with the damaged shards, not the mesh.
+
+    **The global barrier**: list moves are only safe if every chip
+    flips placements together — a reader seeing shard ``a`` at
+    placement ``g`` and shard ``b`` at ``g+1`` would double-count or
+    drop the moved lists.  So the pass assembles the COMPLETE new
+    pytree (every shard's leaves under the recomputed LPT placement)
+    before anything is published, bumps the index generation ONCE, and
+    publishes through ``server.swap_index`` — which warms a full
+    replacement executable table against the new placement generation
+    and installs it with a single atomic assignment.  In-flight
+    searches finish on the snapshot they started on.
+
+    Gate: the recall-canary ``health_check`` (when the index carries
+    canaries) must pass before the swap — same contract as the
+    single-index :class:`Rebalancer`.  Recluster (moving rows between
+    lists) needs the PQ encoder and stays with the single-index pass;
+    this pass repairs tombstone debt and placement skew.
+
+    Returns the index now serving: a new generation when repair work
+    was accepted, ``index`` unchanged on a no-op.  Fault sites:
+    ``rebalance.plan`` / ``rebalance.compact`` / ``rebalance.verify`` /
+    ``rebalance.swap``.
+    """
+    from raft_tpu.distributed import ann as _dann
+
+    expects(isinstance(index, _dann.RoutedIndex),
+            "rebalance_routed: a RoutedIndex (placement='by_list') is "
+            "required — data-parallel shards rebalance per shard with "
+            "the single-index Rebalancer")
+    config = config or RebalanceConfig()
+    faults.maybe_fail("rebalance.plan")
+
+    li = index.list_indices                       # (n_dev, L+1, cap)
+    live_per_shard = jnp.sum(li >= 0, axis=(1, 2))
+    dead_per_shard = jnp.sum(li <= -2, axis=(1, 2))
+    occupied = jnp.maximum(live_per_shard + dead_per_shard, 1)
+    frac = np.asarray(dead_per_shard / occupied)
+    eligible = [s for s in range(index.n_shards)
+                if frac[s] >= config.dead_fraction]
+    load = np.asarray(live_per_shard, np.int64)
+    skew = load.max() / max(load.mean(), 1.0)
+    if not eligible and skew <= config.overfull_factor:
+        if obs.enabled():
+            obs.registry().counter("rebalance.routed.noops").inc()
+        return index
+
+    centers, recon, rsq, gli, sizes = _dann._gather_global(index)
+
+    faults.maybe_fail("rebalance.compact")
+    if eligible:
+        order, live = _mutate.compaction_order(gli)
+        sel = jnp.asarray(
+            np.isin(np.asarray(index.owner), eligible))   # (n_lists,)
+        cap = gli.shape[1]
+        ident = jnp.broadcast_to(jnp.arange(cap, dtype=order.dtype),
+                                 gli.shape)
+        order = jnp.where(sel[:, None], order, ident)
+        drop = sel[:, None] & (jnp.arange(cap)[None, :] >= live[:, None])
+        gli = jnp.where(drop, -1, jnp.take_along_axis(gli, order, axis=1))
+        recon = jnp.where(
+            drop[:, :, None], 0,
+            jnp.take_along_axis(recon, order[:, :, None], axis=1))
+        rsq = jnp.where(drop, 0, jnp.take_along_axis(rsq, order, axis=1))
+        sizes = jnp.where(sel, live, sizes)
+
+    placement = _dann.compute_placement(
+        np.asarray(jnp.sum(gli >= 0, axis=1)), index.n_shards,
+        generation=index.placement.generation + 1)
+    cand = _dann._place_lists(handle, (centers, recon, rsq, gli, sizes),
+                              index.rotation, placement, index.metric,
+                              index.size)
+    cand.canaries = index.canaries
+    _mutate.next_generation(index, cand)          # the ONE global bump
+
+    faults.maybe_fail("rebalance.verify")
+    if cand.canaries is not None:
+        _dann.health_check(handle, cand, raise_on_fail=True)
+    faults.maybe_fail("rebalance.swap")
+    if server is not None:
+        ex = getattr(server, "executor", server)
+        if getattr(ex, "index", None) is not cand:
+            server.swap_index(cand)
+    if obs.enabled():
+        obs.registry().counter("rebalance.routed.passes").inc()
+        obs.registry().counter("rebalance.swaps").inc()
+    return cand

@@ -32,7 +32,7 @@ PACKAGES = {
 GUARDED = {
     "build", "search", "extend", "fit", "predict", "transform",
     "fit_predict", "knn", "knn_query", "all_knn_query", "build_index",
-    "eps_neighbors_l2sq", "refine", "submit",
+    "eps_neighbors_l2sq", "refine", "submit", "upsert",
 }
 VALIDATORS = {"check_matrix", "guard_nonfinite"}
 

@@ -12,6 +12,7 @@ from raft_tpu.cluster import KMeansParams, kmeans
 from raft_tpu.comms import CommsSession
 from raft_tpu.distributed import kmeans as dist_kmeans
 from raft_tpu.distributed import knn as dist_knn
+from raft_tpu.neighbors import ivf_pq
 from raft_tpu.random import make_blobs
 
 
@@ -247,3 +248,251 @@ class TestDistributedCagra:
         gt = np.asarray(gt)
         rec = sum(len(set(a) & set(b)) for a, b in zip(ii, gt)) / gt.size
         assert rec >= 0.7, rec
+
+
+class TestRoutedAnn:
+    """PR 8 tentpole: ``placement="by_list"`` index-parallel routing.
+
+    Contracts under test: full-probe routed search is EXACTLY the
+    single-index answer (hierarchical top-k over a disjoint list
+    partition); per-shard scan work is ~1/n_shards of the probed rows
+    (the acceptance tripwire); the candidate exchange is fixed at
+    (k, nq) pairs per shard; a failed shard drops only its owned lists;
+    the placement map and the whole routed index serialize round-trip.
+    """
+
+    N, DIM, NL, NQ, K = 2048, 32, 32, 16, 10
+
+    @pytest.fixture(scope="class")
+    def rhandle(self):
+        devs = jax.devices()
+        if len(devs) < 8:
+            devs = jax.devices("cpu")
+        if len(devs) < 8:
+            pytest.skip("needs 8 devices")
+        from raft_tpu.comms import CommsSession
+        mesh = jax.sharding.Mesh(np.asarray(devs[:8]), ("data",))
+        s = CommsSession(mesh=mesh, axis_name="data").init()
+        yield s.worker_handle(seed=0)
+        s.destroy()
+
+    @pytest.fixture(scope="class")
+    def data(self):
+        rng = np.random.default_rng(7)
+        db = rng.normal(size=(self.N, self.DIM)).astype(np.float32)
+        q = rng.normal(size=(self.NQ, self.DIM)).astype(np.float32)
+        return db, q
+
+    @pytest.fixture(scope="class")
+    def built(self, rhandle, data):
+        from raft_tpu.distributed import ann
+        db, _ = data
+        params = ivf_pq.IndexParams(n_lists=self.NL, pq_dim=8,
+                                    kmeans_n_iters=3,
+                                    cache_reconstructions=True)
+        base = ivf_pq.build(rhandle, params, db)
+        return base, ann.shard_by_list(rhandle, base)
+
+    @staticmethod
+    def _recall(found, truth):
+        hits = sum(len(set(f.tolist()) & set(t.tolist()))
+                   for f, t in zip(found, truth))
+        return hits / truth.size
+
+    def test_full_probe_matches_single_index_exactly(self, rhandle, data,
+                                                     built):
+        from raft_tpu.core.outputs import raw
+        from raft_tpu.distributed import ann
+        _, q = data
+        base, ridx = built
+        sp = ivf_pq.SearchParams(n_probes=self.NL, scan_mode="recon")
+        bd, bi = raw(ivf_pq.search)(rhandle, sp, base, q, self.K)
+        rd, ri = ann.search(rhandle, sp, ridx, q, self.K)
+        np.testing.assert_array_equal(np.asarray(ri), np.asarray(bi))
+        np.testing.assert_allclose(np.asarray(rd), np.asarray(bd),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_scan_work_and_gather_shape_tripwire(self, rhandle, data,
+                                                 built):
+        """Acceptance criterion: per-shard scanned candidates at the
+        operating point stay under (probed_rows / n_shards) * 1.5 and
+        the exchange is the fixed (n_shards, nq, k) pair block."""
+        from raft_tpu.distributed import ann
+        _, q = data
+        _, ridx = built
+        n_probes = 8
+        sp = ivf_pq.SearchParams(n_probes=n_probes)
+        _, _, stats = ann.search(rhandle, sp, ridx, q, self.K,
+                                 return_stats=True)
+        cap = ridx.capacity
+        probed_rows = self.NQ * n_probes * cap
+        bound = probed_rows / ridx.n_shards * 1.5
+        assert stats["gather_shape"] == (ridx.n_shards, self.NQ, self.K)
+        assert int(stats["scanned_rows"].sum()) <= probed_rows
+        assert int(stats["scanned_rows"].max()) <= bound, (
+            f"placement imbalance: {stats['scanned_rows']} vs {bound}")
+
+    def test_recall_parity_with_data_parallel(self, rhandle, data, built):
+        from raft_tpu.distributed import ann
+        from raft_tpu.neighbors import brute_force
+        from raft_tpu.core.outputs import raw
+        db, q = data
+        _, ridx = built
+        params = ivf_pq.IndexParams(n_lists=self.NL, pq_dim=8,
+                                    kmeans_n_iters=3,
+                                    cache_reconstructions=True)
+        dp = ann.build(rhandle, params, db)  # data-parallel replica
+        sp = ivf_pq.SearchParams(n_probes=8)
+        _, truth = raw(brute_force.knn)(rhandle, db, q, self.K)
+        _, ri = ann.search(rhandle, sp, ridx, q, self.K)
+        _, di = ann.search(rhandle, sp, dp, q, self.K)
+        r_routed = self._recall(np.asarray(ri), np.asarray(truth))
+        r_dp = self._recall(np.asarray(di), np.asarray(truth))
+        assert r_routed > r_dp - 0.1, (r_routed, r_dp)
+
+    def test_build_by_list_entry_point(self, rhandle, data):
+        from raft_tpu.distributed import ann
+        db, q = data
+        params = ivf_pq.IndexParams(n_lists=self.NL, pq_dim=8,
+                                    kmeans_n_iters=3,
+                                    cache_reconstructions=True)
+        idx = ann.build(rhandle, params, db, placement="by_list")
+        assert isinstance(idx, ann.RoutedIndex)
+        assert idx.n_shards == 8 and idx.n_lists == self.NL
+        d, i, status = ann.search(rhandle, ivf_pq.SearchParams(n_probes=8),
+                                  idx, q, self.K, return_status=True)
+        assert np.asarray(i).min() >= 0
+        np.testing.assert_array_equal(np.asarray(status),
+                                      np.full(8, ann.SHARD_OK, np.int8))
+
+    def test_placement_roundtrip(self, rhandle, built):
+        import io
+        from raft_tpu.distributed import ann
+        _, ridx = built
+        buf = io.BytesIO()
+        ann.placement_to_stream(rhandle, buf, ridx.placement)
+        buf.seek(0)
+        back = ann.placement_from_stream(rhandle, buf)
+        np.testing.assert_array_equal(back.owner, ridx.placement.owner)
+        np.testing.assert_array_equal(back.local_slot,
+                                      ridx.placement.local_slot)
+        assert back.n_shards == ridx.placement.n_shards
+        assert back.n_local == ridx.placement.n_local
+        assert back.generation == ridx.placement.generation
+
+    def test_routed_serialization_roundtrip(self, rhandle, data, built):
+        import io
+        from raft_tpu.distributed import ann
+        _, q = data
+        _, ridx = built
+        buf = io.BytesIO()
+        ann.serialize_routed(rhandle, buf, ridx)
+        buf.seek(0)
+        back = ann.deserialize_routed(rhandle, buf)
+        sp = ivf_pq.SearchParams(n_probes=self.NL)
+        d1, i1 = ann.search(rhandle, sp, ridx, q, self.K)
+        d2, i2 = ann.search(rhandle, sp, back, q, self.K)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2),
+                                   rtol=1e-6)
+        np.testing.assert_array_equal(back.placement.owner,
+                                      ridx.placement.owner)
+
+    def test_failed_shard_drops_only_owned_lists(self, rhandle, data,
+                                                 built):
+        from raft_tpu.core.outputs import raw
+        from raft_tpu.distributed import ann
+        from raft_tpu.neighbors import brute_force
+        db, q = data
+        base, ridx = built
+        dead = 3
+        sp = ivf_pq.SearchParams(n_probes=self.NL)
+        d, i, status = ann.search(rhandle, sp, ridx, q, self.K,
+                                  failed_shards=[dead],
+                                  return_status=True)
+        expect = np.full(8, ann.SHARD_OK, np.int8)
+        expect[dead] = ann.SHARD_FAILED
+        np.testing.assert_array_equal(np.asarray(status), expect)
+        # ids living in the dead shard's owned lists must not appear
+        li = np.asarray(base.list_indices)
+        owned = ridx.placement.shard_lists(dead)
+        lost = set(li[owned][li[owned] >= 0].ravel().tolist())
+        found = set(np.asarray(i).ravel().tolist()) - {-1}
+        assert not (found & lost)
+        # graceful degradation: recall drops by roughly the dead shard's
+        # owned share, not to a cliff
+        _, truth = raw(brute_force.knn)(rhandle, db, q, self.K)
+        rec = self._recall(np.asarray(i), np.asarray(truth))
+        assert rec > 0.5, rec
+
+    def test_scan_mode_fallback_reported_in_status(self, rhandle, data,
+                                                   built):
+        from raft_tpu.distributed import ann
+        _, q = data
+        _, ridx = built
+        sp = ivf_pq.SearchParams(n_probes=8, scan_mode="fused")
+        _, i, status = ann.search(rhandle, sp, ridx, q, self.K,
+                                  return_status=True)
+        np.testing.assert_array_equal(
+            np.asarray(status),
+            np.full(8, ann.SHARD_OK_FALLBACK, np.int8))
+        # fallback is a reporting change only: results still valid
+        assert np.asarray(i).min() >= 0
+
+    def test_rebalance_placement_preserves_results(self, rhandle, data,
+                                                   built):
+        from raft_tpu.distributed import ann
+        from raft_tpu.neighbors import mutate
+        _, q = data
+        _, ridx = built
+        sp = ivf_pq.SearchParams(n_probes=self.NL)
+        d1, i1 = ann.search(rhandle, sp, ridx, q, self.K)
+        reb = ann.rebalance_placement(rhandle, ridx)
+        assert reb.placement.generation == ridx.placement.generation + 1
+        assert mutate.generation(reb) == mutate.generation(ridx) + 1
+        d2, i2 = ann.search(rhandle, sp, reb, q, self.K)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_aot_export_merges_to_live_answer(self, rhandle, data, built):
+        from raft_tpu.core import aot
+        from raft_tpu.distributed import ann
+        from raft_tpu.matrix.select_k import select_k
+        from raft_tpu.neighbors import grouped
+        from raft_tpu.distance.types import DistanceType
+        _, q = data
+        _, ridx = built
+        n_probes = 8
+        sp = ivf_pq.SearchParams(n_probes=n_probes)
+        ld, li = ann.search(rhandle, sp, ridx, q, self.K)
+        outs = []
+        for s in range(ridx.n_shards):
+            buf = aot.export_ivf_pq_routed_search(
+                rhandle, ridx, s, n_probes, self.K, self.NQ)
+            fn = aot.load_search_fn(buf)
+            ds, is_ = fn(jnp.asarray(q))
+            outs.append((np.asarray(ds), np.asarray(is_)))
+        all_d = jnp.asarray(np.stack([o[0] for o in outs], 0))
+        all_i = jnp.asarray(np.stack([o[1] for o in outs], 0))
+        md, mi = grouped.finalize_topk(
+            all_d.transpose(1, 0, 2), all_i.transpose(1, 0, 2),
+            self.NQ, self.K,
+            ridx.metric != DistanceType.InnerProduct, False, select_k)
+        np.testing.assert_array_equal(np.asarray(mi), np.asarray(li))
+        np.testing.assert_allclose(np.asarray(md), np.asarray(ld),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_executor_swap_is_placement_barrier(self, rhandle, data,
+                                                built):
+        from raft_tpu.distributed import ann
+        from raft_tpu.serving.executor import DistributedExecutor
+        _, q = data
+        _, ridx = built
+        ex = DistributedExecutor(
+            rhandle, ridx, ks=(self.K,), max_batch=16,
+            search_params=ivf_pq.SearchParams(n_probes=8))
+        ex.warmup()
+        d1, i1 = ex.search_bucket(jnp.asarray(q), self.NQ, self.K)
+        reb = ann.rebalance_placement(rhandle, ridx)
+        ex.swap_index(reb)
+        d2, i2 = ex.search_bucket(jnp.asarray(q), self.NQ, self.K)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))

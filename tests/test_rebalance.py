@@ -295,3 +295,77 @@ class TestServingIntegration:
             assert swaps == 1
         finally:
             obs.disable()
+
+
+class TestRoutedRebalance:
+    """PR 8: per-shard compaction passes + the global placement
+    generation barrier over a ``placement="by_list"`` index."""
+
+    @pytest.fixture(scope="class")
+    def rhandle(self):
+        devs = jax.devices()
+        if len(devs) < 8:
+            devs = jax.devices("cpu")
+        if len(devs) < 8:
+            pytest.skip("needs 8 devices")
+        from raft_tpu.comms import CommsSession
+        mesh = jax.sharding.Mesh(np.asarray(devs[:8]), ("data",))
+        s = CommsSession(mesh=mesh, axis_name="data").init()
+        yield s.worker_handle(seed=0)
+        s.destroy()
+
+    @pytest.fixture(scope="class")
+    def routed(self, rhandle):
+        from raft_tpu.distributed import ann
+        rng = np.random.default_rng(31)
+        db = rng.normal(size=(2048, 32)).astype(np.float32)
+        q = rng.normal(size=(16, 32)).astype(np.float32)
+        params = ivf_pq.IndexParams(n_lists=32, pq_dim=8,
+                                    kmeans_n_iters=3,
+                                    cache_reconstructions=True)
+        base = ivf_pq.build(rhandle, params, db)
+        return ann.shard_by_list(rhandle, base), q
+
+    def test_noop_on_clean_index(self, rhandle, routed):
+        from raft_tpu.serving.rebalancer import rebalance_routed
+        idx, _ = routed
+        assert rebalance_routed(rhandle, idx) is idx
+
+    def test_compaction_pass_preserves_results(self, rhandle, routed):
+        from raft_tpu.distributed import ann
+        from raft_tpu.serving.rebalancer import rebalance_routed
+        idx, q = routed
+        deleted = ann.delete(rhandle, idx, list(range(0, 700)))
+        sp = ivf_pq.SearchParams(n_probes=32)
+        d1, i1 = ann.search(rhandle, sp, deleted, q, 10)
+        out = rebalance_routed(rhandle, deleted)
+        assert out is not deleted
+        assert mutate.generation(out) == mutate.generation(deleted) + 1
+        assert out.placement.generation == \
+            deleted.placement.generation + 1
+        d2, i2 = ann.search(rhandle, sp, out, q, 10)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        # tombstone debt actually repaired on the eligible shards
+        assert int(jnp.sum(out.list_indices <= -2)) < \
+            int(jnp.sum(deleted.list_indices <= -2))
+
+    def test_swap_publishes_through_server(self, rhandle, routed):
+        from raft_tpu.distributed import ann
+        from raft_tpu.serving.executor import DistributedExecutor
+        from raft_tpu.serving.rebalancer import rebalance_routed
+        idx, q = routed
+        deleted = ann.delete(rhandle, idx, list(range(0, 700)))
+        ex = DistributedExecutor(
+            rhandle, deleted, ks=(10,), max_batch=16,
+            search_params=ivf_pq.SearchParams(n_probes=8))
+        ex.warmup()
+        out = rebalance_routed(rhandle, deleted, server=ex)
+        assert ex.index is out
+        d, i = ex.search_bucket(jnp.asarray(q), q.shape[0], 10)
+        assert not (set(np.asarray(i).ravel().tolist())
+                    & set(range(0, 700)))
+
+    def test_rejects_data_parallel_index(self, rhandle):
+        from raft_tpu.serving.rebalancer import rebalance_routed
+        with pytest.raises(Exception):
+            rebalance_routed(rhandle, object())
