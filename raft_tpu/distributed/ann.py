@@ -260,7 +260,8 @@ def _stack_leaves(per_shard_leaves, mesh, axis, devs):
         shards = [jax.device_put(per_shard_leaves[s][li][None],
                                  devs[s]) for s in range(n_dev)]
         shape = (n_dev,) + per_shard_leaves[0][li].shape
-        sharding = jax.sharding.NamedSharding(
+        # graftlint: disable=recompile-hazard -- len() is the static
+        sharding = jax.sharding.NamedSharding(  # leaf rank at build time
             mesh, P(axis, *([None] * (len(shape) - 1))))
         placed.append(jax.make_array_from_single_device_arrays(
             shape, sharding, shards))
@@ -1303,23 +1304,28 @@ def _local_index(index, s):
     shard axis)."""
     from raft_tpu.neighbors import cagra, ivf_flat, ivf_pq
     if isinstance(index, DistributedIndex):
-        return ivf_pq.Index(
+        out = ivf_pq.Index(
             centers=index.centers[s], codebooks=index.codebooks[s],
             list_codes=index.list_codes[s],
             list_indices=index.list_indices[s],
             list_sizes=index.list_sizes[s], rotation=index.rotation[s],
             metric=index.metric, list_recon=index.list_recon[s])
-    if isinstance(index, DistributedFlatIndex):
-        return ivf_flat.Index(
+    elif isinstance(index, DistributedFlatIndex):
+        out = ivf_flat.Index(
             centers=index.centers[s], list_data=index.list_data[s],
             list_indices=index.list_indices[s],
             list_sizes=index.list_sizes[s], metric=index.metric)
-    if isinstance(index, DistributedCagraIndex):
-        return cagra.Index(dataset=index.dataset[s], graph=index.graph[s],
-                           metric=index.metric)
-    raise TypeError(
-        f"distributed.ann.health_check: unsupported index type "
-        f"{type(index).__name__}")
+    elif isinstance(index, DistributedCagraIndex):
+        out = cagra.Index(dataset=index.dataset[s], graph=index.graph[s],
+                          metric=index.metric)
+    else:
+        raise TypeError(
+            f"distributed.ann.health_check: unsupported index type "
+            f"{type(index).__name__}")
+    # the local view serves the parent's data snapshot: carry its
+    # generation so generation-keyed executable caches stay distinct
+    out.generation = _mutate.generation(index)
+    return out
 
 
 def health_check(handle, index, *, raise_on_fail: bool = True):

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.core.outputs import auto_convert_output
+from raft_tpu.neighbors import mutate as _mutate
 from raft_tpu.neighbors.ivf_pq import (
     Index,
     _decode_rows,
@@ -84,7 +85,8 @@ def pack_list_data(res, index: Index, label: int, codes, *,
             upd["list_recon_sq"] = index.list_recon_sq.at[
                 label, offset:offset + n_rows].set(
                     _recon_sq(recon[None])[0])
-    return dataclasses.replace(index, **upd)
+    return _mutate.next_generation(index,
+                                   dataclasses.replace(index, **upd))
 
 
 @auto_convert_output

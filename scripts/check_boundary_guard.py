@@ -1,99 +1,40 @@
 #!/usr/bin/env python
-"""CI guard: public entry points must run the boundary validator.
+"""CI guard shim: the boundary-validator lint now lives in graftlint.
 
-Every module-level public entry point in ``raft_tpu/neighbors`` and
-``raft_tpu/cluster`` that accepts user arrays (build / search / extend /
-fit / predict / ...) must route them through
-``raft_tpu.integrity.boundary`` (``check_matrix`` / ``guard_nonfinite``),
-either directly or by delegating to a same-module function that does.
-This keeps the PR 4 input-hardening contract from silently eroding as
-entry points are added.
+The real pass is ``scripts/graftlint/passes/boundary_guard.py`` (run it
+with ``python -m scripts.graftlint --rules boundary-guard``); this
+wrapper keeps the historical script entry point and its ``check_file``
+/ ``main`` API for callers that load it by path.
 
 Usage: python scripts/check_boundary_guard.py   (exits 1 on violations)
 """
 
 from __future__ import annotations
 
-import ast
 import pathlib
 import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
-# package -> scan mode: "functions" checks module-level entry points
-# only; "all" also checks methods of module-level classes (the serving
-# surface is class-shaped: Server.submit / Server.search)
-PACKAGES = {
-    "raft_tpu/neighbors": "functions",
-    "raft_tpu/cluster": "functions",
-    "raft_tpu/serving": "all",
-}
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))
 
-# entry-point names that take user arrays and must validate them
-GUARDED = {
-    "build", "search", "extend", "fit", "predict", "transform",
-    "fit_predict", "knn", "knn_query", "all_knn_query", "build_index",
-    "eps_neighbors_l2sq", "refine", "submit", "upsert",
-}
-VALIDATORS = {"check_matrix", "guard_nonfinite"}
+from scripts.graftlint import core as _core  # noqa: E402
+from scripts.graftlint.passes import boundary_guard as _pass  # noqa: E402
 
-
-def _calls_validator(fn: ast.FunctionDef) -> bool:
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Attribute) and node.attr in VALIDATORS:
-            return True
-        if isinstance(node, ast.Name) and node.id in VALIDATORS:
-            return True
-    return False
-
-
-def _local_callees(fn: ast.FunctionDef) -> set:
-    """Names a function may delegate to: direct calls, but also bare
-    references (``raw(fit)(...)`` wraps ``fit`` before calling it)."""
-    out = set()
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Name):
-            out.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            out.add(node.attr)
-    return out
+PACKAGES = {p.rstrip("/"): m for p, m in _pass.PACKAGES.items()}
+GUARDED = _pass.GUARDED
+VALIDATORS = _pass.VALIDATORS
 
 
 def check_file(path: pathlib.Path, mode: str = "functions") -> list:
-    tree = ast.parse(path.read_text(), filename=str(path))
-    fns = {n.name: n for n in tree.body if isinstance(n, ast.FunctionDef)}
-    if mode == "all":
-        # class methods keyed by bare name so delegation resolves
-        # (Server.search -> self.submit matches fns["submit"])
-        for cls in tree.body:
-            if isinstance(cls, ast.ClassDef):
-                for n in cls.body:
-                    if isinstance(n, ast.FunctionDef):
-                        fns.setdefault(n.name, n)
-
-    # fixed point: a function is "checked" if it calls a validator, or
-    # calls a same-module function that is checked (delegation)
-    checked = {name for name, fn in fns.items() if _calls_validator(fn)}
-    changed = True
-    while changed:
-        changed = False
-        for name, fn in fns.items():
-            if name in checked:
-                continue
-            if _local_callees(fn) & checked:
-                checked.add(name)
-                changed = True
-
+    path = pathlib.Path(path)
     try:
-        path = path.relative_to(ROOT)
+        rel = str(path.relative_to(ROOT))
     except ValueError:
-        pass
-    return [
-        f"{path}:{fn.lineno}: public entry point "
-        f"'{name}' never reaches the boundary validator "
-        f"(raft_tpu.integrity.boundary.check_matrix)"
-        for name, fn in sorted(fns.items())
-        if name in GUARDED and name not in checked
-    ]
+        rel = str(path)
+    mod = _core.Module(rel, path.read_text())
+    return [str(d) for d in _pass.check_module(mod, mode)
+            if not mod.suppressed(d.line, d.rule)]
 
 
 def main() -> int:

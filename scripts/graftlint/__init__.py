@@ -1,0 +1,37 @@
+"""graftlint — project-wide static analysis for raft_tpu's conventions.
+
+Eight PRs of growth made correctness rest on cross-cutting disciplines
+that no single module can see violated: every mutation bumps a
+generation, every ``ExecutableCache`` key carries it, every scan path
+rides the ``id < 0`` padded-row/tombstone mask, no traced-shape-
+dependent Python reaches the serving hot path, and every metric /
+fault-site name asserted anywhere actually ticks somewhere.  The
+reference (RAFT) bakes such invariants into the C++ type system; the
+Python/JAX equivalent is this AST-based pass framework.
+
+Usage::
+
+    python -m scripts.graftlint            # human file:line:rule output
+    python -m scripts.graftlint --json     # machine report + registry
+
+Suppress a finding on one line with a reason::
+
+    x = ids == -1  # graftlint: disable=mask-seam -- post-clamp public ids
+
+See docs/api.md, "Static analysis" for the rule catalogue and how to
+add a pass.
+"""
+
+from scripts.graftlint.core import (  # noqa: F401
+    Diagnostic,
+    Module,
+    Project,
+    all_passes,
+    load_project,
+    register,
+    run_passes,
+)
+from scripts.graftlint.registry import build_registry  # noqa: F401
+
+# importing the package registers every bundled pass
+from scripts.graftlint import passes  # noqa: F401  (side-effect import)

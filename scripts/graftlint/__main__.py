@@ -1,0 +1,84 @@
+"""CLI: ``python -m scripts.graftlint [--json] [--rules a,b] [--root D]``.
+
+Exit status 0 when the tree is clean, 1 when any diagnostic fires
+(suppressed findings do not fail the run).  ``--json`` emits a machine
+report including the generated metric/stage/fault-site registry, so CI
+artifacts and dashboards can diff the available metric surface across
+versions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+
+from scripts.graftlint import core, registry
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m scripts.graftlint",
+        description="raft_tpu invariant lint (see docs/api.md, "
+                    "'Static analysis')")
+    parser.add_argument("--json", action="store_true",
+                        help="emit a JSON report (diagnostics + "
+                             "generated registry) to stdout")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule subset to run")
+    parser.add_argument("--root", default=None, type=pathlib.Path,
+                        help="repository root (default: autodetected)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalogue and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, doc in sorted(core.rule_docs().items()):
+            print(f"{rule}: {doc}")
+        return 0
+
+    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    if rules:
+        unknown = set(rules) - set(core.rule_docs())
+        if unknown:
+            print(f"graftlint: unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    project = core.load_project(root=args.root)
+    diags, suppressed = core.run_passes(project, rules=rules)
+    reg = registry.build_registry(project)
+
+    if args.json:
+        print(json.dumps({
+            "version": 1,
+            "rules": core.rule_docs(),
+            "diagnostics": [d.as_dict() for d in diags],
+            "suppressed": suppressed,
+            "registry": reg.as_dict(),
+        }, indent=2, sort_keys=True))
+    else:
+        for d in diags:
+            print(d)
+    if diags:
+        n = len(diags)
+        print(f"\ngraftlint: {n} violation(s)"
+              + (f" ({suppressed} suppressed)" if suppressed else "")
+              + " — see docs/api.md 'Static analysis' for each rule's "
+                "invariant and how to suppress with a reason",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # stdout piped into a pager/head that exited early — not an
+        # analysis failure; silence the shutdown flush and exit clean
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

@@ -1,0 +1,243 @@
+"""graftlint core: parsed-module model, pass registry, suppression, runner.
+
+A *pass* is a class with a ``name``, the ``rules`` it may emit, a
+``doc`` line per rule, and ``run(project) -> [Diagnostic]``.  Passes
+register themselves via :func:`register` at import time (see
+``passes/__init__.py``); the CLI and the test harness both drive them
+through :func:`run_passes`.
+
+Suppressions are per-line comments::
+
+    something_flagged()  # graftlint: disable=rule-name -- why it is ok
+
+``disable`` with no ``=rule`` list suppresses every rule on that line.
+A comment-only suppression line also covers the line directly below it
+(for expressions too long to share a line with their justification).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+import re
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent.parent
+
+# directories the default project scan covers, relative to the root.
+# raft_tpu is the analysis subject; tests ride along for the
+# registry-consistency reference side (a typo'd counter asserted in a
+# test reads 0 forever and the test "passes" vacuously).
+DEFAULT_SCAN = ("raft_tpu", "tests")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable(?:=([A-Za-z0-9_,\-]+))?")
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Diagnostic:
+    """One finding: ``file:line: rule: message``."""
+
+    path: str          # repo-relative, forward slashes
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}"
+
+    def as_dict(self) -> Dict[str, object]:
+        return {"file": self.path, "line": self.line, "rule": self.rule,
+                "message": self.message}
+
+
+class Module:
+    """One parsed source file plus its suppression table."""
+
+    def __init__(self, rel: str, source: str) -> None:
+        self.rel = rel.replace("\\", "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=self.rel)
+        self.suppressions: Dict[int, set] = {}
+        for i, text in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = (set(r.strip() for r in m.group(1).split(","))
+                     if m.group(1) else {"*"})
+            self.suppressions.setdefault(i, set()).update(rules)
+            # a comment-only suppression line covers the next line too
+            if text.strip().startswith("#"):
+                self.suppressions.setdefault(i + 1, set()).update(rules)
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        rules = self.suppressions.get(line)
+        return bool(rules) and (rule in rules or "*" in rules)
+
+    def in_dir(self, *prefixes: str) -> bool:
+        return any(self.rel.startswith(p) for p in prefixes)
+
+
+class Project:
+    """The set of modules a lint run sees."""
+
+    def __init__(self, modules: Iterable[Module],
+                 root: Optional[pathlib.Path] = None) -> None:
+        self.root = root or REPO_ROOT
+        self.modules: List[Module] = list(modules)
+        self.by_rel: Dict[str, Module] = {m.rel: m for m in self.modules}
+        self.errors: List[Diagnostic] = []
+
+    @classmethod
+    def from_sources(cls, sources: Dict[str, str]) -> "Project":
+        """Build an in-memory project from ``{relpath: source}`` — the
+        fixture entry point the graftlint tests use."""
+        return cls(Module(rel, src) for rel, src in sources.items())
+
+    def walk(self, *prefixes: str) -> Iterator[Module]:
+        for m in self.modules:
+            if not prefixes or m.in_dir(*prefixes):
+                yield m
+
+
+def load_project(root: Optional[pathlib.Path] = None,
+                 scan: Tuple[str, ...] = DEFAULT_SCAN) -> Project:
+    """Parse every ``*.py`` under the scan roots into a Project.
+
+    Unparseable files become synthetic ``parse-error`` diagnostics
+    rather than aborting the run — a syntax error in one file must not
+    hide findings in the rest of the tree."""
+    root = root or REPO_ROOT
+    modules, errors = [], []
+    for top in scan:
+        base = root / top
+        if base.is_file():
+            paths = [base]
+        else:
+            paths = sorted(base.rglob("*.py"))
+        for path in paths:
+            if "__pycache__" in path.parts:
+                continue
+            rel = path.relative_to(root).as_posix()
+            try:
+                modules.append(Module(rel, path.read_text()))
+            except SyntaxError as e:
+                errors.append(Diagnostic(rel, e.lineno or 1, "parse-error",
+                                         f"could not parse: {e.msg}"))
+    project = Project(modules, root=root)
+    project.errors = errors
+    return project
+
+
+# ---------------------------------------------------------------------------
+# pass registry
+
+_PASSES: Dict[str, type] = {}
+
+
+def register(cls: type) -> type:
+    """Class decorator: add a pass to the global registry."""
+    _PASSES[cls.name] = cls
+    return cls
+
+
+def all_passes() -> Dict[str, type]:
+    return dict(_PASSES)
+
+
+def rule_docs() -> Dict[str, str]:
+    """``{rule: one-line invariant}`` across every registered pass."""
+    out: Dict[str, str] = {}
+    for cls in _PASSES.values():
+        out.update(cls.docs)
+    return out
+
+
+def run_passes(project: Project,
+               rules: Optional[Iterable[str]] = None,
+               ) -> Tuple[List[Diagnostic], int]:
+    """Run every registered pass (optionally filtered to ``rules``) over
+    the project.  Returns ``(diagnostics, n_suppressed)`` with
+    diagnostics sorted by (file, line, rule) and suppressed findings
+    dropped (but counted)."""
+    wanted = set(rules) if rules is not None else None
+    diags: List[Diagnostic] = list(project.errors)
+    suppressed = 0
+    for cls in _PASSES.values():
+        if wanted is not None and not (wanted & set(cls.docs)):
+            continue
+        for d in cls().run(project):
+            if wanted is not None and d.rule not in wanted:
+                continue
+            mod = project.by_rel.get(d.path)
+            if mod is not None and mod.suppressed(d.line, d.rule):
+                suppressed += 1
+                continue
+            diags.append(d)
+    return sorted(diags), suppressed
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers (used by several passes)
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def terminal_name(node: ast.AST) -> Optional[str]:
+    """The last identifier of a call target: ``x.y.f`` -> ``f``."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def contains(node: ast.AST, predicate: Callable[[ast.AST], bool]) -> bool:
+    return any(predicate(n) for n in ast.walk(node))
+
+
+def walk_functions(tree: ast.AST
+                   ) -> Iterator[Tuple[ast.AST, Tuple[ast.AST, ...]]]:
+    """Yield ``(function_def, enclosing_stack)`` for every (async)
+    function at any nesting depth; the stack is outermost-first and
+    excludes the function itself."""
+    def visit(node: ast.AST, stack: Tuple[ast.AST, ...]) -> Iterator:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, stack
+                yield from visit(child, stack + (child,))
+            else:
+                yield from visit(child, stack)
+    yield from visit(tree, ())
+
+
+def import_aliases(tree: ast.AST) -> Dict[str, str]:
+    """Map local names to the fully-qualified thing they import:
+    ``import time as t`` -> ``{"t": "time"}``; ``from time import
+    sleep`` -> ``{"sleep": "time.sleep"}``."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out

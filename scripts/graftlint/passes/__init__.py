@@ -1,0 +1,10 @@
+"""Bundled graftlint passes.  Importing this package registers them."""
+
+from scripts.graftlint.passes import (  # noqa: F401
+    boundary_guard,
+    generation_discipline,
+    mask_seam,
+    recompile_hazard,
+    registry_consistency,
+    timing_discipline,
+)

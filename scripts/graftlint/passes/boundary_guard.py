@@ -1,0 +1,110 @@
+"""boundary-guard: public entry points must reach the boundary validator.
+
+The PR 4 input-hardening contract: every module-level public entry
+point in ``raft_tpu/neighbors`` and ``raft_tpu/cluster`` (plus class
+methods on the class-shaped serving surface) that accepts user arrays
+must route them through ``raft_tpu.integrity.boundary``
+(``check_matrix`` / ``guard_nonfinite``) — directly, or by delegating
+to a same-module function that does.  PR 4's standalone AST script
+found 3 real unguarded entry points at introduction; this is that
+lint, rehosted as a graftlint pass (``scripts/check_boundary_guard.py``
+remains as a thin shim for back-compat).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from scripts.graftlint.core import Diagnostic, Module, Project, register
+
+# package prefix -> scan mode: "functions" checks module-level entry
+# points only; "all" also checks methods of module-level classes (the
+# serving surface is class-shaped: Server.submit / Server.search)
+PACKAGES = {
+    "raft_tpu/neighbors/": "functions",
+    "raft_tpu/cluster/": "functions",
+    "raft_tpu/serving/": "all",
+}
+
+# entry-point names that take user arrays and must validate them
+GUARDED = {
+    "build", "search", "extend", "fit", "predict", "transform",
+    "fit_predict", "knn", "knn_query", "all_knn_query", "build_index",
+    "eps_neighbors_l2sq", "refine", "submit", "upsert",
+}
+VALIDATORS = {"check_matrix", "guard_nonfinite"}
+
+
+def _calls_validator(fn: ast.FunctionDef) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and node.attr in VALIDATORS:
+            return True
+        if isinstance(node, ast.Name) and node.id in VALIDATORS:
+            return True
+    return False
+
+
+def _local_callees(fn: ast.FunctionDef) -> set:
+    """Names a function may delegate to: direct calls, but also bare
+    references (``raw(fit)(...)`` wraps ``fit`` before calling it)."""
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            out.add(node.attr)
+    return out
+
+
+def check_module(mod: Module, mode: str = "functions") -> List[Diagnostic]:
+    tree = mod.tree
+    fns: Dict[str, ast.FunctionDef] = {
+        n.name: n for n in tree.body if isinstance(n, ast.FunctionDef)}
+    if mode == "all":
+        # class methods keyed by bare name so delegation resolves
+        # (Server.search -> self.submit matches fns["submit"])
+        for cls in tree.body:
+            if isinstance(cls, ast.ClassDef):
+                for n in cls.body:
+                    if isinstance(n, ast.FunctionDef):
+                        fns.setdefault(n.name, n)
+
+    # fixed point: a function is "checked" if it calls a validator, or
+    # calls a same-module function that is checked (delegation)
+    checked = {name for name, fn in fns.items() if _calls_validator(fn)}
+    changed = True
+    while changed:
+        changed = False
+        for name, fn in fns.items():
+            if name in checked:
+                continue
+            if _local_callees(fn) & checked:
+                checked.add(name)
+                changed = True
+
+    return [
+        Diagnostic(mod.rel, fn.lineno, "boundary-guard",
+                   f"public entry point '{name}' never reaches the "
+                   f"boundary validator "
+                   f"(raft_tpu.integrity.boundary.check_matrix)")
+        for name, fn in sorted(fns.items())
+        if name in GUARDED and name not in checked
+    ]
+
+
+@register
+class BoundaryGuardPass:
+    name = "boundary-guard"
+    docs = {
+        "boundary-guard":
+            "public build/search/extend/... entry points must route "
+            "user arrays through integrity.boundary validators",
+    }
+
+    def run(self, project: Project) -> List[Diagnostic]:
+        out: List[Diagnostic] = []
+        for prefix, mode in PACKAGES.items():
+            for mod in project.walk(prefix):
+                out.extend(check_module(mod, mode))
+        return out

@@ -1,0 +1,151 @@
+"""generation-discipline: mutations bump, caches key, nobody forgets.
+
+The mutation layer's snapshot model (PR 7) hangs on one host-side
+integer: every ``delete`` / ``extend`` / ``compact`` / ``upsert``
+returns a NEW index object stamped ``parent.generation + 1``
+(``neighbors/mutate.next_generation``), and the serving tier's
+``ExecutableCache`` keys every warmed executable on that counter (plus
+the placement generation for routed indexes).  Forget either side and
+the failure is silent: a forgotten bump lets a recycled ``id()`` serve
+a *stale executable* for a mutated index; a key-site without the
+generation re-introduces the bucket-collision bug the weakref guard
+was built to kill.
+
+Two rules:
+
+- ``generation-discipline``: a function under ``raft_tpu/neighbors/``,
+  ``raft_tpu/serving/`` or ``raft_tpu/distributed/`` that takes an
+  existing index (parameter named ``index`` / ``parent``) and
+  constructs a new one (a ``*Index(...)`` constructor or
+  ``dataclasses.replace``) must bump or propagate the generation:
+  call ``next_generation``, assign ``.generation``, or read
+  ``mutate.generation(...)``.
+- ``generation-discipline``: inside any class with ``Cache`` in its
+  name, an assignment to a variable named ``key`` must mention the
+  generation (a ``generation`` name/attribute or a ``"generation"``
+  string, e.g. via ``getattr``) — every executable-cache key carries
+  the generation, and routed paths additionally carry the placement
+  generation.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from scripts.graftlint.core import (
+    Diagnostic,
+    Project,
+    register,
+    terminal_name,
+    walk_functions,
+)
+
+_SCOPE = ("raft_tpu/neighbors/", "raft_tpu/serving/",
+          "raft_tpu/distributed/")
+_PARENT_PARAMS = {"index", "parent"}
+
+
+def _constructs_index(fn: ast.AST):
+    """First Call node in ``fn`` that builds an index-like object."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = terminal_name(node.func)
+        if callee is None:
+            continue
+        if callee == "Index" or callee.endswith("Index"):
+            return node
+        if callee == "replace" and node.args:
+            first = node.args[0]
+            if isinstance(first, ast.Name) and first.id in _PARENT_PARAMS:
+                return node
+    return None
+
+
+def _handles_generation(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            callee = terminal_name(node.func)
+            if callee in ("next_generation", "generation"):
+                return True
+        # out.generation = ... (direct stamp, e.g. deserializers)
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Attribute) and t.attr == "generation":
+                    return True
+    return False
+
+
+def _params(fn: ast.AST) -> set:
+    a = fn.args
+    return {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+
+
+def _mentions_generation(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and "generation" in n.id:
+            return True
+        if isinstance(n, ast.Attribute) and "generation" in n.attr:
+            return True
+        if (isinstance(n, ast.Constant) and isinstance(n.value, str)
+                and "generation" in n.value):
+            return True
+    return False
+
+
+@register
+class GenerationDisciplinePass:
+    name = "generation-discipline"
+    docs = {
+        "generation-discipline":
+            "index-from-index constructors must bump/propagate the "
+            "generation; executable-cache keys must include it",
+    }
+
+    def run(self, project: Project) -> List[Diagnostic]:
+        out: List[Diagnostic] = []
+        for mod in project.walk(*_SCOPE):
+            for fn, stack in walk_functions(mod.tree):
+                if not (_params(fn) & _PARENT_PARAMS):
+                    continue
+                # only the outermost such function is accountable —
+                # nested helpers inherit the parent's bump
+                if any(_params(f) & _PARENT_PARAMS for f in stack):
+                    continue
+                ctor = _constructs_index(fn)
+                if ctor is None:
+                    continue
+                if _handles_generation(fn):
+                    continue
+                out.append(Diagnostic(
+                    mod.rel, ctor.lineno, "generation-discipline",
+                    f"'{fn.name}' builds a new index from an existing "
+                    f"one without bumping/propagating the generation "
+                    f"(call mutate.next_generation or assign "
+                    f".generation) — stale warmed executables otherwise"))
+        # cache-key rule: core/aot.py plus any serving-layer cache
+        for mod in project.walk("raft_tpu/"):
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                if "Cache" not in node.name:
+                    continue
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Assign):
+                        continue
+                    named_key = any(
+                        isinstance(t, ast.Name) and t.id == "key"
+                        for t in sub.targets)
+                    if not named_key:
+                        continue
+                    if _mentions_generation(sub.value):
+                        continue
+                    out.append(Diagnostic(
+                        mod.rel, sub.lineno, "generation-discipline",
+                        f"cache key in {node.name} does not include the "
+                        f"index generation — a recycled id() can pair a "
+                        f"stale executable with a newer generation"))
+        return out

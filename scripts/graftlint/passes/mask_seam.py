@@ -1,0 +1,144 @@
+"""mask-seam: the ``id < 0`` tombstone/padding mask must never be skipped.
+
+Every scan formulation encodes three row states in one id array
+(``neighbors/mutate``): ``>= 0`` live, ``-1`` never-filled padding,
+``<= -2`` tombstoned (encoded ``-(id + 2)``).  Library code that tests
+``ids == -1`` sees padding but *misses tombstones* — a delete-aware
+path silently resurrects deleted rows.  The only comparisons that
+respect the seam are sign tests (``< 0`` / ``>= 0``); the only place an
+exact ``-1`` is legitimate is AFTER ``grouped.finalize_topk`` clamps
+encoded ids to the public sentinel (suppress with a reason there).
+
+The second seam is numeric: the fused kernels' one-hot accumulator
+merges (PR 6) multiply masks into distance values — IEEE says
+``0 * inf = NaN``, so sentinel distances inside ``ops/*_pallas.py``
+must be the finite ``3.0e38`` (``_ACC_WORST``) wherever they can meet
+a product.  An ``inf`` flowing into ``*`` / ``@`` / ``dot`` poisons
+whole accumulator rows.
+
+Rules:
+
+- ``mask-seam``: ``== -1`` / ``!= -1`` comparisons against id-ish
+  expressions (names containing ``ids`` / ``indices``, the scan id
+  buffers ``outi`` / ``alli`` / ``best_i``, ``neighbors``) anywhere
+  under ``raft_tpu/``.
+- ``mask-seam``: a multiplication / matmul / ``dot`` in
+  ``raft_tpu/ops/*_pallas.py`` with an ``inf`` literal anywhere in its
+  operands.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from scripts.graftlint.core import (
+    Diagnostic,
+    Project,
+    contains,
+    register,
+    terminal_name,
+)
+
+_ID_EXACT = {"outi", "alli", "best_i", "neighbors", "ti", "gi"}
+_DOT_CALLS = {"dot", "dot_general", "matmul", "einsum"}
+
+
+def _idish(name: str) -> bool:
+    n = name.lower()
+    return ("indices" in n or n in _ID_EXACT or n == "ids"
+            or n.endswith("_ids") or n.startswith("ids_"))
+
+
+def _idish_expr(node: ast.AST) -> Optional[str]:
+    """The id-ish identifier an expression reads, if any (follows
+    attribute/subscript bases: ``index.list_indices[0] == -1``)."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and _idish(node.attr):
+        return node.attr
+    if isinstance(node, ast.Name) and _idish(node.id):
+        return node.id
+    return None
+
+
+def _is_minus_one(node: ast.AST) -> bool:
+    return (isinstance(node, ast.UnaryOp)
+            and isinstance(node.op, ast.USub)
+            and isinstance(node.operand, ast.Constant)
+            and node.operand.value == 1)
+
+
+def _is_inf(node: ast.AST) -> bool:
+    if isinstance(node, ast.Attribute) and node.attr == "inf":
+        return True
+    if isinstance(node, ast.Name) and node.id == "inf":
+        return True
+    if isinstance(node, ast.Constant) and isinstance(node.value, float):
+        return node.value != node.value or abs(node.value) == float("inf")
+    if (isinstance(node, ast.Call) and terminal_name(node.func) == "float"
+            and node.args and isinstance(node.args[0], ast.Constant)
+            and str(node.args[0].value).lower() in ("inf", "-inf",
+                                                    "infinity")):
+        return True
+    return False
+
+
+@register
+class MaskSeamPass:
+    name = "mask-seam"
+    docs = {
+        "mask-seam":
+            "id arrays are masked with sign tests (tombstones are <= -2,"
+            " not -1); Pallas one-hot merges need finite sentinels, "
+            "never inf in a product",
+    }
+
+    def run(self, project: Project) -> List[Diagnostic]:
+        out: List[Diagnostic] = []
+        for mod in project.walk("raft_tpu/"):
+            pallas = (mod.rel.startswith("raft_tpu/ops/")
+                      and mod.rel.endswith("_pallas.py"))
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Compare):
+                    self._check_compare(mod, node, out)
+                if pallas:
+                    if (isinstance(node, ast.BinOp)
+                            and isinstance(node.op, (ast.Mult,
+                                                     ast.MatMult))
+                            and (contains(node.left, _is_inf)
+                                 or contains(node.right, _is_inf))):
+                        out.append(Diagnostic(
+                            mod.rel, node.lineno, "mask-seam",
+                            "inf literal flows into a product — IEEE "
+                            "0*inf=NaN poisons the one-hot merge; use "
+                            "the finite 3.0e38 sentinel (_ACC_WORST)"))
+                    elif (isinstance(node, ast.Call)
+                          and terminal_name(node.func) in _DOT_CALLS
+                          and any(contains(a, _is_inf)
+                                  for a in node.args)):
+                        out.append(Diagnostic(
+                            mod.rel, node.lineno, "mask-seam",
+                            "inf literal flows into a dot/matmul — IEEE "
+                            "0*inf=NaN poisons the one-hot merge; use "
+                            "the finite 3.0e38 sentinel (_ACC_WORST)"))
+        return out
+
+    def _check_compare(self, mod, node: ast.Compare,
+                       out: List[Diagnostic]) -> None:
+        sides = [node.left] + list(node.comparators)
+        ops_ok = all(isinstance(op, (ast.Eq, ast.NotEq)) for op in node.ops)
+        if not ops_ok:
+            return
+        has_minus_one = any(_is_minus_one(s) for s in sides)
+        if not has_minus_one:
+            return
+        for s in sides:
+            name = _idish_expr(s)
+            if name is not None:
+                out.append(Diagnostic(
+                    mod.rel, node.lineno, "mask-seam",
+                    f"'{name} == -1' misses tombstones (encoded <= -2) "
+                    f"— mask with a sign test (< 0 / >= 0) or clamp "
+                    f"through grouped.finalize_topk first"))
+                return

@@ -1,0 +1,101 @@
+"""recompile-hazard: keep unbounded request-time shapes off the device.
+
+The serving tier's steady-state contract (PR 5, re-asserted per round
+by the serving-smoke CI job) is ZERO XLA recompiles after warmup: every
+device program is compiled once per pre-warmed (bucket, k) shape, and
+batch assembly / result slicing happen in host numpy.  The way that
+contract erodes is one innocent line: a ``jnp`` call whose shape
+derives from a request-time Python value — ``jnp.zeros((len(requests),
+dim))`` compiles a fresh executable for every distinct batch size the
+queue happens to cut.
+
+Two rules, scoped to ``raft_tpu/serving/`` and ``raft_tpu/distributed/``
+(the layers that sit on the request path):
+
+- ``recompile-hazard``: a ``jnp.*`` / ``jax.*`` call with a ``len(...)``
+  anywhere in its arguments.  Host-side sizing belongs in numpy; device
+  shapes must come from the pre-warmed bucket constants
+  (``serving.buckets``) or from index geometry fixed at build time.
+- ``recompile-hazard``: a ``jax.jit`` (or bare ``jit``) call created
+  inside a serving hot-path function (``search`` / ``search_bucket`` /
+  ``submit`` / ``_dispatch`` / ``_run`` / ``offer`` / ``cut_batch``).
+  Wrapping per request defeats the warmed-executable table; jits belong
+  at module scope or in warmup/builder paths.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from scripts.graftlint.core import (
+    Diagnostic,
+    Project,
+    contains,
+    dotted_name,
+    register,
+)
+
+_SCOPE = ("raft_tpu/serving/", "raft_tpu/distributed/")
+_DEVICE_ROOTS = ("jnp", "jax")
+_HOT_FNS = {"search", "search_bucket", "submit", "_dispatch", "_run",
+            "offer", "cut_batch"}
+
+
+def _is_len_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "len")
+
+
+@register
+class RecompileHazardPass:
+    name = "recompile-hazard"
+    docs = {
+        "recompile-hazard":
+            "serving/distributed device calls must not key shapes on "
+            "request-time Python sizes (len(), per-request jit)",
+    }
+
+    def run(self, project: Project) -> List[Diagnostic]:
+        out: List[Diagnostic] = []
+        for mod in project.walk(*_SCOPE):
+            # stack of enclosing function names, for the hot-path rule
+            def visit(node: ast.AST, fn_stack: tuple) -> None:
+                for child in ast.iter_child_nodes(node):
+                    stack = fn_stack
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        stack = fn_stack + (child.name,)
+                    if isinstance(child, ast.Call):
+                        self._check_call(mod, child, stack, out)
+                    visit(child, stack)
+
+            visit(mod.tree, ())
+        return out
+
+    def _check_call(self, mod, call: ast.Call, fn_stack: tuple,
+                    out: List[Diagnostic]) -> None:
+        target = dotted_name(call.func)
+        if target is None:
+            return
+        root = target.split(".")[0]
+        if root not in _DEVICE_ROOTS:
+            return
+        if target in ("jax.jit", "jit"):
+            if fn_stack and (set(fn_stack) & _HOT_FNS):
+                out.append(Diagnostic(
+                    mod.rel, call.lineno, "recompile-hazard",
+                    f"jit created inside hot-path function "
+                    f"'{fn_stack[-1]}' — compile per request; hoist to "
+                    f"module scope or the warmup path"))
+            return
+        for arg in list(call.args) + [k.value for k in call.keywords]:
+            if contains(arg, _is_len_call):
+                out.append(Diagnostic(
+                    mod.rel, call.lineno, "recompile-hazard",
+                    f"device call {target}(...) takes a len()-derived "
+                    f"argument — request-time sizes retrace per novel "
+                    f"shape; assemble host-side (numpy) and dispatch at "
+                    f"a pre-warmed bucket shape"))
+                return

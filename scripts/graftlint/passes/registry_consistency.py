@@ -1,0 +1,115 @@
+"""registry-consistency: every asserted metric / fault-site name ticks.
+
+The bug class: a test asserts ``snap["counters"]["serving.admited"]
+== 3`` (typo), ``.get(...)`` quietly returns 0, the assertion is
+rewritten to ``>= 0`` in a hurry, and the counter is dead forever.
+Same shape for fault sites: ``plan.at("rebalance.swop")`` scripts a
+failure no ``maybe_fail`` will ever fire, and the resilience test
+passes vacuously.
+
+This pass checks every *reference* against the registry generated from
+the library AST (:mod:`scripts.graftlint.registry`).  References are
+collected from anchored contexts only — arbitrary dotted strings are
+not guessed at:
+
+- ``.counter("…") / .gauge("…") / .timer("…") / .histogram("…")`` calls
+  (in ``tests/`` these are reads of names the library must define);
+- ``snapshot()["counters"]["…"]`` subscripts, ``["…"] .get(…)`` calls
+  and ``"…" in snap["timers"]`` membership tests;
+- ``plan.at("…")`` / ``inject("…")`` fault-site scripting calls.
+
+A name is only policed when its first dotted segment is a namespace
+root the registry knows (``serving.``, ``integrity.``, ``comms.``, …)
+— synthetic unit-test names (``"c"``, ``"site.a"``) fall outside the
+roots and are skipped.  Dynamic library names (``f"comms.{op}.calls"``)
+resolve by prefix.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from scripts.graftlint.core import (
+    Diagnostic,
+    Project,
+    register,
+    str_const,
+    terminal_name,
+)
+from scripts.graftlint.registry import build_registry
+
+_METRIC_CALLS = {"counter", "gauge", "timer", "histogram"}
+_SNAPSHOT_KINDS = {"counters", "gauges", "timers", "histograms"}
+_SITE_CALLS = {"at", "inject", "maybe_fail"}
+
+
+def _snapshot_kind(node: ast.AST) -> Optional[str]:
+    """``"counters"`` for an expression like ``snap["counters"]``."""
+    if isinstance(node, ast.Subscript):
+        kind = str_const(node.slice)
+        if kind in _SNAPSHOT_KINDS:
+            return kind
+    return None
+
+
+@register
+class RegistryConsistencyPass:
+    name = "registry-consistency"
+    docs = {
+        "registry-consistency":
+            "metric / stage / fault-site names referenced in raft_tpu/ "
+            "or asserted in tests/ must resolve against the generated "
+            "registry (typo'd counters never tick)",
+    }
+
+    def run(self, project: Project) -> List[Diagnostic]:
+        reg = build_registry(project)
+        roots = reg.roots()
+        out: List[Diagnostic] = []
+        for mod in project.walk("raft_tpu/", "tests/"):
+            for name, line, is_site in self._references(mod):
+                if "." not in name or name.split(".")[0] not in roots:
+                    continue
+                if is_site:
+                    if not reg.resolves_site(name):
+                        out.append(Diagnostic(
+                            mod.rel, line, "registry-consistency",
+                            f"fault site '{name}' matches no "
+                            f"maybe_fail() site in raft_tpu/ — the "
+                            f"scripted failure can never fire"))
+                elif not reg.resolves_metric(name):
+                    out.append(Diagnostic(
+                        mod.rel, line, "registry-consistency",
+                        f"metric '{name}' is never recorded by "
+                        f"raft_tpu/ — a typo'd name reads 0 forever"))
+        return out
+
+    def _references(self, mod) -> List[Tuple[str, int, bool]]:
+        refs: List[Tuple[str, int, bool]] = []
+
+        def add(name: Optional[str], line: int, is_site: bool) -> None:
+            if name:
+                refs.append((name, line, is_site))
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                callee = terminal_name(node.func)
+                if callee in _METRIC_CALLS and node.args:
+                    add(str_const(node.args[0]), node.lineno, False)
+                elif callee in _SITE_CALLS and node.args:
+                    add(str_const(node.args[0]), node.lineno, True)
+                elif (callee == "get" and node.args
+                      and isinstance(node.func, ast.Attribute)
+                      and _snapshot_kind(node.func.value)):
+                    add(str_const(node.args[0]), node.lineno, False)
+            elif isinstance(node, ast.Subscript):
+                if _snapshot_kind(node.value):
+                    add(str_const(node.slice), node.lineno, False)
+            elif isinstance(node, ast.Compare):
+                # "name" in snap["timers"]
+                if (len(node.ops) == 1
+                        and isinstance(node.ops[0], (ast.In, ast.NotIn))
+                        and _snapshot_kind(node.comparators[0])):
+                    add(str_const(node.left), node.lineno, False)
+        return refs

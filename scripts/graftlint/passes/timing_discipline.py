@@ -1,0 +1,85 @@
+"""raw-perf-counter / bare-sleep: timing and waiting have one home each.
+
+Library wall-timing must go through ``observability.stage()`` so it is
+fenced (device work actually finished), labeled, aggregated, and
+collection-gated — a raw ``time.perf_counter()`` pair measures dispatch
+time and exports nothing.  Sleeping belongs to the resilience
+retry/backoff layer only: a bare ``time.sleep()`` anywhere else hides
+latency from the latency histograms and breaks ``Deadline`` accounting
+(a deadline cannot preempt a sleep it does not know about).
+
+These were CI ``grep`` steps through PR 8; as greps they false-
+positived on comments, docstrings and this very file's documentation.
+As AST passes they flag only the actual attribute load / call:
+
+- ``raw-perf-counter``: any use of ``time.perf_counter`` under
+  ``raft_tpu/`` outside ``raft_tpu/observability/``
+  (``time.monotonic`` stays legal — deadlines/batch cuts are control
+  flow, not telemetry).
+- ``bare-sleep``: any ``time.sleep(...)`` call under ``raft_tpu/``
+  outside ``raft_tpu/resilience/`` (``cond.wait(timeout=...)`` and
+  friends stay legal — they are wakeable).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from scripts.graftlint.core import (
+    Diagnostic,
+    Project,
+    import_aliases,
+    register,
+)
+
+
+@register
+class TimingDisciplinePass:
+    name = "timing-discipline"
+    docs = {
+        "raw-perf-counter":
+            "library timing goes through observability.stage(), not raw "
+            "time.perf_counter()",
+        "bare-sleep":
+            "waits go through resilience.retry backoff, not bare "
+            "time.sleep()",
+    }
+
+    def run(self, project: Project) -> List[Diagnostic]:
+        out: List[Diagnostic] = []
+        for mod in project.walk("raft_tpu/"):
+            aliases = import_aliases(mod.tree)
+            time_names = {local for local, full in aliases.items()
+                          if full == "time"}
+            check_pc = not mod.in_dir("raft_tpu/observability/")
+            check_sleep = not mod.in_dir("raft_tpu/resilience/")
+            for node in ast.walk(mod.tree):
+                if check_pc and self._is_time_member(
+                        node, aliases, time_names, "perf_counter"):
+                    out.append(Diagnostic(
+                        mod.rel, node.lineno, "raw-perf-counter",
+                        "raw time.perf_counter() in library code — use "
+                        "raft_tpu.observability.stage() so the timing "
+                        "is fenced, labeled and exported"))
+                if (check_sleep and isinstance(node, ast.Call)
+                        and self._is_time_member(
+                            node.func, aliases, time_names, "sleep")):
+                    out.append(Diagnostic(
+                        mod.rel, node.lineno, "bare-sleep",
+                        "bare time.sleep() in library code — route "
+                        "waits through raft_tpu.resilience.retry so "
+                        "deadlines can account for them"))
+        return out
+
+    @staticmethod
+    def _is_time_member(node: ast.AST, aliases, time_names,
+                        member: str) -> bool:
+        if (isinstance(node, ast.Attribute) and node.attr == member
+                and isinstance(node.value, ast.Name)
+                and node.value.id in (time_names or {"time"})):
+            return True
+        if (isinstance(node, ast.Name)
+                and aliases.get(node.id) == f"time.{member}"):
+            return True
+        return False

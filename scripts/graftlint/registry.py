@@ -1,0 +1,212 @@
+"""Generated metric / stage / fault-site registry.
+
+The observability and resilience layers are *name-coupled*: library
+code ticks ``obs.registry().counter("serving.admitted")`` and a test
+(or dashboard) asserts the same string.  Nothing checks the two sides
+agree — a typo'd counter silently reads 0 forever (the
+counter-never-ticks bug class).  This module derives the authoritative
+name sets **from the library AST at lint time** instead of a
+hand-maintained list:
+
+- **metrics** — every literal (or literal-prefixed f-string / string
+  concat) first argument to ``.counter(...)`` / ``.gauge(...)`` /
+  ``.timer(...)`` / ``.histogram(...)`` under ``raft_tpu/``;
+- **stages** — every ``stage("...")`` label (stage labels become timer
+  names on exit);
+- **fault sites** — every ``maybe_fail("...")`` site.
+
+Dynamic names resolve one level of indirection: when the name argument
+is a bare parameter of the enclosing function (the ``_count(name)``
+helper idiom), the extractor collects the literal arguments of every
+same-module call to that function — so ``_count("serving.expired")``
+defines ``serving.expired``, and ``_entry("distributed.ann.build",
+...)`` defines the ``distributed.ann.build`` fault site fired by the
+``maybe_fail(site)`` inside ``_entry``.
+
+``python -m scripts.graftlint --json`` emits the registry in its
+report so dashboards can diff the available metric surface across
+versions.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from scripts.graftlint.core import (
+    Project,
+    str_const,
+    terminal_name,
+)
+
+_METRIC_KINDS = ("counter", "gauge", "timer", "histogram")
+
+
+@dataclasses.dataclass
+class Registry:
+    """Exact names and f-string prefixes per kind.  ``kind`` is one of
+    the metric kinds, ``"stage"`` or ``"fault_site"``."""
+
+    names: Dict[str, Set[str]] = dataclasses.field(
+        default_factory=lambda: {k: set() for k in
+                                 _METRIC_KINDS + ("stage", "fault_site")})
+    prefixes: Dict[str, Set[str]] = dataclasses.field(
+        default_factory=lambda: {k: set() for k in
+                                 _METRIC_KINDS + ("stage", "fault_site")})
+
+    def add(self, kind: str, name: Optional[str], prefix: Optional[str]
+            ) -> None:
+        if name:
+            self.names[kind].add(name)
+        elif prefix:
+            self.prefixes[kind].add(prefix)
+
+    # -- resolution --------------------------------------------------------
+
+    def metric_names(self) -> Set[str]:
+        """Every name a metric read could legitimately use: counters,
+        gauges, timers, histograms, plus stage labels (stages surface as
+        timers in snapshots)."""
+        out: Set[str] = set()
+        for k in _METRIC_KINDS + ("stage",):
+            out |= self.names[k]
+        return out
+
+    def metric_prefixes(self) -> Set[str]:
+        out: Set[str] = set()
+        for k in _METRIC_KINDS + ("stage",):
+            out |= self.prefixes[k]
+        return out
+
+    def roots(self) -> Set[str]:
+        """First dotted segments of every known name/prefix — the
+        namespace the consistency pass polices.  Dotted strings outside
+        these roots (test-synthetic sites like ``site.a``, module paths)
+        are not metric references and are skipped."""
+        out = set()
+        for names in self.names.values():
+            out |= {n.split(".")[0] for n in names if "." in n}
+        for prefixes in self.prefixes.values():
+            out |= {p.split(".")[0] for p in prefixes if "." in p}
+        return out
+
+    def resolves_metric(self, name: str) -> bool:
+        if name in self.metric_names():
+            return True
+        return any(name.startswith(p) for p in self.metric_prefixes())
+
+    def resolves_site(self, site: str) -> bool:
+        if site in self.names["fault_site"]:
+            return True
+        return any(site.startswith(p)
+                   for p in self.prefixes["fault_site"])
+
+    def as_dict(self) -> Dict[str, object]:
+        return {
+            "counters": sorted(self.names["counter"]),
+            "gauges": sorted(self.names["gauge"]),
+            "timers": sorted(self.names["timer"]),
+            "histograms": sorted(self.names["histogram"]),
+            "stages": sorted(self.names["stage"]),
+            "fault_sites": sorted(self.names["fault_site"]),
+            "prefixes": {k: sorted(v) for k, v in self.prefixes.items()
+                         if v},
+        }
+
+
+def _literal_or_prefix(node: ast.AST
+                       ) -> Tuple[Optional[str], Optional[str]]:
+    """Classify a name-argument expression: ``("lit", None)`` for a
+    string constant, ``(None, "pre.")`` for an f-string / concat with a
+    literal head, ``(None, None)`` otherwise."""
+    s = str_const(node)
+    if s is not None:
+        return s, None
+    if isinstance(node, ast.JoinedStr):
+        head = ""
+        for part in node.values:
+            p = str_const(part)
+            if p is None:
+                break
+            head += p
+        return None, head or None
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        head = str_const(node.left)
+        if head is not None:
+            return None, head
+    return None, None
+
+
+def _param_index(fn: ast.AST, name: str) -> Optional[int]:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args]
+    if name in names:
+        return names.index(name)
+    return None
+
+
+def _calls_of(tree: ast.AST, fname: str) -> List[ast.Call]:
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and terminal_name(node.func) == fname:
+            out.append(node)
+    return out
+
+
+def _enclosing_chains(tree: ast.AST) -> Dict[int, Tuple[ast.AST, ...]]:
+    """``id(node) -> (outermost_fn, ..., innermost_fn)`` for every node."""
+    chains: Dict[int, Tuple[ast.AST, ...]] = {}
+
+    def visit(node: ast.AST, stack: Tuple[ast.AST, ...]) -> None:
+        for child in ast.iter_child_nodes(node):
+            inner = stack
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                inner = stack + (child,)
+            chains[id(child)] = inner
+            visit(child, inner)
+
+    visit(tree, ())
+    return chains
+
+
+def build_registry(project: Project) -> Registry:
+    """Scan ``raft_tpu/`` for every definition site (see module doc)."""
+    reg = Registry()
+    for mod in project.walk("raft_tpu/"):
+        chains = _enclosing_chains(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            callee = terminal_name(node.func)
+            if callee in _METRIC_KINDS:
+                kind = callee
+            elif callee == "stage":
+                kind = "stage"
+            elif callee == "maybe_fail":
+                kind = "fault_site"
+            else:
+                continue
+            arg = node.args[0]
+            name, prefix = _literal_or_prefix(arg)
+            if name or prefix:
+                reg.add(kind, name, prefix)
+                continue
+            if not isinstance(arg, ast.Name):
+                continue
+            # bare-parameter indirection: find the innermost enclosing
+            # function declaring this parameter, then harvest the
+            # literal arguments of its same-module call sites
+            owner, pos = None, None
+            for fn in reversed(chains.get(id(node), ())):
+                idx = _param_index(fn, arg.id)
+                if idx is not None:
+                    owner, pos = fn, idx
+                    break
+            if owner is None:
+                continue
+            for call in _calls_of(mod.tree, owner.name):
+                if pos < len(call.args):
+                    name, prefix = _literal_or_prefix(call.args[pos])
+                    reg.add(kind, name, prefix)
+    return reg

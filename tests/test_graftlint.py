@@ -1,0 +1,399 @@
+"""graftlint: per-rule violation/clean fixtures, suppression, CLI, and
+the live-tree tripwire (the analyzer's own acceptance bar: the shipped
+tree must lint clean, so any regression fails here before it fails in
+production behavior)."""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))
+
+from scripts.graftlint import (  # noqa: E402
+    Project,
+    build_registry,
+    load_project,
+    run_passes,
+)
+from scripts.graftlint.core import rule_docs  # noqa: E402
+
+
+def lint(sources, rules=None):
+    diags, _ = run_passes(Project.from_sources(sources), rules=rules)
+    return diags
+
+
+def rules_of(diags):
+    return {d.rule for d in diags}
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard
+
+class TestRecompileHazard:
+    BAD_LEN = ("import jax.numpy as jnp\n"
+               "def cut_batch(queue):\n"
+               "    return jnp.zeros((len(queue), 4))\n")
+
+    def test_len_derived_device_shape_flagged(self):
+        diags = lint({"raft_tpu/serving/x.py": self.BAD_LEN})
+        assert [d.rule for d in diags] == ["recompile-hazard"]
+        assert diags[0].line == 3
+
+    def test_host_numpy_sizing_clean(self):
+        src = ("import numpy as np\n"
+               "def cut_batch(queue):\n"
+               "    return np.zeros((len(queue), 4))\n")
+        assert lint({"raft_tpu/serving/x.py": src}) == []
+
+    def test_scope_is_serving_and_distributed_only(self):
+        # the same code is legal in build-time layers (ops/, neighbors/)
+        assert lint({"raft_tpu/ops/x.py": self.BAD_LEN}) == []
+        diags = lint({"raft_tpu/distributed/x.py": self.BAD_LEN})
+        assert rules_of(diags) == {"recompile-hazard"}
+
+    def test_jit_inside_hot_path_flagged(self):
+        src = ("import jax\n"
+               "def _dispatch(fn, q):\n"
+               "    return jax.jit(fn)(q)\n")
+        diags = lint({"raft_tpu/serving/x.py": src})
+        assert [d.rule for d in diags] == ["recompile-hazard"]
+
+    def test_module_scope_jit_clean(self):
+        src = ("import jax\n"
+               "def _impl(q):\n"
+               "    return q\n"
+               "_warm = jax.jit(_impl)\n"
+               "def _dispatch(q):\n"
+               "    return _warm(q)\n")
+        assert lint({"raft_tpu/serving/x.py": src}) == []
+
+
+# ---------------------------------------------------------------------------
+# generation-discipline
+
+class TestGenerationDiscipline:
+    BAD = ("import dataclasses\n"
+           "def rewrite_codes(index, codes):\n"
+           "    return dataclasses.replace(index, codes=codes)\n")
+    GOOD = ("import dataclasses\n"
+            "from raft_tpu.neighbors import mutate as _mutate\n"
+            "def rewrite_codes(index, codes):\n"
+            "    out = dataclasses.replace(index, codes=codes)\n"
+            "    return _mutate.next_generation(index, out)\n")
+
+    def test_unbumped_replace_flagged(self):
+        diags = lint({"raft_tpu/neighbors/x.py": self.BAD})
+        assert [d.rule for d in diags] == ["generation-discipline"]
+
+    def test_next_generation_bump_clean(self):
+        assert lint({"raft_tpu/neighbors/x.py": self.GOOD}) == []
+
+    def test_direct_generation_stamp_clean(self):
+        src = ("def local_view(index, s):\n"
+               "    out = Index(centers=index.centers[s])\n"
+               "    out.generation = generation(index)\n"
+               "    return out\n")
+        assert lint({"raft_tpu/distributed/x.py": src}) == []
+
+    def test_cache_key_without_generation_flagged(self):
+        src = ("class ExecutableCache:\n"
+               "    def get(self, index, batch):\n"
+               "        key = (id(index), batch)\n"
+               "        return self._entries.get(key)\n")
+        diags = lint({"raft_tpu/serving/x.py": src})
+        assert [d.rule for d in diags] == ["generation-discipline"]
+        assert diags[0].line == 3
+
+    def test_cache_key_with_generation_clean(self):
+        src = ("class ExecutableCache:\n"
+               "    def get(self, index, batch):\n"
+               "        key = (id(index),\n"
+               "               getattr(index, 'generation', 0), batch)\n"
+               "        return self._entries.get(key)\n")
+        assert lint({"raft_tpu/serving/x.py": src}) == []
+
+
+# ---------------------------------------------------------------------------
+# mask-seam
+
+class TestMaskSeam:
+    def test_exact_minus_one_compare_flagged(self):
+        src = "def mask(ids):\n    return ids == -1\n"
+        diags = lint({"raft_tpu/neighbors/x.py": src})
+        assert [d.rule for d in diags] == ["mask-seam"]
+        assert "tombstones" in diags[0].message
+
+    def test_sign_test_clean(self):
+        src = "def mask(ids):\n    return ids < 0\n"
+        assert lint({"raft_tpu/neighbors/x.py": src}) == []
+
+    def test_non_id_names_not_flagged(self):
+        src = "def f(count):\n    return count == -1\n"
+        assert lint({"raft_tpu/neighbors/x.py": src}) == []
+
+    def test_inf_in_pallas_product_flagged(self):
+        src = ("import jax.numpy as jnp\n"
+               "def kernel(mask, d):\n"
+               "    return d + mask * jnp.inf\n")
+        diags = lint({"raft_tpu/ops/foo_pallas.py": src})
+        assert [d.rule for d in diags] == ["mask-seam"]
+        assert "3.0e38" in diags[0].message
+
+    def test_finite_sentinel_in_pallas_clean(self):
+        src = ("def kernel(mask, d):\n"
+               "    return d + mask * 3.0e38\n")
+        assert lint({"raft_tpu/ops/foo_pallas.py": src}) == []
+
+    def test_inf_outside_pallas_clean(self):
+        # inf is fine outside the one-hot-merge kernels (e.g. top-k
+        # seeds in plain ops modules)
+        src = ("import jax.numpy as jnp\n"
+               "def seed(mask, d):\n"
+               "    return d + mask * jnp.inf\n")
+        assert lint({"raft_tpu/ops/foo.py": src}) == []
+
+
+# ---------------------------------------------------------------------------
+# boundary-guard
+
+class TestBoundaryGuard:
+    def test_unguarded_entry_point_flagged(self):
+        src = ("def search(res, params, index, queries, k):\n"
+               "    return queries\n")
+        diags = lint({"raft_tpu/neighbors/x.py": src})
+        assert [d.rule for d in diags] == ["boundary-guard"]
+
+    def test_direct_validator_call_clean(self):
+        src = ("from raft_tpu.integrity import boundary as _b\n"
+               "def search(res, params, index, queries, k):\n"
+               "    queries, ok = _b.check_matrix(queries, 'q', site='s')\n"
+               "    return queries\n")
+        assert lint({"raft_tpu/neighbors/x.py": src}) == []
+
+    def test_same_module_delegation_clean(self):
+        src = ("from raft_tpu.integrity.boundary import check_matrix\n"
+               "def _impl(queries):\n"
+               "    queries, ok = check_matrix(queries, 'q', site='s')\n"
+               "    return queries\n"
+               "def search(res, params, index, queries, k):\n"
+               "    return _impl(queries)\n")
+        assert lint({"raft_tpu/neighbors/x.py": src}) == []
+
+    def test_serving_scans_class_methods(self):
+        src = ("class Server:\n"
+               "    def submit(self, queries):\n"
+               "        return queries\n")
+        diags = lint({"raft_tpu/serving/x.py": src})
+        assert [d.rule for d in diags] == ["boundary-guard"]
+        # ...but neighbors/cluster check module-level functions only
+        assert lint({"raft_tpu/neighbors/x.py": src}) == []
+
+
+# ---------------------------------------------------------------------------
+# timing discipline (the former CI greps, now AST-accurate)
+
+class TestTimingDiscipline:
+    def test_raw_perf_counter_flagged(self):
+        src = "import time\ndef f():\n    return time.perf_counter()\n"
+        diags = lint({"raft_tpu/core/x.py": src})
+        assert [d.rule for d in diags] == ["raw-perf-counter"]
+
+    def test_from_import_alias_flagged(self):
+        # the old grep missed "from time import perf_counter as clock"
+        src = ("from time import perf_counter as clock\n"
+               "def f():\n"
+               "    return clock()\n")
+        diags = lint({"raft_tpu/core/x.py": src})
+        assert [d.rule for d in diags] == ["raw-perf-counter"]
+
+    def test_observability_package_exempt(self):
+        src = "import time\ndef f():\n    return time.perf_counter()\n"
+        assert lint({"raft_tpu/observability/x.py": src}) == []
+
+    def test_mention_in_docstring_clean(self):
+        # the old grep false-positived on prose; the AST pass must not
+        src = '"""never call time.perf_counter() or time.sleep(1)."""\n'
+        assert lint({"raft_tpu/core/x.py": src}) == []
+
+    def test_bare_sleep_flagged_outside_resilience(self):
+        src = "import time\ndef f():\n    time.sleep(1)\n"
+        diags = lint({"raft_tpu/serving/x.py": src})
+        assert [d.rule for d in diags] == ["bare-sleep"]
+        assert lint({"raft_tpu/resilience/x.py": src}) == []
+
+    def test_monotonic_and_cond_wait_clean(self):
+        src = ("import time\n"
+               "def f(cond):\n"
+               "    t = time.monotonic()\n"
+               "    cond.wait(timeout=0.1)\n"
+               "    return t\n")
+        assert lint({"raft_tpu/serving/x.py": src}) == []
+
+
+# ---------------------------------------------------------------------------
+# registry-consistency
+
+LIB = ("def _count(name):\n"
+       "    registry().counter(name).inc()\n"
+       "def admit(op):\n"
+       "    registry().counter('serving.batch.admitted').inc()\n"
+       "    _count('serving.batch.expired')\n"
+       "    registry().counter(f'comms.{op}.calls').inc()\n"
+       "def swap():\n"
+       "    maybe_fail('serving.swap')\n")
+
+
+class TestRegistryConsistency:
+    def test_typoed_counter_assert_flagged(self):
+        test = ("def test_x(snap):\n"
+                "    assert snap['counters']['serving.batch.admited']\n")
+        diags = lint({"raft_tpu/serving/obs.py": LIB,
+                      "tests/test_x.py": test})
+        assert [d.rule for d in diags] == ["registry-consistency"]
+        assert "serving.batch.admited" in diags[0].message
+
+    def test_known_names_and_prefixes_resolve(self):
+        test = ("def test_x(snap, plan):\n"
+                "    assert snap['counters']['serving.batch.admitted']\n"
+                "    assert snap['counters'].get("
+                "'serving.batch.expired', 0)\n"
+                "    assert 'comms.p2p.calls' in snap['counters']\n"
+                "    plan.at('serving.swap')\n")
+        assert lint({"raft_tpu/serving/obs.py": LIB,
+                     "tests/test_x.py": test}) == []
+
+    def test_indirect_helper_names_register(self):
+        # _count("serving.batch.expired") defines the name even though
+        # the .counter() call site only sees the bare parameter
+        test = ("def test_x(snap):\n"
+                "    assert snap['counters']['serving.batch.expired']\n")
+        assert lint({"raft_tpu/serving/obs.py": LIB,
+                     "tests/test_x.py": test}) == []
+
+    def test_unknown_fault_site_flagged(self):
+        test = ("def test_x(plan):\n"
+                "    plan.at('serving.swop')\n")
+        diags = lint({"raft_tpu/serving/obs.py": LIB,
+                      "tests/test_x.py": test})
+        assert [d.rule for d in diags] == ["registry-consistency"]
+        assert "can never fire" in diags[0].message
+
+    def test_synthetic_test_names_skipped(self):
+        # names outside the registry's namespace roots are unit-test
+        # synthetics, not references to library metrics
+        test = ("def test_x(snap, plan):\n"
+                "    assert snap['counters']['c'] == 1\n"
+                "    assert snap['counters']['work.done'] == 1\n"
+                "    plan.at('site.a')\n")
+        assert lint({"raft_tpu/serving/obs.py": LIB,
+                     "tests/test_x.py": test}) == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+
+class TestSuppression:
+    BAD = "def f(ids):\n    return ids == -1{}\n"
+
+    def test_named_suppression_honored_and_counted(self):
+        src = self.BAD.format(
+            "  # graftlint: disable=mask-seam -- post-clamp public ids")
+        diags, n = run_passes(
+            Project.from_sources({"raft_tpu/neighbors/x.py": src}))
+        assert diags == [] and n == 1
+
+    def test_bare_disable_suppresses_any_rule(self):
+        src = self.BAD.format("  # graftlint: disable")
+        diags, n = run_passes(
+            Project.from_sources({"raft_tpu/neighbors/x.py": src}))
+        assert diags == [] and n == 1
+
+    def test_wrong_rule_name_does_not_suppress(self):
+        src = self.BAD.format("  # graftlint: disable=bare-sleep")
+        diags, _ = run_passes(
+            Project.from_sources({"raft_tpu/neighbors/x.py": src}))
+        assert [d.rule for d in diags] == ["mask-seam"]
+
+    def test_comment_only_line_covers_next_line(self):
+        src = ("def f(ids):\n"
+               "    # graftlint: disable=mask-seam -- reason\n"
+               "    return ids == -1\n")
+        diags, n = run_passes(
+            Project.from_sources({"raft_tpu/neighbors/x.py": src}))
+        assert diags == [] and n == 1
+
+
+# ---------------------------------------------------------------------------
+# live tree + generated registry
+
+class TestLiveTree:
+    def test_live_tree_is_violation_free(self):
+        # the tripwire: the shipped tree must stay clean.  When this
+        # fails, either fix the flagged site or suppress it with a
+        # reasoned comment (docs/api.md, "Static analysis").
+        project = load_project()
+        diags, _ = run_passes(project)
+        assert diags == [], "\n".join(str(d) for d in diags)
+
+    def test_registry_reflects_live_definitions(self):
+        reg = build_registry(load_project())
+        d = reg.as_dict()
+        # direct literals
+        assert "integrity.boundary.checks" in d["counters"]
+        assert "xla.compiles" in d["counters"]
+        # one-level indirection through the _count(name) helper
+        assert "serving.admitted" in d["counters"]
+        # fault site defined through the _entry(site, ...) wrapper
+        assert "distributed.ann.search" in d["fault_sites"]
+        assert "rebalance.swap" in d["fault_sites"]
+        # f-string dynamic names register as prefixes
+        assert "comms." in d["prefixes"]["counter"]
+        assert reg.resolves_metric("comms.allreduce.calls")
+        assert not reg.resolves_metric("serving.admited")
+        assert "integrity.health_check" in d["stages"]
+
+    def test_rule_catalogue_complete(self):
+        assert {"recompile-hazard", "generation-discipline", "mask-seam",
+                "boundary-guard", "raw-perf-counter", "bare-sleep",
+                "registry-consistency"} <= set(rule_docs())
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+def _cli(*args, cwd=ROOT):
+    return subprocess.run(
+        [sys.executable, "-m", "scripts.graftlint", *args],
+        cwd=str(cwd), capture_output=True, text=True)
+
+
+class TestCli:
+    def test_json_report_on_clean_tree(self):
+        out = _cli("--json")
+        assert out.returncode == 0, out.stdout + out.stderr
+        report = json.loads(out.stdout)
+        assert report["diagnostics"] == []
+        assert "fault_sites" in report["registry"]
+        assert "mask-seam" in report["rules"]
+
+    def test_violations_fail_with_file_line_rule(self, tmp_path):
+        pkg = tmp_path / "raft_tpu" / "neighbors"
+        pkg.mkdir(parents=True)
+        (pkg / "bad.py").write_text("def f(ids):\n    return ids == -1\n")
+        out = _cli("--root", str(tmp_path), "--rules", "mask-seam")
+        assert out.returncode == 1
+        assert "raft_tpu/neighbors/bad.py:2: mask-seam:" in out.stdout
+
+    def test_unknown_rule_is_a_usage_error(self):
+        out = _cli("--rules", "no-such-rule")
+        assert out.returncode == 2
+
+    def test_list_rules(self):
+        out = _cli("--list-rules")
+        assert out.returncode == 0
+        assert "mask-seam" in out.stdout
+        assert "registry-consistency" in out.stdout

@@ -128,6 +128,44 @@ class TestMutateHelpers:
         np.testing.assert_array_equal(np.asarray(live), [2])
 
 
+class TestTombstoneSerializeRoundTrip:
+    """The tombstone decode helpers (``deleted_ids`` / ``live_sizes``)
+    must survive serialize -> deserialize: both decode from
+    ``list_indices``, which hardened serialization stores verbatim, so a
+    checkpointed-and-restored index must report the same delete state
+    (the rebalancer's resume path depends on it)."""
+
+    DOOMED = [0, 5, 17, 400]
+
+    def _roundtrip(self, res, module, index):
+        buf = io.BytesIO()
+        module.serialize(res, buf, index)
+        buf.seek(0)
+        return module.deserialize(res, buf)
+
+    def _check(self, res, module, index):
+        deleted = module.delete(res, index, self.DOOMED)
+        back = self._roundtrip(res, module, deleted)
+        assert (mutate.deleted_ids(back) == mutate.deleted_ids(deleted)
+                == frozenset(self.DOOMED))
+        np.testing.assert_array_equal(
+            np.asarray(mutate.live_sizes(back.list_indices)),
+            np.asarray(mutate.live_sizes(deleted.list_indices)))
+        assert mutate.live_count(back) == mutate.live_count(index) - len(
+            self.DOOMED)
+
+    def test_flat_roundtrip(self, res, dataset):
+        db, _ = dataset
+        params = ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=5)
+        self._check(res, ivf_flat, ivf_flat.build(res, params, db))
+
+    def test_pq_roundtrip(self, res, pq_dataset):
+        db, _ = pq_dataset
+        params = ivf_pq.IndexParams(n_lists=16, pq_dim=8,
+                                    kmeans_n_iters=5)
+        self._check(res, ivf_pq, ivf_pq.build(res, params, db))
+
+
 class TestFlatMutation:
     @pytest.fixture(scope="class")
     def built(self, res, dataset):
