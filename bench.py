@@ -924,12 +924,18 @@ def bench_distributed(res) -> list:
     candidate-exchange bytes and the per-shard scanned-row ratio — the
     numbers PERFORMANCE.md's per-chip work / gather-bytes model
     predicts (routed scan work ~1/n_shards, gather fixed at (k, nq)
-    pairs per shard for BOTH modes; the routed win is the scan)."""
+    pairs per shard for BOTH modes; the routed win is the scan).
+
+    Round 10 adds the routed FUSED operating point (sync-free grouped
+    scan under shard_map at static capacity) and
+    ``dist_scan_bytes_per_row`` — the per-row HBM traffic of each scan
+    form from :func:`raft_tpu.neighbors.grouped.scan_traffic`, the model
+    behind the 264 -> 72 B/row routed headline."""
     import jax
 
     from raft_tpu.comms.session import CommsSession
     from raft_tpu.distributed import ann as dist_ann
-    from raft_tpu.neighbors import ivf_pq
+    from raft_tpu.neighbors import grouped, ivf_pq
 
     n_dev = len(jax.devices())
     n = DIST_ROWS_PER_DEV * n_dev
@@ -940,17 +946,19 @@ def bench_distributed(res) -> list:
                                 kmeans_n_iters=5,
                                 cache_reconstructions=True)
     sp = ivf_pq.SearchParams(n_probes=DIST_N_PROBES)
+    sp_fused = ivf_pq.SearchParams(n_probes=DIST_N_PROBES,
+                                   scan_mode="fused")
     out = []
     session = CommsSession().init()
     try:
         handle = session.worker_handle()
 
-        def qps(index):
-            i = dist_ann.search(handle, sp, index, queries, k)[1]  # warm
+        def qps(index, p=sp):
+            i = dist_ann.search(handle, p, index, queries, k)[1]  # warm
             np.asarray(i)
             t0 = time.perf_counter()
             for _ in range(RUNS):
-                i = dist_ann.search(handle, sp, index, queries, k)[1]
+                i = dist_ann.search(handle, p, index, queries, k)[1]
             np.asarray(i)
             return nq / ((time.perf_counter() - t0) / RUNS)
 
@@ -962,6 +970,13 @@ def bench_distributed(res) -> list:
         routed_qps = qps(routed)
         _, _, r_stats = dist_ann.search(handle, sp, routed, queries, k,
                                         return_stats=True)
+        routed_fused_qps = qps(routed, sp_fused)
+        _, _, rf_stats = dist_ann.search(handle, sp_fused, routed,
+                                         queries, k, return_stats=True)
+        rot_dim = int(routed.rotation.shape[-1])
+        traffic = grouped.scan_traffic(
+            rot_dim, pq_dim=params.pq_dim,
+            pq_bits=int(getattr(routed, "pq_bits", 0)))
     finally:
         session.destroy()
     # the candidate exchange: each shard contributes (nq, k) f32+i32
@@ -986,6 +1001,25 @@ def bench_distributed(res) -> list:
         "detail": {"n_probes": DIST_N_PROBES, "k": k, "batch": nq,
                    "gather_bytes": gather_bytes,
                    "scanned_rows_max": int(dp_stats["scanned_rows"].max())},
+    })
+    # round 10: the sync-free fused grouped scan under the routed path —
+    # vs_baseline is the CI tripwire ratio (fused must not regress below
+    # the routed recon point it replaces as the default fast path)
+    out.append({
+        "metric": f"dist_qps_routed_fused_{shape}",
+        "value": round(routed_fused_qps, 1), "unit": "qps",
+        "vs_baseline": round(routed_fused_qps / max(routed_qps, 1e-9), 3),
+        "detail": {"n_probes": DIST_N_PROBES, "k": k, "batch": nq,
+                   "scan_mode": rf_stats.get("scan_mode"),
+                   "gather_bytes": gather_bytes,
+                   "scanned_rows_max": int(rf_stats["scanned_rows"].max())},
+    })
+    out.append({
+        "metric": f"dist_scan_bytes_per_row_{shape}",
+        "value": traffic["fused"], "unit": "B/row",
+        "vs_baseline": round(traffic["fused"] / traffic["recon"], 3),
+        "detail": dict(traffic, rot_dim=rot_dim, pq_dim=params.pq_dim,
+                       pq_bits=int(getattr(routed, "pq_bits", 0))),
     })
     return out
 

@@ -194,6 +194,13 @@ class ExecutableCache:
             buf = export_ivf_pq_search(
                 res, index, n_probes=n_probes, k=k, batch=batch,
                 scan_mode=scan_mode or "recon", **export_kwargs)
+        elif kind == "ivf_pq_routed":
+            # per-shard routed program; `shard` (and, for the fused scan,
+            # `group_capacity`) arrive via export_kwargs and are part of
+            # the cache key like every other export specialization
+            buf = export_ivf_pq_routed_search(
+                res, index, n_probes=n_probes, k=k, batch=batch,
+                scan_mode=scan_mode or "recon", **export_kwargs)
         elif kind == "ivf_flat":
             buf = export_ivf_flat_search(res, index, n_probes=n_probes,
                                          k=k, batch=batch, **export_kwargs)
@@ -226,7 +233,8 @@ def executables() -> ExecutableCache:
 
 
 def export_ivf_pq_search(res, index, n_probes: int, k: int, batch: int,
-                         *, scan_mode: str = "recon") -> io.BytesIO:
+                         *, scan_mode: str = "recon",
+                         group_capacity: int = 0) -> io.BytesIO:
     """Export the flagship IVF-PQ search at fixed (batch, k, n_probes)
     into a self-contained artifact (reference analogue: serialized index
     + the prebuilt search instantiation).
@@ -237,39 +245,66 @@ def export_ivf_pq_search(res, index, n_probes: int, k: int, batch: int,
     - ``"recon"`` bakes the bf16 reconstruction cache and exports the
       recon scan (2 bytes/dim/row in the artifact — the fastest live
       formulation, also the largest file).
-    - ``"codes"`` / ``"lut"`` / ``"fused"`` bake only the bit-packed PQ
-      codes + codebooks and export the portable LUT formulation over
-      them (~pq_bits/8 bytes per subspace per row — the compact
-      deployment shape).  The grouped Pallas kernels — including the
-      fused in-kernel top-k variants — are runtime-dispatch paths and
-      are not serialized (their group count is batch-data-dependent);
-      the exported code program computes the same quantized distances,
-      so an artifact warmed under ``scan_mode="fused"`` answers
+    - ``"fused"`` bakes the recon cache and exports the GROUPED scan at
+      the static group capacity ``group_capacity`` (0 derives the
+      exact-safe worst bound from (batch, n_probes, n_lists) — group
+      construction is fully traceable at a static capacity since
+      round 10, so the list-centric formulation exports like any other).
+      The Pallas in-kernel top-k variants remain runtime dispatch paths;
+      the exported XLA twin computes identical quantized distances.
+      Falls back to the LUT export below when the index carries no recon
+      cache.
+    - ``"codes"`` / ``"lut"`` bake only the bit-packed PQ codes +
+      codebooks and export the portable LUT formulation over them
+      (~pq_bits/8 bytes per subspace per row — the compact deployment
+      shape); it computes the same quantized distances as the codes
+      kernel, so an artifact warmed under either mode answers
       identically while carrying its own distinct
       :class:`ExecutableCache` key component.
     """
-    from raft_tpu.neighbors import ivf_pq
+    from raft_tpu.neighbors import grouped, ivf_pq
 
     expects(scan_mode in ("recon", "codes", "lut", "fused"),
             "aot: scan_mode must be 'recon', 'codes', 'lut' or 'fused'")
     metric = index.metric
 
-    if scan_mode == "recon":
+    if scan_mode == "fused" and index.list_recon is None:
+        scan_mode = "lut"
+    if scan_mode in ("recon", "fused"):
         expects(index.list_recon is not None,
                 "aot: index must carry the reconstruction cache")
         if index.list_recon_sq is None:
             index.list_recon_sq = ivf_pq._recon_sq(index.list_recon)
 
-        def fn(centers, list_recon, list_recon_sq, list_indices, rotation,
-               queries):
-            # the precomputed norms ride in the artifact — without them
-            # the exported program would recompute a full pass over the
-            # recon cache per batch (they are runtime inputs, not
-            # constants)
-            return ivf_pq._search_impl_recon(
-                centers, list_recon, list_indices, rotation, queries,
-                k=k, n_probes=n_probes, metric=metric,
-                list_recon_sq=list_recon_sq)
+        if scan_mode == "fused":
+            n_groups = int(group_capacity) or grouped.group_capacity(
+                batch, n_probes, index.n_lists)[0]
+            cap = int(index.capacity)
+            rot = int(index.rot_dim)
+            G = grouped.GROUP
+            block = grouped.block_size(n_groups, G * cap * 8,
+                                       cap * rot * 2, G * rot * 4)
+
+            def fn(centers, list_recon, list_recon_sq, list_indices,
+                   rotation, queries):
+                probes = ivf_pq._select_clusters(centers, rotation,
+                                                 queries, n_probes,
+                                                 metric)
+                return ivf_pq._search_impl_recon_grouped(
+                    centers, list_recon, list_recon_sq, list_indices,
+                    rotation, queries, probes, k, metric, n_groups,
+                    block)
+        else:
+            def fn(centers, list_recon, list_recon_sq, list_indices,
+                   rotation, queries):
+                # the precomputed norms ride in the artifact — without
+                # them the exported program would recompute a full pass
+                # over the recon cache per batch (they are runtime
+                # inputs, not constants)
+                return ivf_pq._search_impl_recon(
+                    centers, list_recon, list_indices, rotation, queries,
+                    k=k, n_probes=n_probes, metric=metric,
+                    list_recon_sq=list_recon_sq)
 
         arrays = (index.centers, index.list_recon, index.list_recon_sq,
                   index.list_indices, index.rotation)
@@ -297,10 +332,12 @@ def export_ivf_pq_search(res, index, n_probes: int, k: int, batch: int,
 
 
 def export_ivf_pq_routed_search(res, index, shard: int, n_probes: int,
-                                k: int, batch: int) -> io.BytesIO:
+                                k: int, batch: int, *,
+                                scan_mode: str = "recon",
+                                group_capacity: int = 0) -> io.BytesIO:
     """Export ONE shard's routed (``placement="by_list"``) search
     program at fixed (batch, k, n_probes): replicated coarse routing +
-    ownership mask + the recon scan over the shard's owned lists +
+    ownership mask + the shard-local scan over the owned lists +
     shard-local top-k.  The artifact is the per-chip deployment unit of
     an index-parallel mesh — each chip loads its own shard's program,
     and the k-bounded candidate exchange/merge stays in the (tiny)
@@ -309,30 +346,67 @@ def export_ivf_pq_routed_search(res, index, shard: int, n_probes: int,
     :func:`raft_tpu.distributed.ann.search` answer exactly (the
     hierarchical-top-k argument; asserted in tests).
 
+    ``scan_mode="recon"`` (default) bakes the probe-order recon scan.
+    ``scan_mode="fused"`` bakes the grouped scan at the static group
+    capacity ``group_capacity`` (0 derives the exact-safe worst bound
+    from (batch, n_probes, slots) — see
+    :func:`raft_tpu.neighbors.grouped.group_capacity`); group
+    construction is fully traceable at a static capacity (round 10), so
+    the export carries zero host syncs and the serving tier's bucket
+    pre-warm covers fused routed executables like any other shape.
+
     ``shard_map`` itself is not exportable — this bakes the shard's
     leaves plus the replicated routing arrays (coarse centers, rotation,
     owner, local_slot) into a single-device program instead."""
-    from raft_tpu.neighbors import ivf_pq
+    from raft_tpu.neighbors import grouped, ivf_pq
 
     expects(getattr(index, "placement", None) is not None,
             "aot: export_ivf_pq_routed_search needs a RoutedIndex "
             "(placement='by_list')")
     expects(0 <= shard < index.n_shards,
             f"aot: shard {shard} out of range for {index.n_shards}")
+    expects(scan_mode in ("recon", "fused"),
+            f"aot: export_ivf_pq_routed_search supports scan_mode "
+            f"'recon' or 'fused', got {scan_mode!r}")
     metric = index.metric
-    dummy = int(index.local_centers.shape[1]) - 1
+    slots = int(index.local_centers.shape[1])
+    dummy = slots - 1
 
-    def fn(coarse, rotation, owner, local_slot, local_centers,
-           list_recon, list_recon_sq, list_indices, queries):
-        probes = ivf_pq._select_clusters(coarse, rotation, queries,
-                                         n_probes, metric)
-        owned = owner[probes] == shard
-        local_probes = jax.numpy.where(owned, local_slot[probes],
-                                       dummy).astype(jax.numpy.int32)
-        return ivf_pq._search_impl_recon(
-            local_centers, list_recon, list_indices, rotation, queries,
-            k=k, n_probes=n_probes, metric=metric, probes=local_probes,
-            list_recon_sq=list_recon_sq)
+    if scan_mode == "fused":
+        n_groups = int(group_capacity) or grouped.group_capacity(
+            batch, n_probes, slots)[0]
+        cap = int(index.capacity)
+        rot = int(index.rotation.shape[1])
+        G = grouped.GROUP
+        block = grouped.block_size(n_groups, G * cap * 8,
+                                   cap * rot * 2, G * rot * 4)
+
+        def fn(coarse, rotation, owner, local_slot, local_centers,
+               list_recon, list_recon_sq, list_indices, queries):
+            probes = ivf_pq._select_clusters(coarse, rotation, queries,
+                                             n_probes, metric)
+            owned = owner[probes] == shard
+            # out-of-range sentinel (== slots): build_groups drops the
+            # unowned pairs entirely (see _dist_search_routed_grouped)
+            local_probes = jax.numpy.where(
+                owned, local_slot[probes],
+                slots).astype(jax.numpy.int32)
+            return ivf_pq._search_impl_recon_grouped(
+                local_centers, list_recon, list_recon_sq, list_indices,
+                rotation, queries, local_probes, k, metric, n_groups,
+                block)
+    else:
+        def fn(coarse, rotation, owner, local_slot, local_centers,
+               list_recon, list_recon_sq, list_indices, queries):
+            probes = ivf_pq._select_clusters(coarse, rotation, queries,
+                                             n_probes, metric)
+            owned = owner[probes] == shard
+            local_probes = jax.numpy.where(owned, local_slot[probes],
+                                           dummy).astype(jax.numpy.int32)
+            return ivf_pq._search_impl_recon(
+                local_centers, list_recon, list_indices, rotation,
+                queries, k=k, n_probes=n_probes, metric=metric,
+                probes=local_probes, list_recon_sq=list_recon_sq)
 
     arrays = tuple(jax.device_get(a) for a in (
         index.coarse_centers, index.rotation, index.owner,

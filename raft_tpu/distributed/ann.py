@@ -35,6 +35,18 @@ Two placements coexist (round 8):
   Per-chip candidate work drops by ~``n_shards`` at identical results:
   any global top-k candidate is in its owning shard's local top-k, so
   the routed search is exactly the single-index search.
+
+Scan formulations under ``shard_map`` (round 10): group construction is
+now fully traceable at a static capacity
+(:func:`raft_tpu.neighbors.grouped.group_capacity`), so the grouped and
+fused scans lower under ``shard_map`` for both placements —
+``scan_mode="fused"`` runs the same formulation ladder the single-index
+search picks (fused Pallas kernels on TPU, the XLA grouped twin
+elsewhere) instead of the pre-round-10 blanket lowering to the
+probe-order recon scan.  :func:`_resolve_scan_mode` is the host-side
+resolution table; :data:`SHARD_OK_FALLBACK` now marks only the genuinely
+unsupported combinations (e.g. ``recon8`` — no stacked int8 cache — or
+code modes on an index without PQ metadata).
 """
 
 from __future__ import annotations
@@ -63,10 +75,11 @@ from raft_tpu.resilience import retry as _retry
 P = jax.sharding.PartitionSpec
 
 # per-shard status codes (the ``return_status=True`` vector).  OK_FALLBACK
-# marks a LIVE shard whose requested ``scan_mode`` could not run under
-# ``shard_map`` and was lowered to the traceable recon scan — previously
-# only visible as a counter tick, now explicit per shard (results are
-# still correct; only the formulation differs).
+# marks a LIVE shard whose requested ``scan_mode`` has no distributed
+# formulation and was lowered to the probe-order recon scan — since
+# round 10 the exception, not the rule (fused/grouped scans lower under
+# ``shard_map`` at the static group capacity; results are correct either
+# way, only the formulation differs).
 SHARD_FAILED = 0
 SHARD_OK = 1
 SHARD_OK_FALLBACK = 2
@@ -102,26 +115,147 @@ def _status_vector(n_shards: int, failed: Tuple[int, ...],
     return jnp.asarray(status)
 
 
-def _scan_mode_lowered(params) -> bool:
-    """Validate ``params.scan_mode`` and report whether the sharded
-    search lowers it.  Under ``shard_map`` the grouped Pallas kernels
-    (fused included) cannot dispatch — their group construction is
-    batch-data-dependent and host-driven — so every mode lowers to the
-    traceable probe-order recon scan.  An explicit non-recon request
-    ticks the counters so operators see the lowering."""
+@dataclasses.dataclass(frozen=True)
+class _ScanResolution:
+    """Host-side static resolution of the shard-local scan formulation.
+
+    ``form`` is one of ``probe_recon`` (probe-order recon scan — the
+    pre-round-10 universal formulation), ``grouped_recon`` (XLA grouped
+    scan at static capacity — the same twin the single-index fused
+    ladder lands on off-TPU), ``fused_recon`` / ``fused_codes`` (the
+    Pallas fused kernels, TPU only) or ``lut`` (the traceable LUT
+    formulation, data-parallel only).  ``lowered`` marks a genuine
+    fallback (status :data:`SHARD_OK_FALLBACK`); ``n_groups`` is the
+    static group capacity for the grouped forms; ``exact`` False arms
+    the in-graph overflow count (calibrated capacity only);
+    ``use_pallas`` gates the non-fused Pallas group kernel inside
+    ``grouped_recon``."""
+
+    form: str
+    lowered: bool
+    n_groups: int = 0
+    exact: bool = True
+    kt: int = 0
+    use_pallas: bool = False
+
+
+def _note_lowered(mode: str) -> None:
+    from raft_tpu import observability as obs
+    if obs.enabled():
+        obs.registry().counter("distributed.ann.scan_mode_lowered").inc()
+        if mode == "fused":
+            obs.registry().counter("ivf_pq.search.fused_fallback").inc()
+
+
+def _note_fused_fallback() -> None:
+    """Fused requested but the Pallas kernel gates failed: the XLA
+    grouped twin runs instead (same ladder as single-index; NOT a
+    distributed lowering, so the status vector stays SHARD_OK)."""
+    from raft_tpu import observability as obs
+    if obs.enabled():
+        obs.registry().counter("ivf_pq.search.fused_fallback").inc()
+
+
+def _resolve_scan_mode(params, index, nq: int, n_probes: int,
+                       k: int) -> _ScanResolution:
+    """Resolve ``params.scan_mode`` to the distributed formulation that
+    runs inside ``shard_map`` — the support matrix docs/api.md
+    ("Distributed search") documents.  Everything here is host-static
+    (shapes, flags, calibrated estimate), so the jitted dispatch below
+    carries the decision as static arguments and the request path does
+    no device sync."""
     mode = getattr(params, "scan_mode", "auto")
     expects(mode in ivf_pq._SCAN_MODES,
             f"distributed.ann.search: unknown scan_mode {mode!r}")
-    lowered = mode not in ("auto", "recon")
-    if lowered:
-        from raft_tpu import observability as obs
-        if obs.enabled():
-            obs.registry().counter(
-                "distributed.ann.scan_mode_lowered").inc()
+    on_tpu = jax.default_backend() == "tpu"
+    kt_req = int(getattr(params, "per_probe_topk", 0) or 0)
+    routed = isinstance(index, RoutedIndex)
+    want_fused = mode == "fused" or (mode == "auto" and on_tpu)
+
+    if routed:
+        if mode in ("lut", "codes", "recon8"):
+            # routed shards carry no raw packed codes and no int8 recon
+            # cache — the documented FALLBACK exception
+            _note_lowered(mode)
+            return _ScanResolution("probe_recon", lowered=True)
+        if not want_fused:
+            return _ScanResolution("probe_recon", lowered=False)
+        slots = index.local_centers.shape[1]
+        cap = index.capacity
+        rot = index.rotation.shape[1]
+        kt = min(kt_req or k, cap)
+        n_groups, exact = grouped.group_capacity(
+            nq, n_probes, slots, est=getattr(index, "group_est", 0.0))
+        metric_l2 = index.metric in ivf_pq._L2_METRICS
+        if on_tpu:
+            from raft_tpu.ops import pq_code_scan_pallas as pcs
+            from raft_tpu.ops import pq_group_scan_pallas as pqp
+            ids_ok = grouped.ids_f32_exact(index, index.list_indices)
+            if (index.list_code_lanes is not None
+                    and index.list_code_rsq is not None
+                    and index.codebooks is not None and index.pq_bits
+                    and ids_ok and metric_l2
+                    and pcs.supported_fused_codes(
+                        True, True, cap, rot, kt, k, nq,
+                        index.codebooks.shape[0], index.pq_bits)):
+                # the 72 B/row headline: per-shard fused code scan
+                return _ScanResolution("fused_codes", lowered=False,
+                                       n_groups=n_groups, exact=exact,
+                                       kt=kt)
+            if ids_ok and pqp.supported_fused(metric_l2, cap, rot, kt,
+                                              k, nq):
+                return _ScanResolution("fused_recon", lowered=False,
+                                       n_groups=n_groups, exact=exact,
+                                       kt=kt)
             if mode == "fused":
-                obs.registry().counter(
-                    "ivf_pq.search.fused_fallback").inc()
-    return lowered
+                _note_fused_fallback()
+            return _ScanResolution("grouped_recon", lowered=False,
+                                   n_groups=n_groups, exact=exact, kt=kt,
+                                   use_pallas=ids_ok)
+        if mode == "fused":
+            _note_fused_fallback()
+        return _ScanResolution("grouped_recon", lowered=False,
+                               n_groups=n_groups, exact=exact, kt=kt)
+
+    # data-parallel (by_row): per-shard local index, worst-bound
+    # capacity only (exact regime — no overflow machinery in the jit)
+    n_lists_local = index.centers.shape[1]
+    cap = index.list_recon.shape[2]
+    rot = index.rotation.shape[2]
+    kt = min(kt_req or k, cap)
+    if mode in ("lut", "codes"):
+        if getattr(index, "pq_bits", 0):
+            # the traceable LUT twin computes the same quantized
+            # distance the codes kernel streams; on TPU a codes request
+            # is still a formulation downgrade (no lane-packed leaves in
+            # the stacked pytree), so report the lowering there
+            lowered = mode == "codes" and on_tpu
+            if lowered:
+                _note_lowered(mode)
+            return _ScanResolution("lut", lowered=lowered, kt=kt)
+        _note_lowered(mode)  # legacy stacked pytree without PQ metadata
+        return _ScanResolution("probe_recon", lowered=True)
+    if mode == "recon8":
+        _note_lowered(mode)  # no stacked int8 recon cache
+        return _ScanResolution("probe_recon", lowered=True)
+    if not want_fused:
+        return _ScanResolution("probe_recon", lowered=False)
+    n_groups, _ = grouped.group_capacity(nq, n_probes, n_lists_local)
+    if on_tpu:
+        from raft_tpu.ops import pq_group_scan_pallas as pqp
+        metric_l2 = index.metric in ivf_pq._L2_METRICS
+        ids_ok = grouped.ids_f32_exact(index, index.list_indices)
+        if ids_ok and pqp.supported_fused(metric_l2, cap, rot, kt, k, nq):
+            return _ScanResolution("fused_recon", lowered=False,
+                                   n_groups=n_groups, kt=kt)
+        if mode == "fused":
+            _note_fused_fallback()
+        return _ScanResolution("grouped_recon", lowered=False,
+                               n_groups=n_groups, kt=kt, use_pallas=ids_ok)
+    if mode == "fused":
+        _note_fused_fallback()
+    return _ScanResolution("grouped_recon", lowered=False,
+                           n_groups=n_groups, kt=kt)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -139,6 +273,12 @@ class DistributedIndex:
     list_recon: jax.Array     # (n_dev, n_lists, cap, rot_dim) bf16
     metric: int = DistanceType.L2Expanded
     size: int = 0
+    # static PQ metadata (round 10): lets the sharded search run the
+    # traceable LUT formulation for codes/lut scan modes instead of
+    # lowering to probe-order recon.  Zero on legacy stacked pytrees,
+    # which keep the pre-round-10 fallback.
+    pq_bits: int = 0
+    codebook_kind: int = 0
     # per-shard recall canaries (tuple of integrity.CanarySet / None) —
     # host-side metadata, NOT a pytree leaf, so jax transforms drop it;
     # build / health_check carry it explicitly
@@ -151,11 +291,16 @@ class DistributedIndex:
     def tree_flatten(self):
         return ((self.centers, self.codebooks, self.list_codes,
                  self.list_indices, self.list_sizes, self.rotation,
-                 self.list_recon), (self.metric, self.size))
+                 self.list_recon),
+                (self.metric, self.size, self.pq_bits, self.codebook_kind))
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
-        return cls(*leaves, metric=aux[0], size=aux[1])
+        # aux may be the legacy (metric, size) pair — callers that
+        # round-trip flatten/unflatten through stored aux keep working
+        return cls(*leaves, metric=aux[0], size=aux[1],
+                   pq_bits=aux[2] if len(aux) > 2 else 0,
+                   codebook_kind=aux[3] if len(aux) > 3 else 0)
 
 
 def build(handle, params: ivf_pq.IndexParams, dataset, *,
@@ -244,7 +389,8 @@ def _build_impl(handle, params: ivf_pq.IndexParams,
 
         placed = _stack_leaves(per_shard_leaves, mesh, axis, devs)
         out = DistributedIndex.tree_unflatten(
-            (params.metric, n), tuple(placed))
+            (params.metric, n, int(locals_[0].pq_bits),
+             int(locals_[0].codebook_kind)), tuple(placed))
         out.shard_canaries = _collect_canaries(locals_, per,
                                                offset_ids=True)
         return out
@@ -352,7 +498,8 @@ def _build_spmd(handle, params: ivf_pq.IndexParams, dataset, mesh, axis,
         jnp.broadcast_to(rotation[None], (n_dev,) + rotation.shape),
         jax.sharding.NamedSharding(mesh, P(axis, None, None)))
     return DistributedIndex.tree_unflatten(
-        (params.metric, n),
+        (params.metric, n, int(params.pq_bits),
+         int(params.codebook_kind)),
         (centers_a, books_a, list_codes, list_indices, list_sizes,
          rot_stack, list_recon))
 
@@ -396,6 +543,105 @@ def _dist_search(index_leaves, queries, k, n_probes, metric, axis_name,
     return run(index_leaves, queries)
 
 
+def _recon_sq_stack(index: DistributedIndex) -> jax.Array:
+    """Stacked (n_dev, n_lists, cap) recon row norms, computed once and
+    cached on the index object (the stacked pytree has no recon_sq leaf;
+    the grouped scan's distance decomposition needs it)."""
+    rsq = getattr(index, "_list_recon_sq_stack", None)
+    if rsq is None:
+        rsq = ivf_pq._recon_sq(index.list_recon)
+        object.__setattr__(index, "_list_recon_sq_stack", rsq)
+    return rsq
+
+
+def _merge_gathered(ld, li, q, k, metric, axis_name, failed):
+    """Shared shard_map epilogue: degraded-shard masking, the k-bounded
+    all_gather, and the replicated merge-select (see :func:`_dist_search`
+    for the exactness argument)."""
+    select_min = metric != DistanceType.InnerProduct
+    if failed:
+        s = jax.lax.axis_index(axis_name)
+        bad = jnp.any(jnp.asarray(failed, jnp.int32) == s)
+        sentinel = jnp.inf if select_min else -jnp.inf
+        ld = jnp.where(bad, jnp.full_like(ld, sentinel), ld)
+        li = jnp.where(bad, jnp.full_like(li, -1), li)
+    all_d = jax.lax.all_gather(ld, axis_name)   # (n_dev, q, k)
+    all_i = jax.lax.all_gather(li, axis_name)
+    nq = q.shape[0]
+    # sqrt=False: the shard-local epilogue already applied it for the
+    # sqrt metrics, and the merge is monotone
+    return grouped.finalize_topk(
+        jnp.transpose(all_d, (1, 0, 2)), jnp.transpose(all_i, (1, 0, 2)),
+        nq, k, select_min, False, select_k)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "k", "kt", "n_probes", "metric", "axis_name", "mesh", "n_groups",
+    "form", "use_pallas", "failed"))
+def _dist_search_grouped(index_leaves, queries, k, kt, n_probes, metric,
+                         axis_name, mesh, n_groups, form,
+                         use_pallas=False, failed=()):
+    """Data-parallel grouped/fused scan under ``shard_map`` (round 10):
+    every shard runs the SAME formulation ladder the single-index search
+    picks, at the worst-case static group capacity — the capacity is a
+    pure function of (nq, n_probes, n_lists), so overflow is impossible
+    and this jitted function carries no overflow plumbing at all."""
+    specs = tuple(P(axis_name, *([None] * (leaf.ndim - 1)))
+                  for leaf in index_leaves)
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(specs, P()), out_specs=(P(), P()),
+                       check_vma=False)
+    def run(leaves, q):
+        centers, list_recon, list_recon_sq, list_indices, rotation = leaves
+        probes = ivf_pq._select_clusters(centers[0], rotation[0], q,
+                                         n_probes, metric)
+        cap, rot = list_recon.shape[2], list_recon.shape[3]
+        if form == "fused_recon":
+            ld, li = ivf_pq._search_impl_fused_recon_grouped(
+                centers[0], list_recon[0], list_recon_sq[0],
+                list_indices[0], rotation[0], q, probes, k, kt, metric,
+                n_groups)
+        else:
+            G = grouped.GROUP
+            block = grouped.block_size(n_groups, G * cap * 8,
+                                       cap * rot * 2, G * rot * 4)
+            ld, li = ivf_pq._search_impl_recon_grouped(
+                centers[0], list_recon[0], list_recon_sq[0],
+                list_indices[0], rotation[0], q, probes, k, metric,
+                n_groups, block, use_pallas=use_pallas, kt=kt)
+        return _merge_gathered(ld, li, q, k, metric, axis_name, failed)
+
+    return run(index_leaves, queries)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "k", "n_probes", "metric", "codebook_kind", "lut_dtype", "pq_bits",
+    "axis_name", "mesh", "failed"))
+def _dist_search_lut(index_leaves, queries, k, n_probes, metric,
+                     codebook_kind, lut_dtype, pq_bits, axis_name, mesh,
+                     failed=()):
+    """Data-parallel LUT scan under ``shard_map``: the traceable LUT
+    formulation computes the same quantized distance the codes kernel
+    streams, so a ``codes``/``lut`` request answers with code-domain
+    distances instead of lowering to the recon scan."""
+    specs = tuple(P(axis_name, *([None] * (leaf.ndim - 1)))
+                  for leaf in index_leaves)
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(specs, P()), out_specs=(P(), P()),
+                       check_vma=False)
+    def run(leaves, q):
+        centers, codebooks, list_codes, list_indices, rotation = leaves
+        ld, li = ivf_pq._search_impl(
+            centers[0], codebooks[0], list_codes[0], list_indices[0],
+            rotation[0], q, k, n_probes, metric, codebook_kind,
+            lut_dtype, pq_bits=pq_bits)
+        return _merge_gathered(ld, li, q, k, metric, axis_name, failed)
+
+    return run(index_leaves, queries)
+
+
 def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
            failed_shards: Sequence[int] = (),
            return_status: bool = False,
@@ -425,65 +671,139 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
     Transient faults at entry (site ``distributed.ann.search``) are
     retried under ``retry_policy`` / ``deadline``.
 
-    ``params.scan_mode`` threading: the shard-local scan runs *inside*
-    ``shard_map``, where the grouped Pallas kernels (including the fused
-    in-kernel top-k) cannot dispatch — their group construction is
-    batch-data-dependent and host-driven.  Every mode therefore lowers
-    to the traceable probe-order recon scan here; results are identical
-    in ranking semantics.  An explicit non-recon request is accepted but
-    reported: live shards answer :data:`SHARD_OK_FALLBACK` in the status
-    vector, the ``distributed.ann.scan_mode_lowered`` counter ticks, and
-    ``scan_mode="fused"`` additionally ticks
-    ``ivf_pq.search.fused_fallback`` (the pre-round-8 signal).
+    ``params.scan_mode`` threading (round 10): group construction is
+    traceable at the static capacity
+    :func:`raft_tpu.neighbors.grouped.group_capacity`, so the grouped
+    and fused scans lower under ``shard_map`` for both placements —
+    ``scan_mode="fused"`` (and ``"auto"`` on TPU) runs the same
+    formulation ladder the single-index search picks: the fused Pallas
+    kernels where the shape/VMEM gates pass, the XLA grouped twin
+    elsewhere (a missed kernel gate ticks ``ivf_pq.search.fused_fallback``
+    but is NOT a distributed lowering — the status stays
+    :data:`SHARD_OK`).  Data-parallel ``codes``/``lut`` requests run the
+    traceable LUT formulation (same quantized distance) when the stacked
+    pytree carries PQ metadata.  Only the genuinely unsupported
+    combinations lower to the probe-order recon scan — ``recon8`` (no
+    stacked int8 cache), code modes on a routed index without the code
+    leaves or on a legacy stacked pytree — and those report
+    :data:`SHARD_OK_FALLBACK` plus the
+    ``distributed.ann.scan_mode_lowered`` counter, exactly as before.
+
+    Routed fused dispatch is sync-free: an uncalibrated index runs at
+    the exact-safe worst-case capacity (zero host reads); a calibrated
+    index (``group_est`` from
+    :func:`raft_tpu.neighbors.ivf_pq.calibrate_group_capacity`, carried
+    through :func:`shard_by_list`) dispatches at the tightened capacity
+    and the per-shard true group counts ride the candidate all_gather —
+    only a batch whose probe skew exceeds the calibrated bound pays the
+    one host read plus an exact re-dispatch at the worst bound, counted
+    by ``ivf_pq.search.group_overflow``.
     """
     with named_range("distributed::ivf_pq_search"):
         expects(handle.comms_initialized(),
                 "distributed.ann.search: handle has no comms")
-        lowered = _scan_mode_lowered(params)
         comms = handle.get_comms()
         queries = ensure_array(queries, "queries")
         failed = _degraded_set(index.n_shards, failed_shards)
-        if isinstance(index, RoutedIndex):
-            n_probes = min(params.n_probes, index.n_lists)
-            sharded = (index.local_centers, index.list_recon,
-                       index.list_recon_sq, index.list_indices)
-            replicated = (index.coarse_centers, index.rotation,
-                          index.owner, index.local_slot)
-            d, i, scanned = _entry(
-                "distributed.ann.search",
-                lambda: _dist_search_routed(
-                    sharded, replicated, queries, int(k), n_probes,
-                    index.metric, comms.axis_name, handle.mesh,
-                    failed=failed),
-                retry_policy, deadline)
-        else:
-            n_probes = min(params.n_probes, index.centers.shape[1])
+        nq = int(queries.shape[0])
+        k = int(k)
+        routed = isinstance(index, RoutedIndex)
+        n_probes = min(params.n_probes,
+                       index.n_lists if routed else index.centers.shape[1])
+        r = _resolve_scan_mode(params, index, nq, n_probes, k)
+        scanned = None
+        if routed:
+            if r.form == "probe_recon":
+                sharded = (index.local_centers, index.list_recon,
+                           index.list_recon_sq, index.list_indices)
+                replicated = (index.coarse_centers, index.rotation,
+                              index.owner, index.local_slot)
+                d, i, scanned = _entry(
+                    "distributed.ann.search",
+                    lambda: _dist_search_routed(
+                        sharded, replicated, queries, k, n_probes,
+                        index.metric, comms.axis_name, handle.mesh,
+                        failed=failed),
+                    retry_policy, deadline)
+            else:
+                sharded, replicated = _routed_leaves(index, r.form)
+
+                def dispatch(ng):
+                    return _dist_search_routed_grouped(
+                        sharded, replicated, queries, k, r.kt, n_probes,
+                        index.metric, comms.axis_name, handle.mesh, ng,
+                        r.form, pq_bits=int(index.pq_bits),
+                        use_pallas=r.use_pallas, failed=failed)
+
+                d, i, scanned, needed = _entry(
+                    "distributed.ann.search",
+                    lambda: dispatch(r.n_groups), retry_policy, deadline)
+                if not r.exact:
+                    # calibrated-capacity regime: the ONE deliberate host
+                    # read of the routed path, AFTER the dispatch so it
+                    # overlaps the scan; almost every batch passes and
+                    # pays nothing further
+                    # graftlint: disable=host-sync -- overflow re-dispatch gate, not steady-state dispatch
+                    if int(jnp.max(needed)) > r.n_groups:
+                        from raft_tpu import observability as obs
+                        if obs.enabled():
+                            obs.registry().counter(
+                                "ivf_pq.search.group_overflow").inc()
+                        worst, _ = grouped.group_capacity(
+                            nq, n_probes, index.local_centers.shape[1])
+                        d, i, scanned, needed = dispatch(worst)
+        elif r.form == "probe_recon":
             leaves = (index.centers, index.list_indices, index.rotation,
                       index.list_recon)
-            scanned = None
             d, i = _entry(
                 "distributed.ann.search",
-                lambda: _dist_search(leaves, queries, int(k), n_probes,
+                lambda: _dist_search(leaves, queries, k, n_probes,
                                      index.metric, comms.axis_name,
                                      handle.mesh, failed=failed),
                 retry_policy, deadline)
+        elif r.form == "lut":
+            leaves = (index.centers, index.codebooks, index.list_codes,
+                      index.list_indices, index.rotation)
+            lut_dtype = jnp.dtype(
+                getattr(params, "lut_dtype", jnp.float32)).name
+            d, i = _entry(
+                "distributed.ann.search",
+                lambda: _dist_search_lut(
+                    leaves, queries, k, n_probes, index.metric,
+                    index.codebook_kind, lut_dtype,
+                    int(index.pq_bits), comms.axis_name, handle.mesh,
+                    failed=failed),
+                retry_policy, deadline)
+        else:
+            leaves = (index.centers, index.list_recon,
+                      _recon_sq_stack(index), index.list_indices,
+                      index.rotation)
+            d, i = _entry(
+                "distributed.ann.search",
+                lambda: _dist_search_grouped(
+                    leaves, queries, k, r.kt, n_probes, index.metric,
+                    comms.axis_name, handle.mesh, r.n_groups, r.form,
+                    use_pallas=r.use_pallas, failed=failed),
+                retry_policy, deadline)
         out = [d, i]
         if return_status:
-            out.append(_status_vector(index.n_shards, failed, lowered))
+            out.append(_status_vector(index.n_shards, failed, r.lowered))
         if return_stats:
             if scanned is None:
                 # data-parallel: every live shard scans its whole local
                 # index for every probe — n_probes lists of cap rows
                 cap = index.list_recon.shape[2]
-                per = np.full(index.n_shards,
-                              queries.shape[0] * n_probes * cap, np.int64)
+                per = np.full(index.n_shards, nq * n_probes * cap,
+                              np.int64)
                 per[list(failed)] = 0
-                gather = (index.n_shards, int(queries.shape[0]), int(k))
             else:
+                # graftlint: disable=host-sync -- opt-in stats readback (return_stats=True), not the serving dispatch
                 per = np.asarray(scanned, np.int64)
-                gather = (index.n_shards, int(queries.shape[0]), int(k))
+            gather = (index.n_shards, nq, k)
             out.append({"scanned_rows": per, "gather_shape": gather,
-                        "scan_mode": "recon", "n_probes": int(n_probes)})
+                        "scan_mode": {"probe_recon": "recon"}.get(
+                            r.form, r.form),
+                        "n_probes": int(n_probes)})
         return tuple(out) if len(out) > 2 else (d, i)
 
 
@@ -640,8 +960,19 @@ class RoutedIndex:
     list_recon_sq: jax.Array   # (n_dev, L+1, cap) — sharded
     list_indices: jax.Array    # (n_dev, L+1, cap) — sharded
     list_sizes: jax.Array      # (n_dev, L+1) — sharded
+    # optional lane-major code leaves (round 10): carried when the base
+    # index was codes-mode eligible, so the routed fused scan streams
+    # 4*ceil(W/4)+8 B/row instead of the 2*rot+8 recon rows.  None on
+    # indexes sharded before round 10 (and after a v1 deserialize).
+    codebooks: Optional[jax.Array] = None        # replicated
+    list_code_lanes: Optional[jax.Array] = None  # (n_dev, L+1, Wi, cap)
+    list_code_rsq: Optional[jax.Array] = None    # (n_dev, L+1, cap)
     metric: int = DistanceType.L2Expanded
     size: int = 0
+    pq_bits: int = 0
+    # calibrated group-capacity estimate (see ivf_pq.group_est); static
+    # aux so jit keys change when a recalibration tightens the capacity
+    group_est: float = 0.0
     # host-side metadata, NOT pytree leaves (transforms drop them; the
     # host wrappers carry them explicitly, like shard_canaries above)
     placement: Optional[Placement] = None
@@ -664,14 +995,20 @@ class RoutedIndex:
         return self.rotation.shape[0]
 
     def tree_flatten(self):
+        # the optional code leaves are pytree children too (None is an
+        # empty subtree, so pre-round-10 indexes flatten identically)
         return ((self.coarse_centers, self.rotation, self.owner,
                  self.local_slot, self.local_centers, self.list_recon,
-                 self.list_recon_sq, self.list_indices, self.list_sizes),
-                (self.metric, self.size))
+                 self.list_recon_sq, self.list_indices, self.list_sizes,
+                 self.codebooks, self.list_code_lanes,
+                 self.list_code_rsq),
+                (self.metric, self.size, self.pq_bits, self.group_est))
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
-        return cls(*leaves, metric=aux[0], size=aux[1])
+        return cls(*leaves, metric=aux[0], size=aux[1],
+                   pq_bits=aux[2] if len(aux) > 2 else 0,
+                   group_est=aux[3] if len(aux) > 3 else 0.0)
 
 
 def _mesh_layout(handle):
@@ -692,9 +1029,14 @@ def _replicate(arr, mesh):
 
 
 def _place_lists(handle, global_leaves, rotation, placement: Placement,
-                 metric, size) -> RoutedIndex:
+                 metric, size, code_leaves=None, pq_bits=0,
+                 group_est=0.0) -> RoutedIndex:
     """Assemble a :class:`RoutedIndex` from global per-list arrays
-    (centers, recon, recon_sq, indices, sizes) under ``placement``."""
+    (centers, recon, recon_sq, indices, sizes) under ``placement``.
+    ``code_leaves`` optionally carries (codebooks, list_code_lanes,
+    list_code_rsq) — the lane-major compact-code cache the routed fused
+    scan streams; the lanes/rsq shard like the recon leaves (axis 0 is
+    the global list id), the codebooks replicate."""
     centers, recon, rsq, li, sizes = global_leaves
     comms, mesh, axis, n_dev, devs = _mesh_layout(handle)
     expects(placement.n_shards == n_dev,
@@ -711,8 +1053,11 @@ def _place_lists(handle, global_leaves, rotation, placement: Placement,
             width = ((0, slots - sel.shape[0]),) + ((0, 0),) * (a.ndim - 1)
             return jnp.pad(sel, width, constant_values=fill)
 
-        per_shard.append((pad(centers, 0), pad(recon, 0), pad(rsq, 0),
-                          pad(li, -1), pad(sizes, 0)))
+        leaves_s = (pad(centers, 0), pad(recon, 0), pad(rsq, 0),
+                    pad(li, -1), pad(sizes, 0))
+        if code_leaves is not None:
+            leaves_s += (pad(code_leaves[1], 0), pad(code_leaves[2], 0))
+        per_shard.append(leaves_s)
     placed = _stack_leaves(per_shard, mesh, axis, devs)
     return RoutedIndex(
         coarse_centers=_replicate(centers, mesh),
@@ -721,8 +1066,13 @@ def _place_lists(handle, global_leaves, rotation, placement: Placement,
         local_slot=_replicate(jnp.asarray(placement.local_slot), mesh),
         local_centers=placed[0], list_recon=placed[1],
         list_recon_sq=placed[2], list_indices=placed[3],
-        list_sizes=placed[4], metric=metric, size=size,
-        placement=placement)
+        list_sizes=placed[4],
+        codebooks=(_replicate(code_leaves[0], mesh)
+                   if code_leaves is not None else None),
+        list_code_lanes=placed[5] if code_leaves is not None else None,
+        list_code_rsq=placed[6] if code_leaves is not None else None,
+        metric=metric, size=size, pq_bits=int(pq_bits),
+        group_est=float(group_est), placement=placement)
 
 
 def shard_by_list(handle, index, *,
@@ -749,12 +1099,29 @@ def shard_by_list(handle, index, *,
         if rsq is None:
             rsq = ivf_pq._recon_sq(index.list_recon)
         size = int(jnp.sum(index.list_sizes))
+        # carry the compact-code cache when the base index is eligible
+        # (the routed fused scan streams the lane-major codes at
+        # 4*ceil(W/4)+8 B/row instead of the 2*rot+8 recon rows)
+        code_leaves = None
+        pq_bits = 0
+        if ivf_pq._codes_mode_eligible(index):
+            if (index.list_code_lanes is None
+                    or index.list_code_rsq is None):
+                index = ivf_pq._with_code_lanes(index)
+            code_leaves = (index.codebooks, index.list_code_lanes,
+                           index.list_code_rsq)
+            pq_bits = int(index.pq_bits)
         out = _place_lists(
             handle, (index.centers, index.list_recon, rsq,
                      index.list_indices, index.list_sizes),
-            index.rotation, placement, index.metric, size)
+            index.rotation, placement, index.metric, size,
+            code_leaves=code_leaves, pq_bits=pq_bits,
+            group_est=float(getattr(index, "group_est", 0.0)))
         out.canaries = getattr(index, "canaries", None)
         out.generation = _mutate.generation(index)
+        # precompute the fused kernels' id-exactness verdict now (one
+        # tiny host sync at shard time) so search dispatch never syncs
+        grouped.ids_f32_exact(out, out.list_indices)
         return out
 
 
@@ -790,7 +1157,11 @@ def _gather_global(index: RoutedIndex):
     rsq = index.list_recon_sq[own, slot]
     li = index.list_indices[own, slot]
     sizes = index.list_sizes[own, slot]
-    return centers, recon, rsq, li, sizes
+    code_leaves = None
+    if index.list_code_lanes is not None:
+        code_leaves = (index.codebooks, index.list_code_lanes[own, slot],
+                       index.list_code_rsq[own, slot])
+    return centers, recon, rsq, li, sizes, code_leaves
 
 
 @functools.partial(jax.jit, static_argnames=("k", "n_probes", "metric",
@@ -855,6 +1226,111 @@ def _dist_search_routed(sharded, replicated, queries, k, n_probes, metric,
     return run(sharded, replicated, queries)
 
 
+def _routed_leaves(index: "RoutedIndex", form: str):
+    """(sharded, replicated) leaf tuples for the routed grouped dispatch.
+    ``fused_codes`` threads the lane-major code cache where the recon
+    forms thread the bf16 reconstructions — the kernels share positional
+    structure (data, row-norms), so ONE jitted dispatcher serves both."""
+    if form == "fused_codes":
+        sharded = (index.local_centers, index.list_code_lanes,
+                   index.list_code_rsq, index.list_indices)
+        replicated = (index.coarse_centers, index.rotation, index.owner,
+                      index.local_slot, index.codebooks)
+    else:
+        sharded = (index.local_centers, index.list_recon,
+                   index.list_recon_sq, index.list_indices)
+        replicated = (index.coarse_centers, index.rotation, index.owner,
+                      index.local_slot)
+    return sharded, replicated
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "k", "kt", "n_probes", "metric", "axis_name", "mesh", "n_groups",
+    "form", "pq_bits", "use_pallas", "failed"))
+def _dist_search_routed_grouped(sharded, replicated, queries, k, kt,
+                                n_probes, metric, axis_name, mesh,
+                                n_groups, form, pq_bits=0,
+                                use_pallas=False, failed=()):
+    """Routed (by_list) grouped/fused scan under ``shard_map``
+    (round 10): the tentpole dispatch.  Replicated coarse routing picks
+    the probe set, ownership maps it to local slots, and the shard scans
+    its owned probes with the grouped formulation at the static capacity
+    ``n_groups`` — the fused code scan streams 4*ceil(W/4)+8 B/row where
+    the probe-order recon scan streamed 2*rot+8 (264 -> 72 at the bench
+    shape).  Alongside the k-bounded candidate exchange, each shard
+    all_gathers its true required group count so the HOST can check the
+    calibrated capacity without a second collective; the check itself
+    (and the rare exact re-dispatch) lives in :func:`search`, keeping
+    this function sync-free."""
+    sspecs = tuple(P(axis_name, *([None] * (leaf.ndim - 1)))
+                   for leaf in sharded)
+    rspecs = tuple(P() for _ in replicated)
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(sspecs, rspecs, P()),
+                       out_specs=(P(), P(), P(), P()),
+                       check_vma=False)
+    def run(sl, rl, q):
+        local_centers, data, rownorm, list_indices = sl
+        coarse, rot, owner, local_slot = rl[:4]
+        s = jax.lax.axis_index(axis_name)
+        slots = local_centers.shape[1]
+        cap = list_indices.shape[2]
+        probes = ivf_pq._select_clusters(coarse, rot, q, n_probes, metric)
+        owned = owner[probes] == s                       # (q, n_probes)
+        # unowned probes map to the OUT-OF-RANGE sentinel slot id
+        # (== slots), NOT the dummy slot: build_groups drops sentinel
+        # probes from the pair groups entirely, so they cost no group
+        # slots.  Mapping them to the dummy slot (the probe-order path's
+        # trick) would funnel ~(1 - 1/n_shards) of all pairs into ONE
+        # list and blow any calibrated capacity.
+        local_probes = jnp.where(owned, local_slot[probes],
+                                 slots).astype(jnp.int32)
+        if form == "fused_codes":
+            ld, li = ivf_pq._search_impl_fused_codes_grouped(
+                local_centers[0], rl[4], data[0], rownorm[0],
+                list_indices[0], rot, q, local_probes, k, kt, metric,
+                n_groups, pq_bits)
+        elif form == "fused_recon":
+            ld, li = ivf_pq._search_impl_fused_recon_grouped(
+                local_centers[0], data[0], rownorm[0], list_indices[0],
+                rot, q, local_probes, k, kt, metric, n_groups)
+        else:
+            rot_dim = data.shape[3]
+            G = grouped.GROUP
+            block = grouped.block_size(n_groups, G * cap * 8,
+                                       cap * rot_dim * 2, G * rot_dim * 4)
+            ld, li = ivf_pq._search_impl_recon_grouped(
+                local_centers[0], data[0], rownorm[0], list_indices[0],
+                rot, q, local_probes, k, metric, n_groups, block,
+                use_pallas=use_pallas, kt=kt)
+        select_min = metric != DistanceType.InnerProduct
+        scanned = (jnp.sum(owned.astype(jnp.int32)) * cap).astype(
+            jnp.int32)
+        # the shard's TRUE group requirement — the in-graph overflow
+        # count the calibrated-capacity regime is checked against
+        needed = grouped.num_groups(local_probes, slots)
+        if failed:
+            bad = jnp.any(jnp.asarray(failed, jnp.int32) == s)
+            sentinel = jnp.inf if select_min else -jnp.inf
+            ld = jnp.where(bad, jnp.full_like(ld, sentinel), ld)
+            li = jnp.where(bad, jnp.full_like(li, -1), li)
+            scanned = jnp.where(bad, 0, scanned)
+            needed = jnp.where(bad, 0, needed)
+        all_d = jax.lax.all_gather(ld, axis_name)        # (n_dev, q, k)
+        all_i = jax.lax.all_gather(li, axis_name)
+        all_scanned = jax.lax.all_gather(scanned, axis_name)  # (n_dev,)
+        all_needed = jax.lax.all_gather(needed, axis_name)    # (n_dev,)
+        nq = q.shape[0]
+        md, mi = grouped.finalize_topk(
+            jnp.transpose(all_d, (1, 0, 2)),
+            jnp.transpose(all_i, (1, 0, 2)),
+            nq, k, select_min, False, select_k)
+        return md, mi, all_scanned, all_needed
+
+    return run(sharded, replicated, queries)
+
+
 def rebalance_placement(handle, index: RoutedIndex, *,
                         placement: Optional[Placement] = None
                         ) -> RoutedIndex:
@@ -871,7 +1347,7 @@ def rebalance_placement(handle, index: RoutedIndex, *,
         expects(index.placement is not None,
                 "distributed.ann.rebalance_placement: index carries no "
                 "placement map")
-        centers, recon, rsq, li, sizes = _gather_global(index)
+        centers, recon, rsq, li, sizes, code_leaves = _gather_global(index)
         if placement is None:
             live = jnp.sum(li >= 0, axis=1).astype(jnp.int32)
             placement = compute_placement(
@@ -879,13 +1355,19 @@ def rebalance_placement(handle, index: RoutedIndex, *,
                 generation=index.placement.generation + 1)
         out = _place_lists(handle, (centers, recon, rsq, li, sizes),
                            index.rotation, placement, index.metric,
-                           index.size)
+                           index.size, code_leaves=code_leaves,
+                           pq_bits=index.pq_bits,
+                           group_est=index.group_est)
         out.canaries = index.canaries
         _mutate.next_generation(index, out)
         return out
 
 
-_ROUTED_SERIALIZATION_VERSION = 1
+# v2 (round 10): trailing (has_codes, pq_bits, group_est) block and,
+# when has_codes, the lane-major compact-code cache (codebooks, lanes,
+# row norms) — v1 streams read fine and land uncalibrated/recon-only
+_ROUTED_SERIALIZATION_VERSION = 2
+_ROUTED_MIN_READ_VERSION = 1
 
 
 def serialize_routed(res, stream, index: RoutedIndex) -> None:
@@ -897,7 +1379,7 @@ def serialize_routed(res, stream, index: RoutedIndex) -> None:
     expects(index.placement is not None,
             "distributed.ann.serialize_routed: index carries no "
             "placement map")
-    centers, recon, rsq, li, sizes = _gather_global(index)
+    centers, recon, rsq, li, sizes, code_leaves = _gather_global(index)
     with ser.enveloped_writer(stream) as body:
         ser.serialize_scalar(
             res, body, np.int32(_ROUTED_SERIALIZATION_VERSION))
@@ -913,19 +1395,32 @@ def serialize_routed(res, stream, index: RoutedIndex) -> None:
         ser.serialize_mdspan(res, body, li)
         ser.serialize_mdspan(res, body, sizes)
         ser.serialize_mdspan(res, body, index.rotation)
+        ser.serialize_scalar(
+            res, body, np.int32(1 if code_leaves is not None else 0))
+        ser.serialize_scalar(res, body, np.int32(index.pq_bits))
+        ser.serialize_scalar(res, body, np.float64(index.group_est))
+        if code_leaves is not None:
+            books, lanes, crsq = code_leaves
+            ser.serialize_mdspan(res, body, books)
+            ser.serialize_mdspan(res, body, lanes)
+            ser.serialize_mdspan(res, body, crsq)
         from raft_tpu.integrity import canary as _canary
         _canary.to_stream(res, body, index.canaries)
 
 
 def deserialize_routed(handle, stream) -> RoutedIndex:
     """Reload a routed index onto the handle's mesh under its stored
-    placement (the mesh must match the stored shard count)."""
+    placement (the mesh must match the stored shard count).  v1 streams
+    (pre round 10) load recon-only and uncalibrated — always correct,
+    just without the fused code scan and tightened capacity."""
     body = ser.open_envelope(stream)
     version = int(ser.deserialize_scalar(handle, body))
-    if version != _ROUTED_SERIALIZATION_VERSION:
+    if not (_ROUTED_MIN_READ_VERSION <= version
+            <= _ROUTED_SERIALIZATION_VERSION):
         raise ValueError(
             f"routed serialization version mismatch: got {version}, "
-            f"expected {_ROUTED_SERIALIZATION_VERSION}")
+            f"expected {_ROUTED_MIN_READ_VERSION}.."
+            f"{_ROUTED_SERIALIZATION_VERSION}")
     metric = int(ser.deserialize_scalar(handle, body))
     size = int(ser.deserialize_scalar(handle, body))
     generation = int(ser.deserialize_scalar(handle, body))
@@ -937,10 +1432,24 @@ def deserialize_routed(handle, stream) -> RoutedIndex:
     li = jnp.asarray(ser.deserialize_mdspan(handle, body))
     sizes = jnp.asarray(ser.deserialize_mdspan(handle, body))
     rotation = jnp.asarray(ser.deserialize_mdspan(handle, body))
+    code_leaves = None
+    pq_bits = 0
+    group_est = 0.0
+    if version >= 2:
+        has_codes = int(ser.deserialize_scalar(handle, body))
+        pq_bits = int(ser.deserialize_scalar(handle, body))
+        group_est = float(ser.deserialize_scalar(handle, body))
+        if has_codes:
+            books = jnp.asarray(ser.deserialize_mdspan(handle, body))
+            lanes = jnp.asarray(ser.deserialize_mdspan(handle, body))
+            crsq = jnp.asarray(ser.deserialize_mdspan(handle, body))
+            code_leaves = (books, lanes, crsq)
     from raft_tpu.integrity import canary as _canary
     canaries = _canary.from_stream(handle, body)
     out = _place_lists(handle, (centers, recon, rsq, li, sizes),
-                       rotation, placement, metric, size)
+                       rotation, placement, metric, size,
+                       code_leaves=code_leaves, pq_bits=pq_bits,
+                       group_est=group_est)
     out.canaries = canaries
     out.generation = generation
     return out

@@ -20,10 +20,15 @@ lists get several groups instead of widening every bucket.  Padding
 overhead is bounded by ``n_lists·G/2`` slots total (~16% at bench shapes),
 independent of skew.
 
-The number of groups is data-dependent; callers host-sync it (an
-(n_lists,)-reduction — the same O(1) transfer the qcap design needed) and
-pass it as a static arg, rounded up so per-batch variation reuses the
-compiled executable.
+The number of groups a batch *needs* is data-dependent, but dispatch no
+longer syncs it (round 10): :func:`group_capacity` gives a static,
+shape-only bound — ``ceil(P/G) + n_touched_lists`` — at which
+:func:`build_groups` provably cannot drop a pair, so the grouped scans
+are fully traceable (they lower under ``jit`` and ``shard_map``) and a
+warmed executable serves every batch at that shape.  A calibrated
+per-index estimate tightens the touched-lists term; only then is an
+in-graph overflow count armed, read *after* the scan is enqueued, and
+the rare overflowing batch re-dispatches at the exact-safe bound.
 """
 
 from __future__ import annotations
@@ -41,8 +46,12 @@ _GROUP_ROUND = 256   # n_groups rounding quantum (compile-cache stability)
 
 
 def num_groups(probes: jax.Array, n_lists: int) -> jax.Array:
-    """Total fixed-size groups needed: sum over lists of ceil(count/G).
-    Callers host-sync this scalar and pass it to :func:`round_groups`."""
+    """Total fixed-size groups this batch needs: sum over lists of
+    ceil(count/G).  Probes ``>= n_lists`` (sentinels) are excluded by the
+    segment reduction, matching what :func:`build_groups` lays out.  The
+    dispatch path no longer syncs this (see :func:`group_capacity`); it
+    remains the calibrated regime's overflow count and the measurement
+    :func:`raft_tpu.neighbors.ivf_pq.calibrate_group_capacity` reads."""
     counts = jax.ops.segment_sum(
         jnp.ones(probes.size, jnp.int32), probes.reshape(-1),
         num_segments=n_lists)
@@ -52,9 +61,58 @@ def num_groups(probes: jax.Array, n_lists: int) -> jax.Array:
 num_groups = jax.jit(num_groups, static_argnames=("n_lists",))
 
 
+@functools.partial(jax.jit, static_argnames=("n_lists",))
+def touched_lists(probes: jax.Array, n_lists: int) -> jax.Array:
+    """Distinct in-range lists the batch probes — the quantity
+    :func:`group_capacity`'s calibrated estimate models."""
+    counts = jax.ops.segment_sum(
+        jnp.ones(probes.size, jnp.int32), probes.reshape(-1),
+        num_segments=n_lists)
+    return jnp.sum((counts > 0).astype(jnp.int32))
+
+
 def round_groups(n: int) -> int:
-    """Round the host-synced group count for executable reuse."""
+    """Round a group count up to the compile-cache quantum."""
     return -(-max(n, 1) // _GROUP_ROUND) * _GROUP_ROUND
+
+
+# estimate safety margin: a calibrated capacity covers probe
+# distributions that touch up to 25% more lists than measured before the
+# overflow re-dispatch path triggers
+_EST_MARGIN = 1.25
+
+
+def group_capacity(nq: int, n_probes: int, n_lists: int,
+                   est: float = 0.0) -> Tuple[int, bool]:
+    """Static group capacity for dispatching :func:`build_groups` at a
+    traceable shape.  Returns ``(capacity, exact)``.
+
+    Worst case: with ``P = nq * n_probes`` pairs, each touched list
+    wastes at most one partial group, so
+    ``sum_l ceil(c_l/G) <= ceil(P/G) + n_touched`` and
+    ``n_touched <= min(n_lists, P)``.  Dispatching at that bound can
+    NEVER drop a pair — ``exact=True`` means no overflow machinery (and
+    no host sync of any kind) is needed.
+
+    ``est`` (the calibrated fraction of ``min(n_lists, P)`` a real batch
+    touches, measured by ``ivf_pq.calibrate_group_capacity`` and carried
+    in the index envelope) tightens the touched-lists term under a 25%
+    safety margin.  The tightened capacity is rounded
+    (:func:`round_groups`) so nearby estimates share executables and
+    clamped to the worst bound; when it lands below the bound,
+    ``exact=False`` tells the caller to arm the in-graph overflow count
+    and re-dispatch at the worst bound if exceeded.
+    """
+    P = nq * n_probes
+    if P <= 0:
+        return 1, True
+    touched_worst = min(n_lists, P)
+    worst = -(-P // GROUP) + touched_worst
+    if est <= 0.0:
+        return worst, True
+    touched = min(int(est * _EST_MARGIN * touched_worst) + 1, touched_worst)
+    capacity = min(round_groups(-(-P // GROUP) + touched), worst)
+    return capacity, capacity >= worst
 
 
 def ids_f32_exact(index_obj, list_indices: jax.Array) -> bool:
@@ -74,48 +132,6 @@ def ids_f32_exact(index_obj, list_indices: jax.Array) -> bool:
         cached = max_abs < (1 << 24)
         object.__setattr__(index_obj, "_ids_f32_exact", cached)
     return cached
-
-
-def cached_groups(index_obj, key, probes: jax.Array, n_lists: int):
-    """Group count for dispatch, avoiding a per-batch host sync.
-
-    First call per ``key`` (= (nq, n_probes)) blocks on the tiny
-    (n_lists,)-reduction and caches the rounded count on the index object.
-    Subsequent calls dispatch with the cached value immediately and return
-    the in-flight device count as ``pending``; the caller hands it to
-    :func:`commit_groups` *after* enqueueing the search, where the host
-    read only waits for the already-finished reduction — the pipeline
-    never stalls on it.  If the read reveals the batch actually needed
-    more groups than the cache (probe-distribution shift), commit_groups
-    reports it and the caller re-dispatches with the corrected count —
-    results stay exact in every case; only shift batches pay a second
-    pass.  The cache grows monotonically (max) so the re-dispatch happens
-    at most once per shift.
-    """
-    cache = getattr(index_obj, "_group_cache", None)
-    if cache is None:
-        cache = {}
-        object.__setattr__(index_obj, "_group_cache", cache)
-    count_dev = num_groups(probes, n_lists)
-    if key in cache:
-        return cache[key], count_dev
-    cache[key] = round_groups(int(count_dev))
-    return cache[key], None
-
-
-def commit_groups(index_obj, key, pending) -> int:
-    """Fold an in-flight group count into the cache (see cached_groups).
-
-    Returns the batch's true rounded group count if it EXCEEDED the value
-    the caller dispatched with (caller must re-dispatch at that size for
-    exact results), else 0."""
-    if pending is None:
-        return 0
-    cache = index_obj._group_cache
-    dispatched = cache[key]
-    true_n = round_groups(int(pending))
-    cache[key] = max(dispatched, true_n)
-    return true_n if true_n > dispatched else 0
 
 
 def build_groups(probes: jax.Array, n_lists: int, n_groups: int
@@ -183,12 +199,21 @@ def probe_overlap_order(probes: jax.Array, n_lists: int) -> jax.Array:
         # degenerate batch (no probes — e.g. every list emptied by
         # delete/compaction upstream): identity order, nothing to cluster
         return jnp.arange(nq, dtype=jnp.int32)
-    r0 = probes[:, 0].astype(jnp.int32)
-    r1 = probes[:, min(1, n_probes - 1)].astype(jnp.int32)
-    # n_lists^2 fits int32 up to 46k lists; clamp sentinels (>= n_lists,
-    # from super-tile dedupe) into range so the key stays monotone
-    key = jnp.minimum(r0, n_lists) * (n_lists + 1) + jnp.minimum(r1, n_lists)
-    return jnp.argsort(key).astype(jnp.int32)
+    r0 = jnp.minimum(probes[:, 0].astype(jnp.int32), n_lists)
+    r1 = jnp.minimum(probes[:, min(1, n_probes - 1)].astype(jnp.int32),
+                     n_lists)
+    # sentinels (>= n_lists, from super-tile dedupe) clamp into range so
+    # the ordering stays monotone
+    if n_lists + 1 <= 46340:
+        # (n_lists+1)^2 fits int32: one fused sort key
+        key = r0 * (n_lists + 1) + r1
+        return jnp.argsort(key).astype(jnp.int32)
+    # above ~46k lists the packed key wraps int32 (and x64 is disabled
+    # by default, so an int64 key would silently downcast): lexsort via
+    # two STABLE passes — secondary key first, primary second
+    o1 = jnp.argsort(r1, stable=True)
+    o0 = jnp.argsort(r0[o1], stable=True)
+    return o1[o0].astype(jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnames=("factor", "n_super"))

@@ -762,29 +762,26 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
                 index.list_indices
             dsq_eff, centers_eff = dsq, index.centers
 
-        gkey = (queries.shape[0], n_probes, F)
-        n_groups, pending = grouped.cached_groups(
-            index, gkey, probes_eff, n_lists_eff)
+        # static group capacity (round 10): the worst-case bound
+        # ceil(P/G) + n_touched is exact-safe — no pair can drop at it —
+        # so dispatch needs no host-synced group count, the shape is a
+        # pure function of (nq, n_probes, n_lists_eff), and one warmed
+        # executable serves every batch at the shape (the old
+        # cached_groups ratchet recompiled on probe-distribution shift)
+        n_groups, _ = grouped.group_capacity(
+            queries.shape[0], n_probes, n_lists_eff)
         G = grouped.GROUP
-
-        def dispatch(ng):
-            block = grouped.block_size(
-                ng,
-                G * F * cap * 8,            # fp32 distances + broadcast ids
-                (F * cap + G) * index.dim * 4)  # data slice + query gather
-            return _search_impl_grouped(centers_eff, data_eff,
-                                        ids_eff, queries, probes_eff,
-                                        k, index.metric, ng, block,
-                                        list_data_sq=dsq_eff,
-                                        use_pallas=use_pallas)
+        block = grouped.block_size(
+            n_groups,
+            G * F * cap * 8,            # fp32 distances + broadcast ids
+            (F * cap + G) * index.dim * 4)  # data slice + query gather
 
         with obs.stage("ivf_flat.search.scan") as st:
-            out = dispatch(n_groups)
-            needed = grouped.commit_groups(index, gkey, pending)
-            if needed:
-                # probe distribution shifted past the cached group count:
-                # re-dispatch at the true size so no pair is dropped
-                out = dispatch(needed)
+            out = _search_impl_grouped(centers_eff, data_eff,
+                                       ids_eff, queries, probes_eff,
+                                       k, index.metric, n_groups, block,
+                                       list_data_sq=dsq_eff,
+                                       use_pallas=use_pallas)
             st.fence(out)
         return out
 

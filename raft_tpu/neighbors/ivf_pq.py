@@ -227,6 +227,13 @@ class Index:
     # canaries — a leaf would be wrong and aux would force a retrace per
     # mutation.  extend/delete/compact stamp parent+1 on the new index.
     generation: int = 0
+    # Calibrated group-capacity estimate (round 10): the measured
+    # fraction of min(n_lists, P) lists a representative batch's probes
+    # touch (see :func:`calibrate_group_capacity`).  0.0 = uncalibrated,
+    # which dispatches the grouped scans at the exact-safe worst-case
+    # capacity — zero host syncs, no overflow machinery.  Host-side like
+    # generation; serialized (v4) through the index envelope.
+    group_est: float = 0.0
 
     @property
     def n_lists(self) -> int:
@@ -1576,11 +1583,13 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
         tracing = (isinstance(queries, jax.core.Tracer)
                    or isinstance(index.centers, jax.core.Tracer))
         if tracing:
-            # queries or the Index pytree traced by an outer jit/vmap: the
-            # grouped dispatches need a host-side group count — use the
-            # fully traceable probe-order formulations instead (the LUT
-            # scan computes the same quantized distance as the codes
-            # kernel, so AOT-exported "codes" searches stay exact)
+            # queries or the Index pytree traced by an outer jit/vmap:
+            # the grouped dispatch itself is shape-static since round 10,
+            # but a calibrated index's overflow re-dispatch gate is a
+            # host read that cannot run under a trace — use the fully
+            # traceable probe-order formulations instead (the LUT scan
+            # computes the same quantized distance as the codes kernel,
+            # so AOT-exported "codes" searches stay exact)
             if mode in ("recon", "recon8") and index.list_recon is not None:
                 return _search_impl_recon(
                     index.centers, index.list_recon, index.list_indices,
@@ -1676,21 +1685,33 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
                                       recall_target=coarse_rt,
                                       exact=exact_coarse)
             st.fence(probes)
-        # group count is data-dependent; cached_groups avoids a
-        # per-batch host sync (measured ~125 ms over the remote tunnel)
-        gkey = (nq, n_probes)
-        n_groups, pending = grouped.cached_groups(
-            index, gkey, probes, index.n_lists)
+        # static group capacity (round 10): uncalibrated indexes dispatch
+        # at the exact-safe worst-case bound — the shape depends only on
+        # (nq, n_probes, n_lists), so NO host sync of a group count
+        # exists anywhere on this path and one warmed executable serves
+        # every batch at the shape.  A calibrated index (group_est > 0)
+        # dispatches at the tightened capacity and arms the in-graph
+        # overflow count, enqueued BEFORE the scan so the read overlaps
+        # the scan's execution; only the rare batch whose probe skew
+        # exceeds the calibrated bound pays a second pass.
+        n_groups, exact = grouped.group_capacity(
+            nq, n_probes, index.n_lists, est=index.group_est)
+        needed_dev = (None if exact
+                      else grouped.num_groups(probes, index.n_lists))
 
         def run_grouped(stage_label, dispatch):
             with obs.stage(stage_label) as st:
                 out = dispatch(n_groups)
-                needed = grouped.commit_groups(index, gkey, pending)
-                if needed:
-                    # probe distribution shifted past the cached group
-                    # count: re-dispatch at the true size so no pair is
-                    # dropped
-                    out = dispatch(needed)
+                if needed_dev is not None and int(needed_dev) > n_groups:
+                    # calibrated capacity exceeded: tick the overflow
+                    # counter and re-dispatch at the worst-case bound,
+                    # where no pair can drop — results stay exact
+                    if obs.enabled():
+                        obs.registry().counter(
+                            "ivf_pq.search.group_overflow").inc()
+                    worst, _ = grouped.group_capacity(
+                        nq, n_probes, index.n_lists)
+                    out = dispatch(worst)
                 st.fence(out)
             return out
 
@@ -1769,13 +1790,53 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
         return run_grouped("ivf_pq.search.scan", dispatch)
 
 
+def calibrate_group_capacity(res, index: Index, queries,
+                             n_probes: int) -> float:
+    """Measure the grouped-scan capacity estimate on a representative
+    query batch and store it on the index (round 10).
+
+    The grouped dispatch needs a static group count; without calibration
+    it uses the exact-safe worst case ``ceil(P/G) + min(n_lists, P)``
+    (see :func:`raft_tpu.neighbors.grouped.group_capacity`).  Real probe
+    distributions touch far fewer lists than the bound assumes, so this
+    measures the touched-list fraction under the index's own coarse
+    router and records it as ``index.group_est`` — searches then
+    dispatch at the tightened capacity with the in-graph overflow
+    fallback armed.  Repeated calls ratchet the estimate upward (max),
+    so calibrating on several batches converges to the widest observed
+    distribution.  The estimate rides the serialization envelope (v4);
+    loading a pre-v4 stream leaves the index uncalibrated, which is
+    always correct (worst-bound dispatch).
+
+    Returns the stored estimate (a fraction of ``min(n_lists, P)``).
+    """
+    from raft_tpu.neighbors import grouped
+
+    queries = ensure_array(queries, "queries")
+    expects(queries.ndim == 2 and queries.shape[1] == index.dim,
+            "ivf_pq.calibrate_group_capacity: queries must be "
+            f"(n, {index.dim})")
+    n_probes = min(int(n_probes), index.n_lists)
+    expects(n_probes >= 1,
+            "ivf_pq.calibrate_group_capacity: n_probes must be >= 1")
+    probes = _select_clusters(index.centers, index.rotation,
+                              jnp.asarray(queries), n_probes, index.metric)
+    P = int(queries.shape[0]) * n_probes
+    touched = int(grouped.touched_lists(probes, index.n_lists))
+    est = touched / max(min(index.n_lists, P), 1)
+    index.group_est = max(float(index.group_est), est)
+    return index.group_est
+
+
 # ---------------------------------------------------------------------------
 # serialization (reference: ivf_pq_serialize.cuh:38 kSerializationVersion)
 # ---------------------------------------------------------------------------
 
 # v2: list_codes are bit-packed; pq_dim is stored explicitly
 # v3: trailing recall-canary block (nested envelope, may be absent)
-_SERIALIZATION_VERSION = 3
+# v4: calibrated group-capacity estimate (group_est float64 scalar)
+#     between the fixed header and the mdspans
+_SERIALIZATION_VERSION = 4
 _MIN_READ_VERSION = 2
 
 
@@ -1787,6 +1848,7 @@ def serialize(res, stream: BinaryIO, index: Index) -> None:
         ser.serialize_scalar(res, body, np.int32(index.codebook_kind))
         ser.serialize_scalar(res, body, np.int32(index.pq_bits))
         ser.serialize_scalar(res, body, np.int32(index.pq_dim))
+        ser.serialize_scalar(res, body, np.float64(index.group_est))
         for arr in (index.centers, index.codebooks, index.list_codes,
                     index.list_indices, index.list_sizes, index.rotation):
             ser.serialize_mdspan(res, body, arr)
@@ -1807,10 +1869,14 @@ def deserialize(res, stream: BinaryIO, *,
     kind = int(ser.deserialize_scalar(res, body))
     pq_bits = int(ser.deserialize_scalar(res, body))
     pq_dim = int(ser.deserialize_scalar(res, body))
+    # back-compat read window: pre-v4 streams carry no capacity estimate
+    # — the index loads uncalibrated (worst-bound dispatch, always safe)
+    group_est = (float(ser.deserialize_scalar(res, body))
+                 if version >= 4 else 0.0)
     arrays = [jnp.asarray(ser.deserialize_mdspan(res, body))
               for _ in range(6)]
     index = Index(*arrays, metric=metric, codebook_kind=kind,
-                  pq_bits=pq_bits, pq_dim_=pq_dim)
+                  pq_bits=pq_bits, pq_dim_=pq_dim, group_est=group_est)
     if version >= 3:
         index.canaries = _canary.from_stream(res, body)
     # the reconstruction cache is derived state: re-decode from codes —

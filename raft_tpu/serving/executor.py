@@ -17,10 +17,18 @@ Two warm paths:
     calibration-dependent fallback walk).
 ``"jit"``
     The live module search functions, warmed by calling each bucket
-    shape once.  The only choice for distributed indexes (shard_map
-    closures over a mesh are not exportable) — degraded-mode shard
-    masking and post-load ``health_check`` compose unchanged because the
-    executor calls the same public entry points.
+    shape once.  The choice for distributed indexes (the cross-shard
+    merge is a shard_map closure over a mesh, not exportable) —
+    degraded-mode shard masking and post-load ``health_check`` compose
+    unchanged because the executor calls the same public entry points.
+    Since round 10 group construction under the routed path is
+    shape-static (a static group capacity rides in the compiled shape
+    instead of a host-synced count), so a warmed distributed bucket
+    dispatches with ZERO host syncs — same steady-state contract as the
+    local AOT path.  Per-shard routed programs (including the fused
+    grouped scan at static capacity) ARE exportable individually via
+    :class:`~raft_tpu.core.aot.ExecutableCache` kind ``"ivf_pq_routed"``
+    — see :meth:`DistributedExecutor.prewarm_shard_artifacts`.
 
 Padded rows are flagged through the integrity mask path
 (:func:`~raft_tpu.integrity.boundary.mask_search_outputs`): id -1 /
@@ -257,16 +265,25 @@ class DistributedExecutor(Executor):
     search routes each query's probes to owning shards via the
     replicated placement map.
 
-    Always ``warm="jit"`` (shard_map closures are not exportable).  The
-    resilience surface passes through untouched: ``failed_shards`` /
-    fault-plan masking and per-shard status behave exactly as in direct
-    :func:`raft_tpu.distributed.ann.search` calls, and post-load
-    :func:`raft_tpu.distributed.ann.health_check` works on the wrapped
-    index because the executor never copies or re-wraps it.  Under
-    ``by_list`` a ``swap_index`` to a rebalanced snapshot is the global
-    generation barrier: the warmed fn table is rebuilt completely
+    Always ``warm="jit"`` (the cross-shard merge is a shard_map closure,
+    not exportable).  The resilience surface passes through untouched:
+    ``failed_shards`` / fault-plan masking and per-shard status behave
+    exactly as in direct :func:`raft_tpu.distributed.ann.search` calls,
+    and post-load :func:`raft_tpu.distributed.ann.health_check` works on
+    the wrapped index because the executor never copies or re-wraps it.
+    Under ``by_list`` a ``swap_index`` to a rebalanced snapshot is the
+    global generation barrier: the warmed fn table is rebuilt completely
     against the new placement before the single atomic swap, so no
     request ever mixes placements.
+
+    Zero-sync steady state (round 10): ``scan_mode="fused"`` lowers
+    under shard_map at a static group capacity, so a warmed bucket
+    dispatch reads nothing back to the host.  The one exception is an
+    index calibrated with a tightened capacity
+    (:func:`raft_tpu.neighbors.ivf_pq.calibrate_group_capacity`): its
+    dispatch carries an in-graph overflow flag whose single host read
+    gates the exact re-dispatch — uncalibrated indexes run at the exact
+    worst bound and never read it.
     """
 
     def __init__(self, handle, index, *, ks: Sequence[int] = (10,),
@@ -292,6 +309,41 @@ class DistributedExecutor(Executor):
 
     def _aot_fn(self, index, bucket: int, k: int) -> Callable:
         raise NotImplementedError("distributed indexes are jit-warmed")
+
+    def prewarm_shard_artifacts(self, scan_mode: str = "fused") -> int:
+        """Load one PER-SHARD routed executable per (bucket, k, shard)
+        into the process :class:`~raft_tpu.core.aot.ExecutableCache`
+        (kind ``"ivf_pq_routed"``) so a single-shard deployment process
+        answers its first request compile-free.
+
+        Only meaningful for ``by_list`` (:class:`RoutedIndex`) indexes —
+        data-parallel placements return 0.  For ``scan_mode="fused"``
+        each artifact bakes the grouped scan at the STATIC group
+        capacity for its bucket shape; that capacity rides in the cache
+        key via the export kwargs, so re-warming after a bucket change
+        never aliases a stale group count.  Returns the number of cached
+        shard executables."""
+        index = self.index
+        if getattr(index, "local_centers", None) is None:
+            return 0
+        from raft_tpu.neighbors import grouped
+
+        cache = _aot_executables()
+        n_probes = min(self.params.n_probes, index.n_lists)
+        slots = int(index.local_centers.shape[1])
+        n = 0
+        for b in self.buckets:
+            cap = grouped.group_capacity(b, n_probes, slots)[0]
+            for k in self.ks:
+                for s in range(index.n_shards):
+                    kwargs = {"shard": s}
+                    if scan_mode == "fused":
+                        kwargs["group_capacity"] = cap
+                    cache.get("ivf_pq_routed", self.handle, index,
+                              batch=b, k=k, n_probes=n_probes,
+                              scan_mode=scan_mode, **kwargs)
+                    n += 1
+        return n
 
     def _live_fn(self, index, k: int) -> Callable:
         from raft_tpu import config

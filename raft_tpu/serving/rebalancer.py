@@ -430,7 +430,8 @@ def rebalance_routed(handle, index, *,
             obs.registry().counter("rebalance.routed.noops").inc()
         return index
 
-    centers, recon, rsq, gli, sizes = _dann._gather_global(index)
+    centers, recon, rsq, gli, sizes, code_leaves = _dann._gather_global(
+        index)
 
     faults.maybe_fail("rebalance.compact")
     if eligible:
@@ -448,13 +449,25 @@ def rebalance_routed(handle, index, *,
             jnp.take_along_axis(recon, order[:, :, None], axis=1))
         rsq = jnp.where(drop, 0, jnp.take_along_axis(rsq, order, axis=1))
         sizes = jnp.where(sel, live, sizes)
+        if code_leaves is not None:
+            # the lane-major code cache is row-indexed on its LAST axis
+            # (n_lists, Wi, cap): same permutation, broadcast over lanes
+            books, lanes, crsq = code_leaves
+            lanes = jnp.where(
+                drop[:, None, :], 0,
+                jnp.take_along_axis(lanes, order[:, None, :], axis=2))
+            crsq = jnp.where(drop, 0,
+                             jnp.take_along_axis(crsq, order, axis=1))
+            code_leaves = (books, lanes, crsq)
 
     placement = _dann.compute_placement(
         np.asarray(jnp.sum(gli >= 0, axis=1)), index.n_shards,
         generation=index.placement.generation + 1)
     cand = _dann._place_lists(handle, (centers, recon, rsq, gli, sizes),
                               index.rotation, placement, index.metric,
-                              index.size)
+                              index.size, code_leaves=code_leaves,
+                              pq_bits=index.pq_bits,
+                              group_est=index.group_est)
     cand.canaries = index.canaries
     _mutate.next_generation(index, cand)          # the ONE global bump
 

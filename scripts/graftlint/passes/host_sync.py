@@ -1,0 +1,210 @@
+"""host-sync: the steady-state dispatch must not read device values back.
+
+Round 10's serving contract is ZERO host syncs on the warmed dispatch
+path: group construction is shape-static (a static group capacity rides
+in the compiled shape), so nothing about a batch needs to come back to
+Python before the next dispatch.  The way that contract erodes is one
+innocent readback — ``int(jnp.max(...))`` to size a buffer,
+``np.asarray(device_result)`` to "just look at" a value — each of which
+stalls the dispatch thread on device completion and reintroduces the
+per-batch sync round 10 removed.
+
+One rule, scoped to the same serving/distributed hot-path functions as
+``recompile-hazard`` (``search`` / ``search_bucket`` / ``submit`` /
+``_dispatch`` / ``_run`` / ``offer`` / ``cut_batch``):
+
+- ``host-sync``: ``int(x)`` / ``float(x)`` / ``np.asarray(x)`` /
+  ``np.array(x)`` where ``x`` mentions a ``jnp.`` / ``jax.`` call or a
+  local name assigned from a non-numpy call (conservatively a device
+  value in these functions), and any ``.block_until_ready()`` call.
+
+Legitimate readbacks exist — the batcher's single result readback that
+feeds request futures, the calibrated-capacity overflow gate that
+triggers the exact re-dispatch — and each one must carry a reasoned
+per-line suppression (``# graftlint: disable=host-sync -- why``) so the
+set of sync points stays enumerable in one grep.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from scripts.graftlint.core import (
+    Diagnostic,
+    Project,
+    contains,
+    dotted_name,
+    register,
+)
+
+# same request-path scope + hot-function set as recompile-hazard: the
+# two passes guard the two halves of the steady-state contract (no
+# recompiles, no syncs) over the same code
+from scripts.graftlint.passes.recompile_hazard import _HOT_FNS, _SCOPE
+
+_DEVICE_ROOTS = ("jnp", "jax")
+# call roots whose results are host values, never device arrays
+_HOST_ROOTS = {"np", "numpy", "math", "time", "os", "re", "warnings",
+               "int", "float", "str", "bool", "len", "range", "sum",
+               "min", "max", "abs", "sorted", "list", "tuple", "dict",
+               "set", "enumerate", "zip", "isinstance", "getattr",
+               "hasattr", "print", "bucket_for", "valid_rows_mask"}
+_COERCIONS = {"int", "float"}
+_METADATA = {"shape", "ndim", "size", "dtype", "sharding"}
+_ASARRAY = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+
+
+def _is_device_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    target = dotted_name(node.func)
+    return (target is not None
+            and target.split(".")[0] in _DEVICE_ROOTS)
+
+
+def _taints(value: ast.AST) -> bool:
+    """Does assigning from this expression make the target plausibly a
+    device array?  jnp/jax-rooted calls do; so does any call whose root
+    is not a known host namespace (dispatch closures, executor methods —
+    in a hot-path function their results are device arrays until the
+    explicit readback)."""
+    if contains(value, _is_device_call):
+        return True
+    if isinstance(value, ast.Tuple):
+        return any(_taints(e) for e in value.elts)
+    if isinstance(value, ast.Call):
+        target = dotted_name(value.func)
+        if target is None:   # method on a subscript/call result etc.
+            return True
+        return target.split(".")[0] not in _HOST_ROOTS
+    return False
+
+
+def _target_names(target: ast.AST) -> List[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for e in target.elts:
+            out.extend(_target_names(e))
+        return out
+    return []
+
+
+@register
+class HostSyncPass:
+    name = "host-sync"
+    docs = {
+        "host-sync":
+            "serving/distributed hot-path functions must not read device "
+            "values back to the host (int()/np.asarray()/"
+            "block_until_ready on device results)",
+    }
+
+    def run(self, project: Project) -> List[Diagnostic]:
+        out: List[Diagnostic] = []
+        for mod in project.walk(*_SCOPE):
+            for fn, stack in self._hot_functions(mod.tree):
+                self._check_fn(mod, fn, out)
+        return out
+
+    def _hot_functions(self, tree: ast.AST):
+        def visit(node, stack):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    names = stack + (child.name,)
+                    if set(names) & _HOT_FNS:
+                        yield child, names
+                    yield from visit(child, names)
+                else:
+                    yield from visit(child, stack)
+        yield from visit(tree, ())
+
+    def _check_fn(self, mod, fn, out: List[Diagnostic]) -> None:
+        tainted: Set[str] = set()
+
+        def device_ref(node: ast.AST) -> bool:
+            # .shape / .ndim / .dtype of a device array are static
+            # trace-time metadata, not value readbacks — prune the whole
+            # subtree so int(x.shape[0]) never flags
+            if (isinstance(node, ast.Attribute)
+                    and node.attr in _METADATA):
+                return False
+            if _is_device_call(node):
+                return True
+            if isinstance(node, ast.Name) and node.id in tainted:
+                return True
+            return any(device_ref(c) for c in ast.iter_child_nodes(node))
+
+        def check_expr(node: ast.AST) -> None:
+            for call in (n for n in ast.walk(node)
+                         if isinstance(n, ast.Call)):
+                target = dotted_name(call.func)
+                term = (call.func.attr
+                        if isinstance(call.func, ast.Attribute) else None)
+                if (term == "block_until_ready"
+                        or target == "jax.block_until_ready"):
+                    out.append(Diagnostic(
+                        mod.rel, call.lineno, "host-sync",
+                        f"block_until_ready in hot-path function "
+                        f"'{fn.name}' — blocks the dispatch thread on "
+                        f"device completion; belongs in warmup/bench "
+                        f"paths only"))
+                    continue
+                if not call.args:
+                    continue
+                sink = None
+                if target in _COERCIONS:
+                    sink = f"{target}()"
+                elif target in _ASARRAY:
+                    sink = f"{target}()"
+                if sink and device_ref(call.args[0]):
+                    out.append(Diagnostic(
+                        mod.rel, call.lineno, "host-sync",
+                        f"{sink} of a device value in hot-path function "
+                        f"'{fn.name}' — per-batch readback stalls the "
+                        f"steady-state dispatch; keep it in-graph, or "
+                        f"suppress with a reason if this readback is "
+                        f"the documented sync point"))
+
+        def walk_stmts(stmts) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue  # nested fns get their own taint scope
+                if isinstance(stmt, ast.Assign):
+                    check_expr(stmt.value)
+                    names = [n for t in stmt.targets
+                             for n in _target_names(t)]
+                    if _taints(stmt.value):
+                        tainted.update(names)
+                    else:
+                        tainted.difference_update(names)
+                elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+                    if stmt.value is not None:
+                        check_expr(stmt.value)
+                        names = _target_names(stmt.target)
+                        if _taints(stmt.value):
+                            tainted.update(names)
+                else:
+                    for field in ast.iter_child_nodes(stmt):
+                        if isinstance(field, ast.stmt):
+                            continue
+                        if isinstance(field, ast.withitem):
+                            check_expr(field.context_expr)
+                        elif isinstance(field, ast.expr):
+                            check_expr(field)
+                # recurse into compound-statement bodies in source order
+                for name in ("body", "orelse", "finalbody", "handlers"):
+                    sub = getattr(stmt, name, None)
+                    if not sub:
+                        continue
+                    if name == "handlers":
+                        for h in sub:
+                            walk_stmts(h.body)
+                    else:
+                        walk_stmts(sub)
+
+        walk_stmts(fn.body)

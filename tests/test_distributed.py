@@ -8,12 +8,14 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from raft_tpu import observability as obs
 from raft_tpu.cluster import KMeansParams, kmeans
 from raft_tpu.comms import CommsSession
 from raft_tpu.distributed import kmeans as dist_kmeans
 from raft_tpu.distributed import knn as dist_knn
 from raft_tpu.neighbors import ivf_pq
 from raft_tpu.random import make_blobs
+from raft_tpu.serving import buckets as serving_buckets
 
 
 @pytest.fixture
@@ -430,7 +432,17 @@ class TestRoutedAnn:
         from raft_tpu.distributed import ann
         _, q = data
         _, ridx = built
+        # round 10: fused lowers under shard_map at static group
+        # capacity — shards report plain SHARD_OK, not FALLBACK
         sp = ivf_pq.SearchParams(n_probes=8, scan_mode="fused")
+        _, i, status = ann.search(rhandle, sp, ridx, q, self.K,
+                                  return_status=True)
+        np.testing.assert_array_equal(
+            np.asarray(status), np.full(8, ann.SHARD_OK, np.int8))
+        assert np.asarray(i).min() >= 0
+        # recon8 stays a genuine lowering under the routed path and
+        # keeps the FALLBACK status visible to callers
+        sp = ivf_pq.SearchParams(n_probes=8, scan_mode="recon8")
         _, i, status = ann.search(rhandle, sp, ridx, q, self.K,
                                   return_status=True)
         np.testing.assert_array_equal(
@@ -496,3 +508,148 @@ class TestRoutedAnn:
         ex.swap_index(reb)
         d2, i2 = ex.search_bucket(jnp.asarray(q), self.NQ, self.K)
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    # ---- round 10: sync-free fused grouping under the routed path ----
+
+    def test_routed_fused_full_probe_matches_single_index(self, rhandle,
+                                                          data):
+        """by_list fused at full probe == the single-index fused answer.
+
+        Per-cluster codebooks keep the index out of the codes/LUT
+        branch, so BOTH sides land on the same grouped recon twin — the
+        comparison is formulation-for-formulation, not recall-level."""
+        from raft_tpu.core.outputs import raw
+        from raft_tpu.distributed import ann
+        db, q = data
+        params = ivf_pq.IndexParams(
+            n_lists=self.NL, pq_dim=self.DIM, kmeans_n_iters=3,
+            codebook_kind=ivf_pq.CodebookKind.PER_CLUSTER,
+            cache_reconstructions=True)
+        base = ivf_pq.build(rhandle, params, db)
+        ridx = ann.shard_by_list(rhandle, base)
+        assert ridx.list_code_lanes is None   # not codes-eligible
+        sp = ivf_pq.SearchParams(n_probes=self.NL, scan_mode="fused")
+        bd, bi = raw(ivf_pq.search)(rhandle, sp, base, q, self.K)
+        rd, ri, status = ann.search(rhandle, sp, ridx, q, self.K,
+                                    return_status=True)
+        np.testing.assert_array_equal(
+            np.asarray(status), np.full(8, ann.SHARD_OK, np.int8))
+        np.testing.assert_array_equal(np.asarray(ri), np.asarray(bi))
+        np.testing.assert_allclose(np.asarray(rd), np.asarray(bd),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_routed_fused_does_not_tick_lowering(self, rhandle, data,
+                                                 built):
+        """Round-10 acceptance: scan_mode="fused" on a by_list index no
+        longer counts as a distributed lowering — the counter that used
+        to tick on every fused routed request must stay silent."""
+        from raft_tpu import observability as obs
+        from raft_tpu.distributed import ann
+        _, q = data
+        _, ridx = built
+        sp = ivf_pq.SearchParams(n_probes=8, scan_mode="fused")
+        with obs.collecting():
+            low0 = obs.registry().counter(
+                "distributed.ann.scan_mode_lowered").value
+            _, _, stats = ann.search(rhandle, sp, ridx, q, self.K,
+                                     return_stats=True)
+            lowered = obs.registry().counter(
+                "distributed.ann.scan_mode_lowered").value - low0
+        assert lowered == 0, "fused routed search reported a lowering"
+        assert stats["scan_mode"] in ("grouped_recon", "fused_recon",
+                                      "fused_codes")
+
+    def test_routed_fused_overflow_redispatch_under_skew(
+            self, rhandle, data, built, monkeypatch):
+        """Calibrated-capacity protocol: a probe distribution wider than
+        the estimate must tick ivf_pq.search.group_overflow and
+        re-dispatch at the worst bound — results identical to the
+        uncalibrated (always-worst) index."""
+        import dataclasses
+        from raft_tpu import observability as obs
+        from raft_tpu.distributed import ann
+        from raft_tpu.neighbors import grouped
+        _, q = data
+        _, ridx = built
+        # drop the compile-cache quantum so the class-sized mesh can
+        # actually exceed a tightened capacity (at the default 256 the
+        # rounded capacity clamps to the worst bound at this scale)
+        monkeypatch.setattr(grouped, "_GROUP_ROUND", 1)
+        sp = ivf_pq.SearchParams(n_probes=8, scan_mode="fused")
+        d0, i0 = ann.search(rhandle, sp, ridx, q, self.K)
+        tight = dataclasses.replace(ridx, group_est=0.05)
+        slots = ridx.local_centers.shape[1]
+        cap, exact = grouped.group_capacity(self.NQ, 8, slots, est=0.05)
+        worst, _ = grouped.group_capacity(self.NQ, 8, slots)
+        assert not exact and cap < worst, (cap, worst)
+        with obs.collecting():
+            d1, i1 = ann.search(rhandle, sp, tight, q, self.K)
+            n_over = obs.registry().counter(
+                "ivf_pq.search.group_overflow").value
+        assert n_over >= 1, "skewed batch must trip the overflow gate"
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d0))
+
+    def test_routed_serialization_carries_code_leaves_and_est(
+            self, rhandle, built):
+        """Routed envelope v2: lane-major code leaves, pq_bits and the
+        calibrated estimate survive the round trip (v1 streams read back
+        as recon-only / uncalibrated — always-correct defaults)."""
+        import io
+        from raft_tpu.distributed import ann
+        _, ridx = built
+        assert ridx.list_code_lanes is not None   # codes-eligible base
+        buf = io.BytesIO()
+        ann.serialize_routed(rhandle, buf, ridx)
+        buf.seek(0)
+        back = ann.deserialize_routed(rhandle, buf)
+        assert back.pq_bits == ridx.pq_bits
+        assert back.group_est == ridx.group_est
+        np.testing.assert_array_equal(np.asarray(back.list_code_lanes),
+                                      np.asarray(ridx.list_code_lanes))
+        np.testing.assert_array_equal(np.asarray(back.list_code_rsq),
+                                      np.asarray(ridx.list_code_rsq))
+        np.testing.assert_array_equal(np.asarray(back.codebooks),
+                                      np.asarray(ridx.codebooks))
+
+    def test_serving_dispatch_zero_sync_steady_state(self, rhandle, data,
+                                                     built):
+        """Round-10 serving acceptance on the routed path: across warmed
+        mixed-size batches under scan_mode="fused", steady state sees
+        ZERO XLA recompiles and ZERO overflow re-dispatches (the
+        uncalibrated index runs the exact worst-bound regime, which
+        never reads anything back)."""
+        from raft_tpu.serving.executor import DistributedExecutor
+        _, q = data
+        _, ridx = built
+        ex = DistributedExecutor(
+            rhandle, ridx, ks=(self.K,), max_batch=16,
+            search_params=ivf_pq.SearchParams(n_probes=8,
+                                              scan_mode="fused"))
+        qn = np.asarray(q)
+
+        def dispatch(m):
+            # host-side bucket assembly, exactly as the batcher does it
+            # (a jnp.pad here would itself compile per novel (m, bucket)
+            # pair — the recompile-hazard class of bug)
+            b = serving_buckets.bucket_for(m, 16)
+            buf = np.zeros((b, qn.shape[1]), qn.dtype)
+            buf[:m] = qn[:m]
+            return ex.search_bucket(jnp.asarray(buf), m, self.K)
+
+        with obs.collecting():
+            # the registry is global and cumulative — earlier tests
+            # legitimately tick group_overflow, so assert deltas only
+            over0 = obs.registry().counter(
+                "ivf_pq.search.group_overflow").value
+            ex.warmup()
+            for m in (1, 3, 8, 16, 5, 2):
+                dispatch(m)
+            c0 = obs.registry().counter("xla.compiles").value
+            for m in (2, 16, 1, 7, 4, 16, 3):
+                dispatch(m)
+            c1 = obs.registry().counter("xla.compiles").value
+            n_over = obs.registry().counter(
+                "ivf_pq.search.group_overflow").value - over0
+        assert c1 == c0, f"{c1 - c0} recompiles in steady state"
+        assert n_over == 0, "steady-state dispatch re-dispatched"

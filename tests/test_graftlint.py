@@ -294,6 +294,82 @@ class TestRegistryConsistency:
 
 
 # ---------------------------------------------------------------------------
+# host-sync
+
+class TestHostSync:
+    def test_device_coercion_in_hot_fn_flagged(self):
+        src = ("import jax.numpy as jnp\n"
+               "def search(q):\n"
+               "    return int(jnp.max(q))\n")
+        diags = lint({"raft_tpu/serving/x.py": src}, rules=["host-sync"])
+        assert [d.rule for d in diags] == ["host-sync"]
+        assert diags[0].line == 3
+
+    def test_tainted_name_readback_flagged(self):
+        # the sync hides behind an assignment: d came off the device
+        src = ("import numpy as np\n"
+               "import jax.numpy as jnp\n"
+               "def _dispatch(q):\n"
+               "    d = jnp.sqrt(q)\n"
+               "    return np.asarray(d)\n")
+        diags = lint({"raft_tpu/serving/x.py": src}, rules=["host-sync"])
+        assert [d.rule for d in diags] == ["host-sync"]
+        assert diags[0].line == 5
+
+    def test_block_until_ready_flagged(self):
+        src = ("def submit(x):\n"
+               "    x.block_until_ready()\n"
+               "    return x\n")
+        diags = lint({"raft_tpu/distributed/x.py": src},
+                     rules=["host-sync"])
+        assert [d.rule for d in diags] == ["host-sync"]
+
+    def test_shape_metadata_coercion_clean(self):
+        # array METADATA is host-resident; int(x.shape[0]) never syncs
+        src = ("import jax.numpy as jnp\n"
+               "def search(q):\n"
+               "    arr = jnp.asarray(q)\n"
+               "    n = int(arr.shape[0])\n"
+               "    return jnp.zeros((n, arr.ndim))\n")
+        assert lint({"raft_tpu/serving/x.py": src},
+                    rules=["host-sync"]) == []
+
+    def test_reassignment_clears_taint(self):
+        src = ("import numpy as np\n"
+               "import jax.numpy as jnp\n"
+               "def search(q):\n"
+               "    d = jnp.sqrt(q)\n"
+               "    d = np.zeros(4)\n"
+               "    return float(d[0])\n")
+        assert lint({"raft_tpu/serving/x.py": src},
+                    rules=["host-sync"]) == []
+
+    def test_scope_and_hot_fn_gating(self):
+        src = ("import jax.numpy as jnp\n"
+               "def helper(q):\n"
+               "    return int(jnp.max(q))\n")
+        # cold function inside the scope: clean
+        assert lint({"raft_tpu/serving/x.py": src},
+                    rules=["host-sync"]) == []
+        hot = src.replace("def helper", "def search")
+        # hot name outside the serving/distributed scope: clean
+        assert lint({"raft_tpu/neighbors/x.py": hot},
+                    rules=["host-sync"]) == []
+
+    def test_reasoned_suppression_counted(self):
+        # the design contract: every surviving sync point carries an
+        # inline reason, so `grep 'disable=host-sync'` enumerates them
+        src = ("import jax.numpy as jnp\n"
+               "def search(q):\n"
+               "    # graftlint: disable=host-sync -- documented readback\n"
+               "    return int(jnp.max(q))\n")
+        diags, n_sup = run_passes(
+            Project.from_sources({"raft_tpu/serving/x.py": src}),
+            rules=["host-sync"])
+        assert diags == [] and n_sup == 1
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 
 class TestSuppression:

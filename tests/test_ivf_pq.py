@@ -625,3 +625,127 @@ class TestListDataHelpers:
         rel = (np.linalg.norm(rec - orig, axis=1)
                / np.maximum(np.linalg.norm(orig, axis=1), 1e-6))
         assert float(np.median(rel)) < 0.5
+
+
+class TestGroupCapacity:
+    """Round 10: shape-static group capacity — the grouped dispatch no
+    longer syncs a per-batch group count, and a calibrated index's
+    tightened capacity is covered by the in-graph overflow fallback."""
+
+    def test_worst_bound_is_exact_and_total(self):
+        from raft_tpu.neighbors import grouped
+        cap, exact = grouped.group_capacity(16, 8, 32)
+        assert exact
+        assert cap == -(-16 * 8 // grouped.GROUP) + min(32, 16 * 8)
+        # degenerate batch: still a valid (static) dispatch shape
+        assert grouped.group_capacity(0, 8, 32) == (1, True)
+        # calibrated capacity never exceeds the worst bound
+        t, e = grouped.group_capacity(16, 8, 32, est=0.9)
+        assert t <= cap and (e or t < cap)
+
+    def test_probe_overlap_order_above_int32_key_range(self):
+        """Regression (round 10): at n_lists=65536 the old fused sort
+        key r0*(n_lists+1)+r1 wraps int32 — the two-pass stable lexsort
+        must match numpy's lexsort exactly."""
+        from raft_tpu.neighbors import grouped
+        n_lists = 65536
+        assert (n_lists + 1) ** 2 > np.iinfo(np.int32).max
+        rng = np.random.default_rng(3)
+        probes = rng.integers(0, n_lists, size=(512, 4), dtype=np.int32)
+        order = np.asarray(grouped.probe_overlap_order(
+            jnp.asarray(probes), n_lists))
+        r0 = np.minimum(probes[:, 0], n_lists)
+        r1 = np.minimum(probes[:, 1], n_lists)
+        np.testing.assert_array_equal(order, np.lexsort((r1, r0)))
+        # and the small-n_lists fast path agrees with the same model
+        small = rng.integers(0, 64, size=(256, 4), dtype=np.int32)
+        got = np.asarray(grouped.probe_overlap_order(jnp.asarray(small),
+                                                     64))
+        np.testing.assert_array_equal(
+            got, np.lexsort((np.minimum(small[:, 1], 64),
+                             np.minimum(small[:, 0], 64))))
+
+    def test_executable_reuse_across_group_counts(self, res, dataset):
+        """Two batches at the SAME shape with DIFFERENT true group
+        counts must share one executable — the capacity, not the count,
+        is the compiled shape (the round-10 churn fix)."""
+        from raft_tpu import observability as obs
+        from raft_tpu.neighbors import grouped
+        db, q = dataset
+        params = ivf_pq.IndexParams(n_lists=32, pq_dim=8,
+                                    kmeans_n_iters=5,
+                                    cache_reconstructions=True)
+        index = ivf_pq.build(res, params, db)
+        sp = ivf_pq.SearchParams(n_probes=8, scan_mode="recon")
+        narrow = np.tile(np.asarray(q[:1]), (16, 1))
+        spread = np.asarray(q[:16])
+        pn = ivf_pq._select_clusters(index.centers, index.rotation,
+                                     jnp.asarray(narrow), 8, index.metric)
+        ps = ivf_pq._select_clusters(index.centers, index.rotation,
+                                     jnp.asarray(spread), 8, index.metric)
+        assert (int(grouped.num_groups(pn, 32))
+                < int(grouped.num_groups(ps, 32)))
+        with obs.collecting():
+            ivf_pq.search(res, sp, index, narrow, 10)    # warm the shape
+            c0 = obs.registry().counter("xla.compiles").value
+            ivf_pq.search(res, sp, index, spread, 10)
+            ivf_pq.search(res, sp, index, narrow, 10)
+            c1 = obs.registry().counter("xla.compiles").value
+        assert c1 == c0, f"{c1 - c0} recompiles across group-count change"
+        # the churn mechanism itself is gone: no per-batch group cache
+        assert not hasattr(grouped, "cached_groups")
+        assert not hasattr(grouped, "commit_groups")
+
+    def test_calibrated_overflow_redispatch_is_exact(self, res, dataset,
+                                                     monkeypatch):
+        """Calibrate on a narrow batch, then search a wider one: the
+        overflow counter must tick and the worst-bound re-dispatch must
+        return exactly the uncalibrated answer."""
+        from raft_tpu import observability as obs
+        from raft_tpu.neighbors import grouped
+        db, q = dataset
+        params = ivf_pq.IndexParams(n_lists=32, pq_dim=8,
+                                    kmeans_n_iters=5,
+                                    cache_reconstructions=True)
+        index = ivf_pq.build(res, params, db)
+        # drop the compile-cache quantum so this test-sized index can
+        # exceed a tightened capacity (at the default 256 the rounded
+        # capacity clamps to the worst bound at this scale)
+        monkeypatch.setattr(grouped, "_GROUP_ROUND", 1)
+        sp = ivf_pq.SearchParams(n_probes=8, scan_mode="recon")
+        spread = np.asarray(q)                 # 50 blob queries
+        d0, i0 = ivf_pq.search(res, sp, index, spread, 10)
+        narrow = np.tile(np.asarray(q[:1]), (len(spread), 1))
+        est = ivf_pq.calibrate_group_capacity(res, index, narrow, 8)
+        assert 0.0 < est < 1.0
+        cap, exact = grouped.group_capacity(len(spread), 8, 32,
+                                            est=index.group_est)
+        worst, _ = grouped.group_capacity(len(spread), 8, 32)
+        assert not exact and cap < worst, (cap, worst)
+        with obs.collecting():
+            d1, i1 = ivf_pq.search(res, sp, index, spread, 10)
+            n_over = obs.registry().counter(
+                "ivf_pq.search.group_overflow").value
+        assert n_over >= 1, "wide batch must trip the overflow gate"
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d0))
+        # repeated calibration ratchets: a wider batch raises the
+        # estimate, a narrower one never lowers it
+        est2 = ivf_pq.calibrate_group_capacity(res, index, spread, 8)
+        assert est2 >= est
+        assert ivf_pq.calibrate_group_capacity(res, index, narrow, 8) == est2
+
+    def test_group_est_rides_serialization_v4(self, res, dataset):
+        from raft_tpu.neighbors import grouped
+        db, q = dataset
+        params = ivf_pq.IndexParams(n_lists=16, pq_dim=8,
+                                    kmeans_n_iters=4,
+                                    cache_reconstructions=True)
+        index = ivf_pq.build(res, params, db)
+        ivf_pq.calibrate_group_capacity(res, index, np.asarray(q), 8)
+        assert index.group_est > 0.0
+        buf = io.BytesIO()
+        ivf_pq.serialize(res, buf, index)
+        buf.seek(0)
+        back = ivf_pq.deserialize(res, buf)
+        assert back.group_est == index.group_est
