@@ -20,9 +20,43 @@ is ``{}``).
 """
 
 import json
+import os
 import time
 
 import numpy as np
+
+# every emitted JSON line is retained and written to BENCH_rNN.json at
+# the end of the run (any mode, pass or fail) — the machine-readable
+# record CI uploads as an artifact, no shell redirection required
+_EMITTED: list = []
+
+#: env override for the artifact path (CI pins it; default auto-numbers)
+BENCH_OUT_ENV = "RAFT_TPU_BENCH_OUT"
+
+
+def _emit(obj) -> None:
+    """Print one result line (the existing JSON-lines protocol) and
+    retain it for :func:`_write_bench_artifact`."""
+    _EMITTED.append(obj)
+    print(json.dumps(obj), flush=True)
+
+
+def _write_bench_artifact() -> str:
+    """Write the retained result lines to ``$RAFT_TPU_BENCH_OUT`` or the
+    next free ``BENCH_rNN.json`` beside this file.  Called from the
+    entry-point ``finally`` so a failed run still leaves its partial
+    record for the post-mortem."""
+    path = os.environ.get(BENCH_OUT_ENV)
+    if not path:
+        here = os.path.dirname(os.path.abspath(__file__))
+        n = 1
+        while os.path.exists(os.path.join(here, f"BENCH_r{n:02d}.json")):
+            n += 1
+        path = os.path.join(here, f"BENCH_r{n:02d}.json")
+    with open(path, "w") as f:
+        json.dump({"results": _EMITTED}, f, indent=2)
+    print(f"bench artifact: {path}", flush=True)
+    return path
 
 N_DB = 1_000_000
 N_QUERIES = 5_000
@@ -132,13 +166,13 @@ def _print_stage_breakdown(harness: str, index) -> None:
     rep = obs.build_report(index)
     if rep is None:
         return
-    print(json.dumps({"stage_breakdown": {
+    _emit({"stage_breakdown": {
         "harness": harness,
         "total_s": round(rep["total_s"], 3),
         "stages": {name: round(t["total_s"], 3)
                    for name, t in sorted(rep["stages"].items())},
         "counters": rep["counters"],
-    }}), flush=True)
+    }})
 
 
 def _search_stage_probe(res, index, queries) -> dict:
@@ -193,7 +227,7 @@ def bench_ivf_pq(res, db, queries, gt_i=None) -> dict:
     build_s = time.perf_counter() - t0
     _print_stage_breakdown("ivf_pq", index)
     stage_probe = _search_stage_probe(res, index, queries)
-    print(json.dumps({"search_stage_probe": stage_probe}), flush=True)
+    _emit({"search_stage_probe": stage_probe})
 
     from raft_tpu.neighbors.refine import refine as refine_fn
 
@@ -233,7 +267,7 @@ def bench_ivf_pq(res, db, queries, gt_i=None) -> dict:
     points = []
     for pt in OPERATING_POINTS:
         point = run_point(pt)
-        print(json.dumps({"op_point": point}), flush=True)
+        _emit({"op_point": point})
         if point["recall"] >= MIN_RECALL and (
                 best is None or point["qps"] > best["qps"]):
             best = point
@@ -305,7 +339,7 @@ def bench_cagra(res, db, queries, gt_i=None) -> dict:
         qps = N_QUERIES / ((time.perf_counter() - t0) / RUNS)
         point = {"itopk": itopk, "search_width": width,
                  "recall": round(recall, 4), "qps": round(qps, 1)}
-        print(json.dumps({"cagra_op_point": point}), flush=True)
+        _emit({"cagra_op_point": point})
         if point["recall"] >= MIN_RECALL and (
                 best is None or point["qps"] > best["qps"]):
             best = point
@@ -400,7 +434,7 @@ def bench_ivf_flat(res, db, queries, gt_i=None) -> dict:
         qps = N_QUERIES / ((time.perf_counter() - t0) / RUNS)
         point = {"n_probes": n_probes, "recall": round(recall, 4),
                  "qps": round(qps, 1)}
-        print(json.dumps({"ivf_flat_op_point": point}), flush=True)
+        _emit({"ivf_flat_op_point": point})
         if point["recall"] >= MIN_RECALL and (
                 best is None or point["qps"] > best["qps"]):
             best = point
@@ -473,12 +507,19 @@ def bench_serving(res, db, queries, *, build_param=None, search_param=None,
     Closed loop (``clients`` synchronous threads, ``request_rows`` rows
     per request) measures ``serving_qps_sustained``; the acceptance bar
     is >= 80% of raw-batch QPS at the same (index, params, max_batch)
-    operating point.  Open loop at ``offered_fraction`` of the measured
-    capacity reports ``serving_p99_ms`` (client-observed submit->result,
-    cross-checked against the ``serving.latency.total`` histogram).  The
-    ``xla.compiles`` counter is sampled around the measured window —
-    steady state must be recompile-free (the closed bucket-shape
-    contract; CI fails the smoke job otherwise).
+    operating point.  The closed loop runs TWICE — tracing off, then
+    tracing on (metrics collection is on in both arms, so the A/B
+    isolates the tracing hooks) — and the ratio is emitted as
+    ``serving_tracing_overhead`` (CI fails the smoke when tracing costs
+    more than the conf's ``max_tracing_overhead``).  Open loop at
+    ``offered_fraction`` of the measured capacity runs with tracing on
+    and reports ``serving_p99_ms`` (client-observed submit->result,
+    cross-checked against the ``serving.latency.total`` histogram) plus
+    the mean per-span breakdown of the traces landed in the flight
+    recorder.  The ``xla.compiles`` counter is sampled around the whole
+    measured window — steady state must be recompile-free *with tracing
+    enabled* (the closed bucket-shape contract; CI fails the smoke job
+    otherwise).
     """
     import threading
 
@@ -487,6 +528,8 @@ def bench_serving(res, db, queries, *, build_param=None, search_param=None,
 
     from raft_tpu import observability as obs
     from raft_tpu import serving
+    from raft_tpu.observability import flight as _flight
+    from raft_tpu.observability import trace as _trace
     from raft_tpu.neighbors import ivf_pq
 
     bp = build_param or {"nlist": 1024, "pq_dim": 32}
@@ -531,51 +574,70 @@ def bench_serving(res, db, queries, *, build_param=None, search_param=None,
                 srv.search(q[:m], k)
             c0 = obs.registry().counter("xla.compiles").value
 
-            # ---- closed loop ----------------------------------------
-            done = [0] * clients
-            stop_at = time.perf_counter() + duration_s
+            # ---- closed loop: tracing off, then tracing on ----------
+            def closed_loop():
+                done = [0] * clients
+                stop_at = time.perf_counter() + duration_s
 
-            def client(j):
-                base = (j * 131) % max(1, q.shape[0] - request_rows)
-                sub = q[base:base + request_rows]
-                while time.perf_counter() < stop_at:
-                    srv.search(sub, k)
-                    done[j] += sub.shape[0]
+                def client(j):
+                    base = (j * 131) % max(1, q.shape[0] - request_rows)
+                    sub = q[base:base + request_rows]
+                    while time.perf_counter() < stop_at:
+                        srv.search(sub, k)
+                        done[j] += sub.shape[0]
 
-            ts = [threading.Thread(target=client, args=(j,))
-                  for j in range(clients)]
-            t0 = time.perf_counter()
-            for t in ts:
-                t.start()
-            for t in ts:
-                t.join()
-            serving_qps = sum(done) / (time.perf_counter() - t0)
+                ts = [threading.Thread(target=client, args=(j,))
+                      for j in range(clients)]
+                t0 = time.perf_counter()
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                return sum(done) / (time.perf_counter() - t0)
+
+            serving_qps = closed_loop()
+            with _trace.tracing_scope():
+                traced_qps = closed_loop()
+            # sampled AFTER the traced arm: tracing must add zero
+            # compiles on warmed traffic, not just zero in its own arm
             recompiles = (obs.registry().counter("xla.compiles").value
                           - c0)
 
-            # ---- open loop ------------------------------------------
+            # ---- open loop (tracing on: feeds the span breakdown) ---
             rate = max(serving_qps * offered_fraction, request_rows)
             interval = request_rows / rate
             lats, futs = [], []
-            t_end = time.perf_counter() + duration_s
-            next_t = time.perf_counter()
-            while time.perf_counter() < t_end:
-                lag = next_t - time.perf_counter()
-                if lag > 0:
-                    time.sleep(lag)
-                t_sub = time.perf_counter()
-                f = srv.submit(q[:request_rows], k)
-                f.add_done_callback(
-                    lambda fut, t=t_sub:
-                    lats.append(time.perf_counter() - t))
-                futs.append(f)
-                next_t += interval
-            for f in futs:
-                f.result(timeout=30.0)
+            _flight.clear()
+            with _trace.tracing_scope():
+                t_end = time.perf_counter() + duration_s
+                next_t = time.perf_counter()
+                while time.perf_counter() < t_end:
+                    lag = next_t - time.perf_counter()
+                    if lag > 0:
+                        time.sleep(lag)
+                    t_sub = time.perf_counter()
+                    f = srv.submit(q[:request_rows], k)
+                    f.add_done_callback(
+                        lambda fut, t=t_sub:
+                        lats.append(time.perf_counter() - t))
+                    futs.append(f)
+                    next_t += interval
+                for f in futs:
+                    f.result(timeout=30.0)
             snap = obs.snapshot()
         hist = snap.get("histograms", {}).get("serving.latency.total", {})
         fill = snap.get("histograms", {}).get("serving.batch_fill", {})
 
+    # mean per-span breakdown of the open-loop traces (flight ring keeps
+    # the last DEFAULT_CAPACITY of them — enough for a mean)
+    traced = _flight.traces()
+    per_span: dict = {}
+    for tr in traced:
+        for sub_span in tr.spans:
+            per_span.setdefault(sub_span.name, []).append(
+                sub_span.duration)
+    span_breakdown = {name: round(float(np.mean(v)) * 1e3, 4)
+                      for name, v in sorted(per_span.items())}
     p50, p95, p99 = (float(v) * 1e3
                      for v in np.percentile(lats, [50, 95, 99]))
     out.append({
@@ -591,6 +653,17 @@ def bench_serving(res, db, queries, *, build_param=None, search_param=None,
                    "max_batch": max_batch, "max_wait_us": max_wait_us,
                    "batch_fill_p50": fill.get("p50")},
     })
+    frac = traced_qps / max(serving_qps, 1e-9)
+    out.append({
+        "metric": "serving_tracing_overhead",
+        "value": round(max(1.0 - frac, 0.0), 4),
+        "unit": "fraction",
+        "vs_baseline": round(frac, 3),
+        "detail": {"qps_tracing_off": round(serving_qps, 1),
+                   "qps_tracing_on": round(traced_qps, 1),
+                   "fraction_of_untraced": round(frac, 3),
+                   "recompiles_with_tracing": int(recompiles)},
+    })
     out.append({
         "metric": "serving_p99_ms",
         "value": round(p99, 3),
@@ -599,6 +672,8 @@ def bench_serving(res, db, queries, *, build_param=None, search_param=None,
         "detail": {"p50_ms": round(p50, 3), "p95_ms": round(p95, 3),
                    "offered_rows_per_s": round(rate, 1),
                    "requests": len(lats),
+                   "traced_requests": len(traced),
+                   "span_breakdown_ms": span_breakdown,
                    "hist_p99_ms": (round(hist["p99"] * 1e3, 3)
                                    if hist.get("p99") is not None
                                    else None)},
@@ -637,7 +712,7 @@ def run_serving(conf_path: str) -> int:
         duration_s=s.get("duration_s", 2.0),
         offered_fraction=s.get("offered_fraction", 0.7))
     for line in lines:
-        print(json.dumps(line), flush=True)
+        _emit(line)
     qps_line = lines[0]["detail"]
     failures = []
     if qps_line["recompiles_steady"] != 0:
@@ -648,8 +723,21 @@ def run_serving(conf_path: str) -> int:
         failures.append(
             f"sustained serving QPS is {qps_line['fraction_of_raw']:.2f}x "
             f"raw batch QPS (bar: {bar:.2f}x)")
+    overhead = next(ln for ln in lines
+                    if ln["metric"] == "serving_tracing_overhead")
+    max_overhead = s.get("max_tracing_overhead", 0.05)
+    traced_frac = overhead["detail"]["fraction_of_untraced"]
+    if traced_frac < 1.0 - max_overhead:
+        failures.append(
+            f"tracing-enabled QPS is {traced_frac:.2f}x the untraced "
+            f"loop (bar: {1.0 - max_overhead:.2f}x)")
     for msg in failures:
         print(f"SERVING SMOKE FAIL: {msg}", flush=True)
+    if failures:
+        from raft_tpu.observability import flight as _flight
+        dumped = _flight.maybe_auto_dump("serving_smoke_failure")
+        if dumped:
+            print(f"flight dump: {dumped}", flush=True)
     return 1 if failures else 0
 
 
@@ -1218,7 +1306,7 @@ def run_conf(conf_path: str) -> None:
                 "latency_p95_ms": round(float(np.percentile(lats, 95)), 2),
                 "latency_p99_ms": round(float(np.percentile(lats, 99)), 2),
                 "build_s": round(build_s, 1)})
-            print(json.dumps(results[-1]), flush=True)
+            _emit(results[-1])
 
     # eval.pl-style summary conditions
     for bar in (0.9, 0.95):
@@ -1228,18 +1316,16 @@ def run_conf(conf_path: str) -> None:
                                        r["qps"] > best[r["name"]]["qps"]):
                 best[r["name"]] = r
         for name, r in best.items():
-            print(json.dumps({"summary": f"QPS at recall={bar}",
-                              "name": name, "qps": r["qps"],
-                              "recall": r["recall"]}), flush=True)
+            _emit({"summary": f"QPS at recall={bar}",
+                   "name": name, "qps": r["qps"],
+                   "recall": r["recall"]})
     eligible = [r for r in results if r["qps"] >= QPS_REFERENCE_POINT]
     for name in {r["name"] for r in eligible}:
         top = max((r for r in eligible if r["name"] == name),
                   key=lambda r: r["recall"])
-        print(json.dumps({"summary": "recall at QPS=2000", "name": name,
-                          "recall": top["recall"], "qps": top["qps"]}),
-              flush=True)
-    print(json.dumps({"integrity_counters": _integrity_counters()}),
-          flush=True)
+        _emit({"summary": "recall at QPS=2000", "name": name,
+               "recall": top["recall"], "qps": top["qps"]})
+    _emit({"integrity_counters": _integrity_counters()})
 
 
 def _setup_jax_cache() -> None:
@@ -1271,38 +1357,40 @@ def main() -> None:
     # (1) pairwise check, (2) brute-force + fusedL2NN, (3) k-means,
     # (4) IVF-Flat then IVF-PQ (+ CAGRA, the headline), (5) MNMG
     gt_i = _ground_truth(res, db, queries)
-    print(json.dumps(bench_pairwise(res)), flush=True)
-    print(json.dumps(bench_brute_force(res, db, queries)), flush=True)
-    print(json.dumps(bench_cagra(res, db, queries, gt_i)), flush=True)
-    print(json.dumps(bench_ivf_flat(res, db, queries, gt_i)), flush=True)
-    print(json.dumps(bench_ivf_pq(res, db, queries, gt_i)), flush=True)
-    print(json.dumps(bench_kmeans(res, db[:KMEANS_N])), flush=True)
-    print(json.dumps(bench_mnmg(res)), flush=True)
+    _emit(bench_pairwise(res))
+    _emit(bench_brute_force(res, db, queries))
+    _emit(bench_cagra(res, db, queries, gt_i))
+    _emit(bench_ivf_flat(res, db, queries, gt_i))
+    _emit(bench_ivf_pq(res, db, queries, gt_i))
+    _emit(bench_kmeans(res, db[:KMEANS_N]))
+    _emit(bench_mnmg(res))
     for line in bench_distributed(res):
-        print(json.dumps(line), flush=True)
+        _emit(line)
     # online serving over a 100k slice of the same dataset (the CI
     # smoke runs the conf/serving-smoke.json variant of this)
     for line in bench_serving(res, db[:SERVING_N], queries[:2048]):
-        print(json.dumps(line), flush=True)
+        _emit(line)
     # the same serving stack under 1% delete + 1% extend mutation churn
     for line in bench_mutation(res, db[:SERVING_N], queries[:2048]):
-        print(json.dumps(line), flush=True)
-    print(json.dumps({"integrity_counters": _integrity_counters()}),
-          flush=True)
+        _emit(line)
+    _emit({"integrity_counters": _integrity_counters()})
 
 
 if __name__ == "__main__":
-    import os
     import sys
 
-    if len(sys.argv) >= 3 and sys.argv[1] == "--conf":
-        _setup_jax_cache()
-        run_conf(sys.argv[2])
-    elif len(sys.argv) >= 2 and sys.argv[1] == "--serving":
-        _setup_jax_cache()
-        conf = sys.argv[2] if len(sys.argv) >= 3 else \
-            os.path.join(os.path.dirname(__file__), "conf",
-                         "serving-smoke.json")
-        sys.exit(run_serving(conf))
-    else:
-        main()
+    try:
+        if len(sys.argv) >= 3 and sys.argv[1] == "--conf":
+            _setup_jax_cache()
+            run_conf(sys.argv[2])
+        elif len(sys.argv) >= 2 and sys.argv[1] == "--serving":
+            _setup_jax_cache()
+            conf = sys.argv[2] if len(sys.argv) >= 3 else \
+                os.path.join(os.path.dirname(__file__), "conf",
+                             "serving-smoke.json")
+            sys.exit(run_serving(conf))
+        else:
+            main()
+    finally:
+        # pass or fail, every run leaves its machine-readable record
+        _write_bench_artifact()

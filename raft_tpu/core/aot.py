@@ -176,10 +176,22 @@ class ExecutableCache:
         key = (kind, id(index), int(getattr(index, "generation", 0) or 0),
                placement_gen, int(batch), int(k), int(n_probes),
                scan_mode, extra)
+        from raft_tpu import observability as obs
         with self._lock:
             hit = self._entries.get(key)
             if hit is not None and hit[0]() is index:
+                if obs.enabled():
+                    obs.registry().counter("aot.cache.hits").inc()
                 return hit[1]
+        if obs.enabled():
+            obs.registry().counter("aot.cache.misses").inc()
+        # always-on flight event: a miss outside warmup/swap means an
+        # export+compile on the serving path — exactly the "why did p99
+        # spike" answer a flight dump should contain
+        from raft_tpu.observability import flight as _flight
+        _flight.record_event("aot.cache_miss", kind=kind, batch=int(batch),
+                             k=int(k), n_probes=int(n_probes),
+                             scan_mode=scan_mode)
         g = self._export_load(kind, res, index, batch=batch, k=k,
                               n_probes=n_probes, scan_mode=scan_mode,
                               **export_kwargs)

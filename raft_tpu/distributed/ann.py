@@ -69,6 +69,8 @@ from raft_tpu.matrix.select_k import select_k
 from raft_tpu.neighbors import grouped
 from raft_tpu.neighbors import ivf_pq
 from raft_tpu.neighbors import mutate as _mutate
+from raft_tpu.observability import flight as _flight
+from raft_tpu.observability import trace as _rtrace
 from raft_tpu.resilience import faults
 from raft_tpu.resilience import retry as _retry
 
@@ -145,6 +147,10 @@ def _note_lowered(mode: str) -> None:
         obs.registry().counter("distributed.ann.scan_mode_lowered").inc()
         if mode == "fused":
             obs.registry().counter("ivf_pq.search.fused_fallback").inc()
+    rec = _rtrace.current()
+    _flight.record_event("distributed.scan_mode_lowered",
+                         trace_id=rec.trace_id if rec else None,
+                         requested=mode)
 
 
 def _note_fused_fallback() -> None:
@@ -154,6 +160,9 @@ def _note_fused_fallback() -> None:
     from raft_tpu import observability as obs
     if obs.enabled():
         obs.registry().counter("ivf_pq.search.fused_fallback").inc()
+    rec = _rtrace.current()
+    _flight.record_event("ivf_pq.fused_fallback",
+                         trace_id=rec.trace_id if rec else None)
 
 
 def _resolve_scan_mode(params, index, nq: int, n_probes: int,
@@ -711,6 +720,29 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
         n_probes = min(params.n_probes,
                        index.n_lists if routed else index.centers.shape[1])
         r = _resolve_scan_mode(params, index, nq, n_probes, k)
+        # per-request tracing: annotate the ambient recorder (pushed by
+        # the serving batcher around its executor call) with the host-
+        # static facts of this dispatch.  Everything attached here is
+        # already on the host — NO new device->host syncs; the scanned-
+        # rows counter below rides along as a lazy device array that only
+        # flight.dump() materializes.
+        rec = _rtrace.current()
+        if rec is not None:
+            rec.annotate("distributed.scan_mode",
+                         {"probe_recon": "recon"}.get(r.form, r.form))
+            rec.annotate("distributed.n_probes", int(n_probes))
+            # same host values _status_vector encodes, without the
+            # device round-trip
+            status = np.full(index.n_shards,
+                             SHARD_OK_FALLBACK if r.lowered else SHARD_OK,
+                             np.int8)
+            status[list(failed)] = SHARD_FAILED
+            rec.annotate("distributed.shard_status", status.tolist())
+        if failed:
+            _flight.record_event("distributed.degraded_search",
+                                 trace_id=rec.trace_id if rec else None,
+                                 failed=list(failed),
+                                 n_shards=index.n_shards)
         scanned = None
         if routed:
             if r.form == "probe_recon":
@@ -751,6 +783,10 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
                                 "ivf_pq.search.group_overflow").inc()
                         worst, _ = grouped.group_capacity(
                             nq, n_probes, index.local_centers.shape[1])
+                        _flight.record_event(
+                            "ivf_pq.group_overflow",
+                            trace_id=rec.trace_id if rec else None,
+                            calibrated_groups=r.n_groups, worst=worst)
                         d, i, scanned, needed = dispatch(worst)
         elif r.form == "probe_recon":
             leaves = (index.centers, index.list_indices, index.rotation,
@@ -785,6 +821,11 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
                     comms.axis_name, handle.mesh, r.n_groups, r.form,
                     use_pallas=r.use_pallas, failed=failed),
                 retry_policy, deadline)
+        if rec is not None and scanned is not None:
+            # lazy attachment: `scanned` is a device array; annotate()
+            # stores the reference without fetching it (no host sync on
+            # the dispatch path — flight.dump() materializes it later)
+            rec.annotate("distributed.scanned_rows", scanned)
         out = [d, i]
         if return_status:
             out.append(_status_vector(index.n_shards, failed, r.lowered))

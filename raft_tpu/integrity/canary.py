@@ -157,6 +157,12 @@ def health_check(res, index, *, raise_on_fail: bool = True
     if not report.ok:
         if obs.enabled():
             obs.registry().counter("integrity.canary.failures").inc()
+        # always-on flight event: a canary failure usually precedes a
+        # rollback / serving error — the post-mortem timeline needs it
+        from raft_tpu.observability import flight as _flight
+        _flight.record_event("integrity.canary_failure",
+                             recall=rec, floor=cs.floor,
+                             n_queries=cs.n_queries, k=cs.k)
         if raise_on_fail:
             raise IntegrityError(
                 f"canary recall {rec:.3f} below floor {cs.floor:.3f} "

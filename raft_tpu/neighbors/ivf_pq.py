@@ -1579,6 +1579,11 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
         def note_fused_fallback():
             if obs.enabled():
                 obs.registry().counter("ivf_pq.search.fused_fallback").inc()
+            from raft_tpu.observability import flight as _flight
+            from raft_tpu.observability import trace as _rtrace
+            rec = _rtrace.current()
+            _flight.record_event("ivf_pq.fused_fallback",
+                                 trace_id=rec.trace_id if rec else None)
 
         tracing = (isinstance(queries, jax.core.Tracer)
                    or isinstance(index.centers, jax.core.Tracer))

@@ -28,6 +28,8 @@ from raft_tpu.observability.registry import (
     Histogram,
     MetricsRegistry,
     Timer,
+    WINDOW_INTERVAL_S,
+    WINDOW_SLOTS,
     collecting,
     disable,
     enable,
@@ -39,6 +41,17 @@ from raft_tpu.observability.registry import (
 from raft_tpu.observability.stage import fence, stage
 from raft_tpu.observability.export import to_json, to_prometheus
 from raft_tpu.observability.report import BuildReport, build_report, build_scope
+from raft_tpu.observability import flight
+from raft_tpu.observability import trace
+from raft_tpu.observability.trace import (
+    Span,
+    SpanRecorder,
+    disable_tracing,
+    enable_tracing,
+    start_request,
+    tracing,
+    tracing_scope,
+)
 
 __all__ = [
     "Counter",
@@ -47,18 +60,29 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "Timer",
+    "WINDOW_INTERVAL_S",
+    "WINDOW_SLOTS",
     "BuildReport",
+    "Span",
+    "SpanRecorder",
     "build_report",
     "build_scope",
     "collecting",
     "disable",
+    "disable_tracing",
     "enable",
+    "enable_tracing",
     "enabled",
     "fence",
+    "flight",
     "registry",
     "reset",
     "snapshot",
     "stage",
+    "start_request",
     "to_json",
     "to_prometheus",
+    "trace",
+    "tracing",
+    "tracing_scope",
 ]

@@ -71,4 +71,16 @@ def to_prometheus(snapshot: Optional[Dict] = None, *,
         # quantiles, but p50/p95/p99 are the numbers dashboards want
         for q in ("p50", "p95", "p99"):
             lines.append(f"{pname}_{q} {h[q]}")
+    # windowed telemetry (PR 11): recent-interval counts and quantiles as
+    # gauges — they rise AND fall with load, unlike the lifetime series
+    win = snapshot.get("window") or {}
+    for name, value in sorted(win.get("counters", {}).items()):
+        pname = _prom_name(name, prefix) + "_window"
+        lines.append(f"# TYPE {pname} gauge")
+        lines.append(f"{pname} {value}")
+    for name, h in sorted(win.get("histograms", {}).items()):
+        pname = _prom_name(name, prefix) + "_window"
+        for field in ("count", "sum", "max", "p50", "p95", "p99"):
+            lines.append(f"# TYPE {pname}_{field} gauge")
+            lines.append(f"{pname}_{field} {h[field]}")
     return "\n".join(lines) + "\n"

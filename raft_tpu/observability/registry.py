@@ -15,6 +15,15 @@ manager.
 
 Thread-safety: metric mutation is guarded by a per-registry lock — stages can
 close on worker threads (e.g. host callbacks, jax.monitoring listeners).
+
+Windowed telemetry (PR 11): counters and histograms additionally maintain a
+rotating ring of fixed-interval slots, so ``snapshot()["window"]`` exposes
+counts and p50/p95/p99 over roughly the last ``interval * slots`` seconds
+instead of process lifetime.  Rotation is lazy (on record — no background
+thread): each slot remembers the absolute interval index ("epoch") it was
+last written in and is zeroed when reused, so an idle metric simply ages out
+of the window.  This is the surface the SLO planner (ROADMAP item 3) and
+load-aware routing (item 4) consume.
 """
 
 from __future__ import annotations
@@ -23,26 +32,65 @@ import bisect
 import contextlib
 import math
 import threading
-from typing import Dict, Iterator, Optional, Sequence, Tuple
+import time
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+# Window defaults: 12 slots x 5 s = ~60 s of recent history.  Kept cheap:
+# one int division + one ring-slot update per record.
+WINDOW_INTERVAL_S = 5.0
+WINDOW_SLOTS = 12
+
+# Injectable clock (tests monkeypatch this to step time deterministically).
+# monotonic matches the serving path's enqueue/deadline clock.
+_now = time.monotonic
 
 
 class Counter:
-    """Monotonic counter (e.g. ``comms.allreduce.calls``, ``xla.compiles``)."""
+    """Monotonic counter (e.g. ``comms.allreduce.calls``, ``xla.compiles``).
 
-    __slots__ = ("name", "_value", "_lock")
+    Alongside the lifetime total, ``inc`` maintains the rotating window ring
+    (see module docstring); :meth:`windowed` reads the recent-interval count.
+    Call sites are collection-gated — when ``enabled()`` is False nothing
+    calls :meth:`inc`, so a disabled counter costs nothing (pinned by
+    tests/test_tracing.py::TestDisabledPathCost).
+    """
 
-    def __init__(self, name: str, lock: threading.RLock) -> None:
+    __slots__ = ("name", "_value", "_lock", "_win_interval", "_win_slots",
+                 "_win_epoch", "_win_counts")
+
+    def __init__(self, name: str, lock: threading.RLock,
+                 window: Tuple[float, int] = (WINDOW_INTERVAL_S,
+                                              WINDOW_SLOTS)) -> None:
         self.name = name
         self._value = 0
         self._lock = lock
+        self._win_interval = float(window[0])
+        self._win_slots = int(window[1])
+        self._win_epoch = [-1] * self._win_slots
+        self._win_counts = [0] * self._win_slots
 
     def inc(self, n: int = 1) -> None:
+        epoch = int(_now() / self._win_interval)
+        idx = epoch % self._win_slots
         with self._lock:
             self._value += n
+            if self._win_epoch[idx] != epoch:
+                self._win_epoch[idx] = epoch
+                self._win_counts[idx] = 0
+            self._win_counts[idx] += n
 
     @property
     def value(self) -> int:
         return self._value
+
+    def windowed(self) -> int:
+        """Count over the last ``interval * slots`` seconds (approximate:
+        includes the currently-filling slot, drops whole expired slots)."""
+        epoch = int(_now() / self._win_interval)
+        lo = epoch - self._win_slots + 1
+        with self._lock:
+            return sum(c for e, c in zip(self._win_epoch, self._win_counts)
+                       if lo <= e <= epoch)
 
 
 class Gauge:
@@ -105,6 +153,25 @@ DEFAULT_HISTOGRAM_BOUNDS: Tuple[float, ...] = tuple(
     1e-6 * 2.0 ** i for i in range(27))
 
 
+def _quantile_of(counts: Sequence[int], count: int,
+                 bounds: Sequence[float], maxv: float, q: float) -> float:
+    """Linear-interpolated quantile over a bucket-count vector (0.0 when
+    empty).  Shared by the lifetime and windowed views — caller holds the
+    lock (or owns a private copy)."""
+    if count == 0:
+        return 0.0
+    target = q * count
+    seen = 0.0
+    for i, c in enumerate(counts):
+        if seen + c >= target and c > 0:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i] if i < len(bounds) else maxv
+            frac = (target - seen) / c
+            return min(lo + frac * (hi - lo), maxv)
+        seen += c
+    return maxv
+
+
 class Histogram:
     """Fixed-bucket distribution (e.g. ``serving.latency.total``).
 
@@ -120,10 +187,13 @@ class Histogram:
     """
 
     __slots__ = ("name", "bounds", "counts", "count", "sum", "min", "max",
-                 "_lock")
+                 "_lock", "_win_interval", "_win_slots", "_win_epoch",
+                 "_win_counts", "_win_n", "_win_sum", "_win_max")
 
     def __init__(self, name: str, lock: threading.RLock,
-                 bounds: Optional[Sequence[float]] = None) -> None:
+                 bounds: Optional[Sequence[float]] = None,
+                 window: Tuple[float, int] = (WINDOW_INTERVAL_S,
+                                              WINDOW_SLOTS)) -> None:
         self.name = name
         self.bounds = tuple(float(b) for b in
                             (bounds if bounds is not None
@@ -136,32 +206,42 @@ class Histogram:
         self.min = math.inf
         self.max = 0.0
         self._lock = lock
+        self._win_interval = float(window[0])
+        self._win_slots = int(window[1])
+        self._win_epoch = [-1] * self._win_slots
+        self._win_counts: List[List[int]] = [
+            [0] * len(self.counts) for _ in range(self._win_slots)]
+        self._win_n = [0] * self._win_slots
+        self._win_sum = [0.0] * self._win_slots
+        self._win_max = [0.0] * self._win_slots
 
     def observe(self, value: float) -> None:
         value = float(value)
         idx = bisect.bisect_left(self.bounds, value)
+        epoch = int(_now() / self._win_interval)
+        widx = epoch % self._win_slots
         with self._lock:
             self.counts[idx] += 1
             self.count += 1
             self.sum += value
             self.min = min(self.min, value)
             self.max = max(self.max, value)
+            if self._win_epoch[widx] != epoch:
+                self._win_epoch[widx] = epoch
+                self._win_counts[widx] = [0] * len(self.counts)
+                self._win_n[widx] = 0
+                self._win_sum[widx] = 0.0
+                self._win_max[widx] = 0.0
+            self._win_counts[widx][idx] += 1
+            self._win_n[widx] += 1
+            self._win_sum[widx] += value
+            self._win_max[widx] = max(self._win_max[widx], value)
 
     def quantile(self, q: float) -> float:
         """Estimated value at quantile ``q`` in [0, 1] (0.0 when empty)."""
         with self._lock:
-            if self.count == 0:
-                return 0.0
-            target = q * self.count
-            seen = 0.0
-            for i, c in enumerate(self.counts):
-                if seen + c >= target and c > 0:
-                    lo = self.bounds[i - 1] if i > 0 else 0.0
-                    hi = self.bounds[i] if i < len(self.bounds) else self.max
-                    frac = (target - seen) / c
-                    return min(lo + frac * (hi - lo), self.max)
-                seen += c
-            return self.max
+            return _quantile_of(self.counts, self.count, self.bounds,
+                                self.max, q)
 
     def as_dict(self) -> Dict[str, object]:
         with self._lock:
@@ -177,12 +257,47 @@ class Histogram:
                 "counts": list(self.counts),
             }
 
+    def windowed_dict(self) -> Dict[str, object]:
+        """Distribution over the last ``interval * slots`` seconds only:
+        count / sum / max and interpolated p50/p95/p99 (same estimator as
+        the lifetime view, over the merged in-window bucket vectors)."""
+        epoch = int(_now() / self._win_interval)
+        lo = epoch - self._win_slots + 1
+        with self._lock:
+            counts = [0] * len(self.counts)
+            n = 0
+            total = 0.0
+            mx = 0.0
+            for i in range(self._win_slots):
+                if lo <= self._win_epoch[i] <= epoch:
+                    for j, c in enumerate(self._win_counts[i]):
+                        counts[j] += c
+                    n += self._win_n[i]
+                    total += self._win_sum[i]
+                    mx = max(mx, self._win_max[i])
+            return {
+                "count": n,
+                "sum": total,
+                "max": mx,
+                "p50": _quantile_of(counts, n, self.bounds, mx, 0.50),
+                "p95": _quantile_of(counts, n, self.bounds, mx, 0.95),
+                "p99": _quantile_of(counts, n, self.bounds, mx, 0.99),
+            }
+
 
 class MetricsRegistry:
-    """Named metric store with get-or-create accessors and snapshot/reset."""
+    """Named metric store with get-or-create accessors and snapshot/reset.
 
-    def __init__(self) -> None:
+    ``window_interval_s`` / ``window_slots`` fix the rotating-window layout
+    for every counter/histogram created by this registry (see module
+    docstring); the merged recent-interval view is the ``"window"`` section
+    of :meth:`snapshot`.
+    """
+
+    def __init__(self, *, window_interval_s: float = WINDOW_INTERVAL_S,
+                 window_slots: int = WINDOW_SLOTS) -> None:
         self._lock = threading.RLock()
+        self._window = (float(window_interval_s), int(window_slots))
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._timers: Dict[str, Timer] = {}
@@ -192,7 +307,8 @@ class MetricsRegistry:
         with self._lock:
             m = self._counters.get(name)
             if m is None:
-                m = self._counters[name] = Counter(name, self._lock)
+                m = self._counters[name] = Counter(name, self._lock,
+                                                   self._window)
             return m
 
     def gauge(self, name: str) -> Gauge:
@@ -217,11 +333,15 @@ class MetricsRegistry:
             m = self._histograms.get(name)
             if m is None:
                 m = self._histograms[name] = Histogram(name, self._lock,
-                                                       bounds)
+                                                       bounds, self._window)
             return m
 
     def snapshot(self) -> Dict[str, Dict]:
-        """Point-in-time copy: plain dicts, safe to mutate / serialize."""
+        """Point-in-time copy: plain dicts, safe to mutate / serialize.
+
+        The ``"window"`` section re-aggregates counters and histograms over
+        the rotating recent interval only (``span_s`` seconds); the other
+        sections remain process-lifetime, unchanged from PR 5."""
         with self._lock:
             return {
                 "counters": {n: c.value for n, c in self._counters.items()},
@@ -229,6 +349,14 @@ class MetricsRegistry:
                 "timers": {n: t.as_dict() for n, t in self._timers.items()},
                 "histograms": {n: h.as_dict()
                                for n, h in self._histograms.items()},
+                "window": {
+                    "interval_s": self._window[0],
+                    "span_s": self._window[0] * self._window[1],
+                    "counters": {n: c.windowed()
+                                 for n, c in self._counters.items()},
+                    "histograms": {n: h.windowed_dict()
+                                   for n, h in self._histograms.items()},
+                },
             }
 
     def reset(self) -> None:

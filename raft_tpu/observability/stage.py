@@ -32,6 +32,7 @@ import contextlib
 import jax
 
 from raft_tpu.core.tracing import range as _trace_range
+from raft_tpu.observability import trace as _request_trace
 from raft_tpu.observability.registry import (
     MetricsRegistry,
     enabled as _enabled,
@@ -103,7 +104,12 @@ def stage(name: str,
         try:
             yield _StageHandle(name)
         finally:
-            reg.timer(name).record(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            reg.timer(name).record(dt)
+            # mirror onto the ambient request trace (one flag check when
+            # per-request tracing is off) so stage timers nest inside
+            # request spans under the same labels
+            _request_trace.stage_hook(name, dt)
 
 
 # ---------------------------------------------------------------------------

@@ -12,7 +12,9 @@ instead of wasting a bucket slot.
 
 Counters (collection-gated): ``serving.admitted``,
 ``serving.shed.queue_full``, ``serving.shed.quota``,
-``serving.shed.deadline``.
+``serving.shed.deadline``.  Every shed additionally lands an anomaly
+event of the same name in the always-on flight recorder (flight.py),
+carrying the request's trace id when tracing is enabled.
 """
 
 from __future__ import annotations
@@ -25,6 +27,7 @@ from typing import Dict, Optional, Tuple
 
 from raft_tpu import observability as obs
 from raft_tpu.core.error import RaftError
+from raft_tpu.observability import flight as _flight
 from raft_tpu.resilience.retry import Deadline
 
 
@@ -83,6 +86,13 @@ class Request:
     # per-row validity from the boundary validator under policy "mask"
     # (None under "raise"/"off"); applied to this request's output slice
     ok_rows: Optional[object] = None
+    # per-request SpanRecorder minted by Server.submit when tracing is
+    # enabled (None otherwise); the batcher closes + flight-records it
+    trace: Optional[object] = None
+
+    @property
+    def trace_id(self) -> Optional[int]:
+        return self.trace.trace_id if self.trace is not None else None
 
 
 class AdmissionQueue:
@@ -111,17 +121,30 @@ class AdmissionQueue:
         """Admit or shed (raises :class:`Overloaded` / subclasses)."""
         if req.deadline is not None and req.deadline.expired:
             _count("serving.shed.deadline")
+            _flight.record_event("serving.shed.deadline",
+                                 trace_id=req.trace_id,
+                                 tenant=req.tenant, rows=req.n,
+                                 phase="submit")
             raise Overloaded(
                 "serving: request deadline already expired at submit")
         bucket = self._buckets.get(req.tenant)
         if bucket is not None and not bucket.try_acquire(req.n):
             _count("serving.shed.quota")
+            _flight.record_event("serving.shed.quota",
+                                 trace_id=req.trace_id,
+                                 tenant=req.tenant, rows=req.n,
+                                 rate=bucket.rate, burst=bucket.burst)
             raise QuotaExceeded(
                 f"serving: tenant {req.tenant!r} exceeded its quota "
                 f"({bucket.rate:g} rows/s, burst {bucket.burst:g})")
         with self.cond:
             if self._rows + req.n > self._max_rows:
                 _count("serving.shed.queue_full")
+                _flight.record_event("serving.shed.queue_full",
+                                     trace_id=req.trace_id,
+                                     tenant=req.tenant, rows=req.n,
+                                     queued_rows=self._rows,
+                                     bound=self._max_rows)
                 raise Overloaded(
                     f"serving: queue full ({self._rows} rows queued, "
                     f"bound {self._max_rows}) — retry with backoff")

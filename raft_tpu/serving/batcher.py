@@ -26,6 +26,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu import observability as obs
+from raft_tpu.observability import flight as _flight
+from raft_tpu.observability import trace as _trace
 from raft_tpu.resilience.retry import DeadlineExceededError
 from raft_tpu.serving.admission import AdmissionQueue
 from raft_tpu.serving.buckets import bucket_for
@@ -116,6 +118,14 @@ class DynamicBatcher:
         for r in batch:
             if r.deadline is not None and r.deadline.expired:
                 _count("serving.expired")
+                _flight.record_event("serving.shed.deadline",
+                                     trace_id=r.trace_id, tenant=r.tenant,
+                                     rows=r.n, phase="dispatch",
+                                     queued_s=t_dispatch - r.t_enqueue)
+                if r.trace is not None:
+                    r.trace.span("serving.queue", r.t_enqueue, t_dispatch)
+                    r.trace.annotate("shed", True)
+                    _flight.record_trace(r.trace.close(t_dispatch))
                 r.future.set_exception(DeadlineExceededError(
                     f"serving: deadline expired after "
                     f"{t_dispatch - r.t_enqueue:.3f}s in queue"))
@@ -126,6 +136,16 @@ class DynamicBatcher:
         k = live[0].k
         n = sum(r.n for r in live)
         bucket = bucket_for(n, self.max_batch)
+        # batch-level recorder: the batch's cut/exec spans and whatever the
+        # executor path annotates (scan mode, shard status, scanned rows —
+        # see distributed.ann.search) are recorded once here and adopted
+        # into every live request's trace afterwards.  Spans are immutable,
+        # so sharing them across traces is safe.
+        traced = [r for r in live if r.trace is not None]
+        batch_rec = (_trace.SpanRecorder("serving.batch",
+                                         trace_id=traced[0].trace.trace_id,
+                                         t0=t_dispatch)
+                     if traced else None)
         # batch assembly and result slicing are HOST-side numpy: request
         # sizes vary continuously, and any jnp op keyed on them
         # (concatenate / pad / slice) would compile per novel shape —
@@ -137,18 +157,35 @@ class DynamicBatcher:
         for r in live:
             buf[off:off + r.n] = np.asarray(r.queries)
             off += r.n
+        t_exec0 = time.monotonic()
         try:
-            d, i = self.executor.search_bucket(jnp.asarray(buf), n, k)
-            # graftlint: disable=host-sync -- THE one readback: results must leave the device to resolve request futures
-            d, i = np.asarray(d), np.asarray(i)
+            with _trace.activating(batch_rec):
+                d, i = self.executor.search_bucket(jnp.asarray(buf), n, k)
+                # graftlint: disable=host-sync -- THE one readback: results must leave the device to resolve request futures
+                d, i = np.asarray(d), np.asarray(i)
         except BaseException as e:  # noqa: BLE001 - forwarded per request
+            _flight.record_event("serving.batch_error",
+                                 trace_id=(traced[0].trace.trace_id
+                                           if traced else None),
+                                 error=repr(e), rows=n, bucket=bucket, k=k)
+            for r in traced:
+                r.trace.annotate("error", repr(e))
+                _flight.record_trace(r.trace.close())
+            # post-mortem artifact: if RAFT_TPU_FLIGHT_DUMP is set, the
+            # ring (this error included) is written before futures fail
+            _flight.maybe_auto_dump("serving.batch_error")
             for r in live:
                 r.future.set_exception(e)
             return
         t_done = time.monotonic()
+        if batch_rec is not None:
+            batch_rec.span("serving.batch_cut", t_dispatch, t_exec0,
+                           rows=n, bucket=bucket, requests=len(live))
+            batch_rec.span("serving.exec", t_exec0, t_done)
         self._record(live, n, bucket, t_dispatch, t_done)
         off = 0
         worst = np.inf if self.executor.select_min else -np.inf
+        results = []
         for r in live:
             rd = d[off:off + r.n]
             ri = i[off:off + r.n]
@@ -160,6 +197,15 @@ class DynamicBatcher:
                 rd = np.where(bad, np.asarray(worst, rd.dtype), rd)
                 ri = np.where(bad, np.asarray(-1, ri.dtype), ri)
             off += r.n
+            results.append((r, rd, ri))
+        t_sliced = time.monotonic()
+        for r, rd, ri in results:
+            if r.trace is not None:
+                rt = r.trace
+                rt.span("serving.queue", r.t_enqueue, t_dispatch)
+                rt.adopt(batch_rec)
+                rt.span("serving.result_slice", t_done, t_sliced)
+                _flight.record_trace(rt.close(t_sliced))
             r.future.set_result((rd, ri))
         if self._on_batch is not None:
             self._on_batch(n, bucket)

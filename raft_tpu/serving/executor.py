@@ -46,6 +46,7 @@ import jax.numpy as jnp
 from raft_tpu import observability as obs
 from raft_tpu.core.aot import executables as _aot_executables
 from raft_tpu.core.error import expects
+from raft_tpu.observability import flight as _flight
 from raft_tpu.distance.types import DistanceType
 from raft_tpu.integrity import boundary as _boundary
 from raft_tpu.serving.buckets import bucket_sizes, pad_rows, valid_rows_mask
@@ -229,6 +230,12 @@ class Executor:
         self.index, self._fns = new_index, fns
         if obs.enabled():
             obs.registry().counter("serving.generation_swaps").inc()
+        # always-on flight event: a generation swap is exactly the kind of
+        # state change a post-mortem needs to see next to shed/error events
+        _flight.record_event("serving.generation_swap",
+                             generation=getattr(new_index, "generation",
+                                                None),
+                             executables=len(fns))
         return len(fns)
 
     # ---- the hot path ---------------------------------------------------

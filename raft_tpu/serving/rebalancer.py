@@ -62,6 +62,7 @@ import numpy as np
 from raft_tpu import observability as obs
 from raft_tpu.core.error import expects
 from raft_tpu.integrity import canary as _canary
+from raft_tpu.observability import flight as _flight
 from raft_tpu.integrity.verify import verify as _verify_index
 from raft_tpu.neighbors import ivf_flat, ivf_pq
 from raft_tpu.neighbors import mutate as _mutate
@@ -201,6 +202,11 @@ class Rebalancer:
                 self._stats["errors"] += 1
         ck.clear()
         self._stats["rollbacks"] += 1
+        # a rollback means a candidate generation was abandoned — exactly
+        # the state transition a post-mortem wants on the anomaly timeline
+        _flight.record_event("rebalance.rollback",
+                             generation=_mutate.generation(self.last_good),
+                             errors=self._stats["errors"])
         return self.last_good
 
     # ---- stages ---------------------------------------------------------

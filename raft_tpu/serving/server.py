@@ -33,6 +33,8 @@ from raft_tpu import observability as obs
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.integrity import boundary as _boundary
+from raft_tpu.observability import flight as _flight
+from raft_tpu.observability import trace as _trace
 from raft_tpu.resilience.retry import Deadline
 from raft_tpu.serving.admission import AdmissionQueue, Overloaded, Request
 from raft_tpu.serving.batcher import DynamicBatcher
@@ -126,6 +128,11 @@ class Server:
         distance (the integrity mask path).
         """
         expects(self._started, "serving: server not started")
+        # per-request trace: minted HERE, at the front door, so spans from
+        # admission / queue / batch / exec all hang off one trace id.  One
+        # flag check when tracing is off.
+        rt = _trace.start_request() if _trace.tracing() else None
+        t_sub = rt.t0 if rt is not None else 0.0
         k = int(k) if k is not None else self.executor.ks[0]
         expects(k in self.executor.ks,
                 f"serving: k={k} is not in the warmed set {self.executor.ks}")
@@ -152,8 +159,24 @@ class Server:
             deadline = Deadline(self.config.default_deadline_s)
         req = Request(queries=queries, k=k, tenant=tenant,
                       deadline=deadline, future=Future(), n=n,
-                      t_enqueue=time.monotonic(), ok_rows=ok_rows)
-        self.queue.offer(req)
+                      t_enqueue=time.monotonic(), ok_rows=ok_rows,
+                      trace=rt)
+        if rt is not None:
+            rt.annotate("tenant", tenant)
+            rt.annotate("rows", n)
+            rt.annotate("k", k)
+        try:
+            self.queue.offer(req)
+        except Overloaded:
+            if rt is not None:
+                # shed at the door: the trace still lands in the flight
+                # recorder (the shed event itself is recorded by offer())
+                rt.span("serving.admission", t_sub, _trace.now())
+                rt.annotate("shed", True)
+                _flight.record_trace(rt.close())
+            raise
+        if rt is not None:
+            rt.span("serving.admission", t_sub, _trace.now())
         return req.future
 
     def search(self, queries, k: Optional[int] = None, *,

@@ -16,7 +16,12 @@ not guessed at:
   (in ``tests/`` these are reads of names the library must define);
 - ``snapshot()["counters"]["…"]`` subscripts, ``["…"] .get(…)`` calls
   and ``"…" in snap["timers"]`` membership tests;
-- ``plan.at("…")`` / ``inject("…")`` fault-site scripting calls.
+- ``plan.at("…")`` / ``inject("…")`` fault-site scripting calls;
+- ``flight.events("…")`` filters and ``record_event("…")`` calls
+  (anomaly event names — a typo'd filter matches nothing forever);
+- ``span("…")`` / ``SpanRecorder("…")`` / ``start_request("…")`` calls
+  (trace-span names; stage labels resolve as spans too, since
+  ``stage()`` mirrors its timing onto the ambient trace).
 
 A name is only policed when its first dotted segment is a namespace
 root the registry knows (``serving.``, ``integrity.``, ``comms.``, …)
@@ -42,6 +47,8 @@ from scripts.graftlint.registry import build_registry
 _METRIC_CALLS = {"counter", "gauge", "timer", "histogram"}
 _SNAPSHOT_KINDS = {"counters", "gauges", "timers", "histograms"}
 _SITE_CALLS = {"at", "inject", "maybe_fail"}
+_EVENT_CALLS = {"events", "record_event"}
+_SPAN_CALLS = {"span", "SpanRecorder", "start_request"}
 
 
 def _snapshot_kind(node: ast.AST) -> Optional[str]:
@@ -67,49 +74,61 @@ class RegistryConsistencyPass:
         reg = build_registry(project)
         roots = reg.roots()
         out: List[Diagnostic] = []
+        checks = {
+            "metric": (reg.resolves_metric,
+                       "metric '{0}' is never recorded by raft_tpu/ — "
+                       "a typo'd name reads 0 forever"),
+            "site": (reg.resolves_site,
+                     "fault site '{0}' matches no maybe_fail() site in "
+                     "raft_tpu/ — the scripted failure can never fire"),
+            "event": (reg.resolves_event,
+                      "flight event '{0}' is never recorded by "
+                      "raft_tpu/ — a typo'd filter matches nothing"),
+            "span": (reg.resolves_span,
+                     "span '{0}' matches no span or stage recorded by "
+                     "raft_tpu/ — a typo'd span name never appears in "
+                     "a trace"),
+        }
         for mod in project.walk("raft_tpu/", "tests/"):
-            for name, line, is_site in self._references(mod):
+            for name, line, kind in self._references(mod):
                 if "." not in name or name.split(".")[0] not in roots:
                     continue
-                if is_site:
-                    if not reg.resolves_site(name):
-                        out.append(Diagnostic(
-                            mod.rel, line, "registry-consistency",
-                            f"fault site '{name}' matches no "
-                            f"maybe_fail() site in raft_tpu/ — the "
-                            f"scripted failure can never fire"))
-                elif not reg.resolves_metric(name):
+                resolves, msg = checks[kind]
+                if not resolves(name):
                     out.append(Diagnostic(
                         mod.rel, line, "registry-consistency",
-                        f"metric '{name}' is never recorded by "
-                        f"raft_tpu/ — a typo'd name reads 0 forever"))
+                        msg.format(name)))
         return out
 
-    def _references(self, mod) -> List[Tuple[str, int, bool]]:
-        refs: List[Tuple[str, int, bool]] = []
+    def _references(self, mod) -> List[Tuple[str, int, str]]:
+        refs: List[Tuple[str, int, str]] = []
 
-        def add(name: Optional[str], line: int, is_site: bool) -> None:
+        def add(name: Optional[str], line: int, kind: str) -> None:
             if name:
-                refs.append((name, line, is_site))
+                refs.append((name, line, kind))
 
         for node in ast.walk(mod.tree):
             if isinstance(node, ast.Call):
                 callee = terminal_name(node.func)
                 if callee in _METRIC_CALLS and node.args:
-                    add(str_const(node.args[0]), node.lineno, False)
+                    add(str_const(node.args[0]), node.lineno, "metric")
                 elif callee in _SITE_CALLS and node.args:
-                    add(str_const(node.args[0]), node.lineno, True)
+                    add(str_const(node.args[0]), node.lineno, "site")
+                elif callee in _EVENT_CALLS and node.args:
+                    add(str_const(node.args[0]), node.lineno, "event")
+                elif callee in _SPAN_CALLS and node.args:
+                    add(str_const(node.args[0]), node.lineno, "span")
                 elif (callee == "get" and node.args
                       and isinstance(node.func, ast.Attribute)
                       and _snapshot_kind(node.func.value)):
-                    add(str_const(node.args[0]), node.lineno, False)
+                    add(str_const(node.args[0]), node.lineno, "metric")
             elif isinstance(node, ast.Subscript):
                 if _snapshot_kind(node.value):
-                    add(str_const(node.slice), node.lineno, False)
+                    add(str_const(node.slice), node.lineno, "metric")
             elif isinstance(node, ast.Compare):
                 # "name" in snap["timers"]
                 if (len(node.ops) == 1
                         and isinstance(node.ops[0], (ast.In, ast.NotIn))
                         and _snapshot_kind(node.comparators[0])):
-                    add(str_const(node.left), node.lineno, False)
+                    add(str_const(node.left), node.lineno, "metric")
         return refs

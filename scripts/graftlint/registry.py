@@ -13,7 +13,12 @@ hand-maintained list:
   ``.timer(...)`` / ``.histogram(...)`` under ``raft_tpu/``;
 - **stages** — every ``stage("...")`` label (stage labels become timer
   names on exit);
-- **fault sites** — every ``maybe_fail("...")`` site.
+- **fault sites** — every ``maybe_fail("...")`` site;
+- **spans** — every ``span("...")`` / ``SpanRecorder("...")`` /
+  ``start_request("...")`` trace-span name (stage labels also resolve as
+  spans: ``stage()`` mirrors its timing onto the ambient trace);
+- **events** — every ``record_event("...")`` flight-recorder anomaly
+  name.
 
 Dynamic names resolve one level of indirection: when the name argument
 is a bare parameter of the enclosing function (the ``_count(name)``
@@ -41,19 +46,19 @@ from scripts.graftlint.core import (
 )
 
 _METRIC_KINDS = ("counter", "gauge", "timer", "histogram")
+_ALL_KINDS = _METRIC_KINDS + ("stage", "fault_site", "span", "event")
 
 
 @dataclasses.dataclass
 class Registry:
     """Exact names and f-string prefixes per kind.  ``kind`` is one of
-    the metric kinds, ``"stage"`` or ``"fault_site"``."""
+    the metric kinds, ``"stage"``, ``"fault_site"``, ``"span"`` or
+    ``"event"``."""
 
     names: Dict[str, Set[str]] = dataclasses.field(
-        default_factory=lambda: {k: set() for k in
-                                 _METRIC_KINDS + ("stage", "fault_site")})
+        default_factory=lambda: {k: set() for k in _ALL_KINDS})
     prefixes: Dict[str, Set[str]] = dataclasses.field(
-        default_factory=lambda: {k: set() for k in
-                                 _METRIC_KINDS + ("stage", "fault_site")})
+        default_factory=lambda: {k: set() for k in _ALL_KINDS})
 
     def add(self, kind: str, name: Optional[str], prefix: Optional[str]
             ) -> None:
@@ -102,6 +107,19 @@ class Registry:
         return any(site.startswith(p)
                    for p in self.prefixes["fault_site"])
 
+    def resolves_event(self, name: str) -> bool:
+        if name in self.names["event"]:
+            return True
+        return any(name.startswith(p) for p in self.prefixes["event"])
+
+    def resolves_span(self, name: str) -> bool:
+        """Span names include stage labels: ``stage()`` mirrors its timing
+        as a span under the same label (trace.stage_hook)."""
+        if name in self.names["span"] or name in self.names["stage"]:
+            return True
+        return any(name.startswith(p)
+                   for p in self.prefixes["span"] | self.prefixes["stage"])
+
     def as_dict(self) -> Dict[str, object]:
         return {
             "counters": sorted(self.names["counter"]),
@@ -110,6 +128,8 @@ class Registry:
             "histograms": sorted(self.names["histogram"]),
             "stages": sorted(self.names["stage"]),
             "fault_sites": sorted(self.names["fault_site"]),
+            "spans": sorted(self.names["span"]),
+            "events": sorted(self.names["event"]),
             "prefixes": {k: sorted(v) for k, v in self.prefixes.items()
                          if v},
         }
@@ -143,6 +163,18 @@ def _param_index(fn: ast.AST, name: str) -> Optional[int]:
     names = [a.arg for a in args.posonlyargs + args.args]
     if name in names:
         return names.index(name)
+    return None
+
+
+def _param_default(fn: ast.AST, pos: int) -> Optional[ast.AST]:
+    """The default-value expression of positional parameter ``pos``, if
+    any — ``start_request(name="serving.request")`` defines the root span
+    name through its default, not a call site."""
+    args = fn.args
+    params = args.posonlyargs + args.args
+    first_with_default = len(params) - len(args.defaults)
+    if pos >= first_with_default:
+        return args.defaults[pos - first_with_default]
     return None
 
 
@@ -185,6 +217,10 @@ def build_registry(project: Project) -> Registry:
                 kind = "stage"
             elif callee == "maybe_fail":
                 kind = "fault_site"
+            elif callee in ("span", "SpanRecorder", "start_request"):
+                kind = "span"
+            elif callee == "record_event":
+                kind = "event"
             else:
                 continue
             arg = node.args[0]
@@ -205,6 +241,10 @@ def build_registry(project: Project) -> Registry:
                     break
             if owner is None:
                 continue
+            default = _param_default(owner, pos)
+            if default is not None:
+                name, prefix = _literal_or_prefix(default)
+                reg.add(kind, name, prefix)
             for call in _calls_of(mod.tree, owner.name):
                 if pos < len(call.args):
                     name, prefix = _literal_or_prefix(call.args[pos])

@@ -39,6 +39,22 @@ def pytest_configure(config):
         "slow: long-running; the fast gate tier runs with -m 'not slow'")
 
 
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """On test failure, dump the flight recorder if RAFT_TPU_FLIGHT_DUMP
+    is set (CI exports it so the Chrome-trace forensics ride the failure
+    artifact).  No-op — not even an env read — on passing tests."""
+    outcome = yield
+    report = outcome.get_result()
+    if report.when == "call" and report.failed:
+        from raft_tpu.observability import flight
+        path = flight.maybe_auto_dump(f"test_failure:{item.nodeid}")
+        if path:
+            tr = item.config.pluginmanager.get_plugin("terminalreporter")
+            if tr is not None:
+                tr.write_line(f"flight dump: {path}")
+
+
 @pytest.fixture
 def res():
     from raft_tpu import DeviceResources

@@ -590,6 +590,30 @@ class TestRoutedAnn:
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
         np.testing.assert_array_equal(np.asarray(d1), np.asarray(d0))
 
+    def test_routed_overflow_lands_flight_event(
+            self, rhandle, data, built, monkeypatch):
+        """The overflow re-dispatch is an anomaly: it must land in the
+        always-on flight recorder with both capacities, even with
+        metrics collection and tracing disabled."""
+        import dataclasses
+        from raft_tpu.distributed import ann
+        from raft_tpu.neighbors import grouped
+        from raft_tpu.observability import flight
+        _, q = data
+        _, ridx = built
+        monkeypatch.setattr(grouped, "_GROUP_ROUND", 1)
+        sp = ivf_pq.SearchParams(n_probes=8, scan_mode="fused")
+        tight = dataclasses.replace(ridx, group_est=0.05)
+        flight.clear()
+        ann.search(rhandle, sp, tight, q, self.K)
+        evs = flight.events("ivf_pq.group_overflow")
+        assert len(evs) >= 1
+        worst, _ = grouped.group_capacity(
+            self.NQ, 8, ridx.local_centers.shape[1])
+        assert evs[0]["attrs"]["worst"] == worst
+        assert evs[0]["attrs"]["calibrated_groups"] < worst
+        assert evs[0]["trace_id"] is None   # no ambient trace active
+
     def test_routed_serialization_carries_code_leaves_and_est(
             self, rhandle, built):
         """Routed envelope v2: lane-major code leaves, pq_bits and the

@@ -292,6 +292,50 @@ class TestRegistryConsistency:
         assert lint({"raft_tpu/serving/obs.py": LIB,
                      "tests/test_x.py": test}) == []
 
+    TRACED = ("def shed(req):\n"
+              "    record_event('serving.shed.deadline', tenant=req.t)\n"
+              "def submit():\n"
+              "    rt = start_request()\n"
+              "    rt.span('serving.admission', 0.0, 1.0)\n"
+              "def timed():\n"
+              "    with stage('serving.cut'):\n"
+              "        pass\n")
+
+    def test_known_event_and_span_references_resolve(self):
+        test = ("def test_x(flight, rec):\n"
+                "    assert flight.events('serving.shed.deadline')\n"
+                "    rec.span('serving.admission', 0.0, 1.0)\n")
+        assert lint({"raft_tpu/serving/obs.py": self.TRACED,
+                     "tests/test_x.py": test},
+                    rules=["registry-consistency"]) == []
+
+    def test_typoed_event_filter_flagged(self):
+        test = ("def test_x(flight):\n"
+                "    assert flight.events('serving.shed.deadlin')\n")
+        diags = lint({"raft_tpu/serving/obs.py": self.TRACED,
+                      "tests/test_x.py": test},
+                     rules=["registry-consistency"])
+        assert [d.rule for d in diags] == ["registry-consistency"]
+        assert "serving.shed.deadlin" in diags[0].message
+
+    def test_typoed_span_name_flagged(self):
+        test = ("def test_x(rec):\n"
+                "    rec.span('serving.admision', 0.0, 1.0)\n")
+        diags = lint({"raft_tpu/serving/obs.py": self.TRACED,
+                      "tests/test_x.py": test},
+                     rules=["registry-consistency"])
+        assert [d.rule for d in diags] == ["registry-consistency"]
+        assert "never appears in a trace" in diags[0].message
+
+    def test_stage_labels_resolve_as_spans(self):
+        # stage() mirrors its timing onto the ambient trace, so a span
+        # reference under a stage label is legitimate
+        test = ("def test_x(rec):\n"
+                "    rec.span('serving.cut', 0.0, 1.0)\n")
+        assert lint({"raft_tpu/serving/obs.py": self.TRACED,
+                     "tests/test_x.py": test},
+                    rules=["registry-consistency"]) == []
+
 
 # ---------------------------------------------------------------------------
 # host-sync
@@ -431,6 +475,17 @@ class TestLiveTree:
         assert reg.resolves_metric("comms.allreduce.calls")
         assert not reg.resolves_metric("serving.admited")
         assert "integrity.health_check" in d["stages"]
+        # trace spans (serving.request registers through the
+        # start_request parameter default) and flight anomaly events
+        assert "serving.request" in d["spans"]
+        assert "serving.exec" in d["spans"]
+        assert "serving.shed.deadline" in d["events"]
+        assert "distributed.degraded_search" in d["events"]
+        assert "ivf_pq.group_overflow" in d["events"]
+        # stage labels double as span names
+        assert reg.resolves_span("serving.latency.total") or \
+            reg.resolves_span("ivf_pq.search.scan")
+        assert not reg.resolves_event("serving.shed.deadlin")
 
     def test_rule_catalogue_complete(self):
         assert {"recompile-hazard", "generation-discipline", "mask-seam",

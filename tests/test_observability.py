@@ -51,8 +51,11 @@ class TestRegistry:
         reg = obs.MetricsRegistry()
         reg.counter("c").inc()
         reg.reset()
-        assert reg.snapshot() == {"counters": {}, "gauges": {},
-                                  "timers": {}, "histograms": {}}
+        snap = reg.snapshot()
+        assert snap["counters"] == {} and snap["gauges"] == {}
+        assert snap["timers"] == {} and snap["histograms"] == {}
+        assert snap["window"]["counters"] == {}
+        assert snap["window"]["histograms"] == {}
 
     def test_get_or_create_identity(self):
         reg = obs.MetricsRegistry()

@@ -623,6 +623,30 @@ class TestDistributedResilience:
                                   return_status=True)
         assert list(np.asarray(status)) == [0, 1, 1, 1, 1, 1, 1, 0]
 
+    def test_degraded_search_lands_flight_event(self, handle, dist_index):
+        """Every degraded dispatch records an always-on flight event
+        (anomaly forensics do not depend on tracing being enabled), and
+        under tracing the ambient trace carries the host-static shard
+        status vector with no extra device->host sync."""
+        from raft_tpu.observability import flight, trace
+        ann, ivf_pq, idx, q = dist_index
+        sp = ivf_pq.SearchParams(n_probes=4)
+        flight.clear()
+        trace.enable_tracing()
+        try:
+            rec = trace.start_request()
+            with trace.activating(rec):
+                ann.search(handle, sp, idx, q, 5, failed_shards=[2, 5])
+        finally:
+            trace.disable_tracing()
+        evs = flight.events("distributed.degraded_search")
+        assert len(evs) == 1
+        assert sorted(evs[0]["attrs"]["failed"]) == [2, 5]
+        assert evs[0]["attrs"]["n_shards"] == 8
+        assert evs[0]["trace_id"] == rec.trace_id
+        status = rec.attrs["distributed.shard_status"]
+        assert status[2] == 0 and status[5] == 0 and status[0] == 1
+
     def test_all_shards_failed_is_fully_padded(self, handle, dist_index):
         ann, ivf_pq, idx, q = dist_index
         sp = ivf_pq.SearchParams(n_probes=4)

@@ -20,6 +20,7 @@ from raft_tpu import observability as obs
 from raft_tpu import serving
 from raft_tpu.core import aot
 from raft_tpu.neighbors import ivf_flat, ivf_pq
+from raft_tpu.observability import flight, trace
 from raft_tpu.resilience.retry import Deadline, DeadlineExceededError
 
 
@@ -27,9 +28,13 @@ from raft_tpu.resilience.retry import Deadline, DeadlineExceededError
 def _clean_registry():
     obs.disable()
     obs.reset()
+    trace.disable_tracing()
+    flight.clear()
     yield
     obs.disable()
     obs.reset()
+    trace.disable_tracing()
+    flight.clear()
 
 
 @pytest.fixture(scope="module", autouse=True)
@@ -543,6 +548,145 @@ class TestGenerationSwap:
             np.asarray(db)[:500, :16])
         with pytest.raises(Exception, match="dim"):
             ex.swap_index(narrow)
+
+
+# ---------------------------------------------------------------------------
+# per-request tracing + flight recorder on the live serving path (PR 11)
+
+
+class TestServingTracing:
+    def test_traced_request_records_full_span_chain(self, pq_setup):
+        ex = _executor(pq_setup, warm="jit")
+        cfg = serving.ServerConfig(max_batch=16, max_wait_us=2_000)
+        q = np.asarray(pq_setup[2])
+        with obs.collecting(), trace.tracing_scope():
+            with serving.Server(ex, cfg) as srv:
+                srv.search(q[:1], 5)              # warm the live path
+                flight.clear()
+                d, i = srv.search(q[:3], 5, tenant="t0")
+        assert d.shape == (3, 5)
+        traces = flight.traces()
+        assert len(traces) == 1
+        rt = traces[0]
+        assert rt.name == "serving.request" and rt.t1 is not None
+        names = [s.name for s in rt.spans]
+        for expected in ("serving.admission", "serving.queue",
+                        "serving.batch_cut", "serving.exec",
+                        "serving.result_slice"):
+            assert expected in names, (expected, names)
+        assert rt.attrs["tenant"] == "t0"
+        assert rt.attrs["rows"] == 3 and rt.attrs["k"] == 5
+        cut = next(s for s in rt.spans if s.name == "serving.batch_cut")
+        assert cut.attrs["rows"] == 3
+
+    def test_untraced_requests_record_nothing(self, pq_setup):
+        ex = _executor(pq_setup, warm="jit")
+        cfg = serving.ServerConfig(max_batch=16, max_wait_us=2_000)
+        q = np.asarray(pq_setup[2])
+        with serving.Server(ex, cfg) as srv:      # tracing off (default)
+            srv.search(q[:3], 5)
+        assert flight.traces() == []
+
+    def test_deadline_shed_at_submit_lands_flight_event(self, pq_setup):
+        ex = _executor(pq_setup, warm="jit")
+        with serving.Server(ex, serving.ServerConfig(max_batch=16)) as srv:
+            with pytest.raises(serving.Overloaded):
+                srv.submit(pq_setup[2][:2], 5, deadline=Deadline(0.0))
+        evs = flight.events("serving.shed.deadline")
+        assert len(evs) == 1
+        assert evs[0]["attrs"]["phase"] == "submit"
+        assert evs[0]["attrs"]["rows"] == 2
+
+    def test_deadline_expiry_while_queued_lands_flight_event(self,
+                                                             pq_setup):
+        ex = _executor(pq_setup, warm="jit")
+        cfg = serving.ServerConfig(max_batch=16, max_wait_us=200_000)
+        q = pq_setup[2]
+        t = [0.0]
+        with trace.tracing_scope(), serving.Server(ex, cfg) as srv:
+            dead = Deadline(0.05, clock=lambda: t[0])
+            doomed = srv.submit(q[:2], 5, deadline=dead)
+            t[0] += 1.0                           # budget lapses queued
+            srv.submit(q[:3], 5).result(timeout=10)
+            with pytest.raises(DeadlineExceededError):
+                doomed.result(timeout=10)
+        evs = flight.events("serving.shed.deadline")
+        assert [e["attrs"]["phase"] for e in evs] == ["dispatch"]
+        # the shed request's trace lands in the ring too, marked shed
+        shed = [r for r in flight.traces() if r.attrs.get("shed")]
+        assert len(shed) == 1
+        assert "serving.queue" in [s.name for s in shed[0].spans]
+
+    def test_queue_full_shed_lands_flight_event(self, pq_setup):
+        ex = _executor(pq_setup, warm="jit")
+        cfg = serving.ServerConfig(max_batch=16, max_queue_rows=4,
+                                   max_wait_us=50_000)
+        q = pq_setup[2]
+        srv = serving.Server(ex, cfg).start()
+        try:
+            srv.batcher.stop(drain=False)
+            fut = srv.submit(q[:3], 5)
+            with pytest.raises(serving.Overloaded):
+                srv.submit(q[:3], 5)
+            srv.batcher.start()
+            fut.result(timeout=30)
+        finally:
+            srv.stop()
+        evs = flight.events("serving.shed.queue_full")
+        assert len(evs) == 1
+        assert evs[0]["attrs"]["rows"] == 3
+        assert evs[0]["attrs"]["queued_rows"] == 3
+        assert evs[0]["attrs"]["bound"] == 4
+
+    def test_quota_shed_lands_flight_event(self, pq_setup):
+        ex = _executor(pq_setup, warm="jit")
+        cfg = serving.ServerConfig(
+            max_batch=16, max_wait_us=100.0,
+            tenant_quotas={"metered": (1.0, 4.0)})
+        q = pq_setup[2]
+        with serving.Server(ex, cfg) as srv:
+            srv.search(q[:4], 5, tenant="metered")
+            with pytest.raises(serving.QuotaExceeded):
+                srv.submit(q[:4], 5, tenant="metered")
+        evs = flight.events("serving.shed.quota")
+        assert len(evs) == 1
+        assert evs[0]["attrs"]["tenant"] == "metered"
+
+    def test_swap_index_lands_generation_swap_event(self, pq_setup):
+        res, db, _, index, _ = pq_setup
+        ex = _executor(pq_setup, warm="jit")
+        cfg = serving.ServerConfig(max_batch=16, max_wait_us=2_000)
+        with serving.Server(ex, cfg) as srv:
+            mutated = ivf_pq.delete(res, index, [0, 1, 2])
+            srv.swap_index(mutated)
+        evs = flight.events("serving.generation_swap")
+        assert len(evs) == 1
+        assert evs[0]["attrs"]["generation"] == \
+            getattr(mutated, "generation", None)
+
+    def test_zero_recompiles_with_tracing_enabled(self, pq_setup):
+        """The PR 11 contract: tracing attaches to timestamps and lazy
+        values the serving path already has — enabling it must not
+        change bucket shapes or add compiles on warmed traffic."""
+        ex = _executor(pq_setup, warm="aot")
+        with obs.collecting():
+            srv = serving.Server(
+                ex, serving.ServerConfig(max_batch=16,
+                                         max_wait_us=2_000)).start()
+            q = np.asarray(pq_setup[2])
+            try:
+                for m in (1, 3, 8, 16, 5, 2):
+                    srv.search(q[:m], 5)
+                c0 = obs.registry().counter("xla.compiles").value
+                with trace.tracing_scope():
+                    for m in (2, 16, 1, 7, 4, 16, 3):
+                        srv.search(q[:m], 5)
+                c1 = obs.registry().counter("xla.compiles").value
+            finally:
+                srv.stop()
+        assert c1 == c0, \
+            f"{c1 - c0} recompiles on warmed traffic with tracing on"
+        assert len(flight.traces()) == 7
 
 
 # ---------------------------------------------------------------------------
