@@ -741,6 +741,312 @@ def run_serving(conf_path: str) -> int:
     return 1 if failures else 0
 
 
+OVERLOAD_MULTIPLIERS = (0.5, 1.0, 1.5, 2.0)
+#: cumulative shed counters sampled around each overload step
+_SHED_COUNTERS = ("serving.shed.deadline", "serving.shed.queue_full",
+                  "serving.shed.quota", "serving.shed.brownout")
+
+
+def bench_overload(res, db, queries, *, build_param=None, search_param=None,
+                   k=SERVING_K, max_batch=SERVING_MAX_BATCH,
+                   max_wait_us=1000.0, clients=8, request_rows=64,
+                   step_duration_s=2.0, deadline_s=0.25,
+                   load_multipliers=OVERLOAD_MULTIPLIERS,
+                   ladder_divisors=(2, 4), best_effort_fraction=0.25,
+                   brownout_conf=None) -> list:
+    """Open-loop offered-load sweep with and without brownout control.
+
+    Measures the closed-loop 1x peak (``clients`` synchronous threads at
+    full quality — the capacity reference every offered rate is a
+    multiple of), then replays an open-loop sweep at
+    ``load_multipliers`` x peak TWICE: controller OFF (static admission
+    only) and controller ON (the declared ladder: full quality, one rung
+    per ``ladder_divisors`` entry at ``n_probes // d``, then a
+    best-effort-shedding top rung).  Every request carries a
+    ``deadline_s`` deadline and **goodput counts only rows answered
+    within it** — late answers and sheds are wasted capacity either way,
+    which is exactly the collapse static admission exhibits at 2x.
+
+    Per step the bench emits an ``overload_point`` line with goodput,
+    admitted p99, per-counter shed fractions, and the brownout-level
+    residency delta; the summary lines are ``overload_goodput_2x`` with
+    the controller (``vs_baseline`` = fraction of the closed-loop peak —
+    the CI gate) and ``overload_goodput_2x_off`` without it.  The
+    ``xla.compiles`` counter is sampled around each arm's whole measured
+    window: brownout transitions must be recompile-free (every rung is
+    pre-warmed through the AOT cache at ``Server.start()``).
+    """
+    import threading
+
+    from raft_tpu import observability as obs
+    from raft_tpu import serving
+    from raft_tpu.neighbors import ivf_pq
+    from raft_tpu.resilience.retry import Deadline
+
+    bp = build_param or {"nlist": 1024, "pq_dim": 32}
+    spc = search_param or {"nprobe": 32}
+    index = ivf_pq.build(
+        res, ivf_pq.IndexParams(n_lists=bp["nlist"], pq_dim=bp["pq_dim"],
+                                kmeans_n_iters=bp.get("kmeans_n_iters", 10)),
+        db)
+
+    def _params(n_probes):
+        return ivf_pq.SearchParams(
+            n_probes=n_probes, scan_mode=spc.get("scan_mode", "auto"),
+            per_probe_topk=spc.get("per_probe_topk", 0))
+
+    sp = _params(spc["nprobe"])
+    ladder = [serving.Rung("full")]
+    ladder += [serving.Rung(f"probes/{d}", params=_params(
+        max(1, spc["nprobe"] // d))) for d in ladder_divisors]
+    ladder.append(serving.Rung("shed-best-effort", shed_best_effort=True))
+    bc = brownout_conf or {}
+    bcfg = serving.BrownoutConfig(
+        step_down_p99_s=bc.get("step_down_p99_s", deadline_s * 0.5),
+        step_up_p99_s=bc.get("step_up_p99_s", deadline_s * 0.1),
+        queue_high_fraction=bc.get("queue_high_fraction", 0.5),
+        queue_low_fraction=bc.get("queue_low_fraction", 0.125),
+        shed_step_down=bc.get("shed_step_down", 1),
+        dwell_s=bc.get("dwell_s", 0.5),
+        interval_s=bc.get("interval_s", 0.1))
+    q = np.asarray(queries)
+    if q.shape[0] < max_batch:
+        q = np.concatenate([q] * int(np.ceil(max_batch / q.shape[0])))
+    # every Nth request is the best-effort tenant — the load the shed
+    # rung is allowed to drop to protect the paying tenant's deadline
+    be_every = (int(round(1.0 / best_effort_fraction))
+                if best_effort_fraction > 0 else 0)
+
+    def closed_loop(srv):
+        done = [0] * clients
+        stop_at = time.perf_counter() + step_duration_s
+
+        def client(j):
+            base = (j * 131) % max(1, q.shape[0] - request_rows)
+            sub = q[base:base + request_rows]
+            while time.perf_counter() < stop_at:
+                srv.search(sub, k)
+                done[j] += sub.shape[0]
+
+        ts = [threading.Thread(target=client, args=(j,))
+              for j in range(clients)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return sum(done) / (time.perf_counter() - t0)
+
+    def open_loop_step(srv, rate):
+        """One offered-load step: paced submits at ``rate`` rows/s,
+        goodput = rows answered within the request deadline."""
+        rec, futs = [], []
+        shed_submit = n_requests = 0
+        interval = request_rows / rate
+        t_start = time.perf_counter()
+        t_end = t_start + step_duration_s
+        next_t = t_start
+        while time.perf_counter() < t_end:
+            lag = next_t - time.perf_counter()
+            if lag > 0:
+                time.sleep(lag)
+            tenant = ("batch" if be_every and n_requests % be_every == 0
+                      else "default")
+            t_sub = time.perf_counter()
+            try:
+                f = srv.submit(q[:request_rows], k, tenant=tenant,
+                               deadline=Deadline(deadline_s))
+            except serving.Overloaded:
+                shed_submit += 1
+            else:
+                f.add_done_callback(
+                    lambda fut, t=t_sub: rec.append(
+                        (time.perf_counter() - t, fut.exception() is None)))
+                futs.append(f)
+            n_requests += 1
+            next_t += interval
+        for f in futs:
+            try:
+                f.result(timeout=30.0)
+            except Exception:  # noqa: BLE001 - sheds surface as exceptions
+                pass
+        elapsed = time.perf_counter() - t_start
+        good = [lat for lat, ok in rec if ok and lat <= deadline_s]
+        return {
+            "offered_rows_per_s": round(n_requests * request_rows
+                                        / elapsed, 1),
+            "goodput_rows_per_s": round(len(good) * request_rows
+                                        / elapsed, 1),
+            "requests": n_requests,
+            "shed_at_submit": shed_submit,
+            "admitted_p99_ms": (round(float(
+                np.percentile(good, 99)) * 1e3, 3) if good else None),
+        }
+
+    def run_arm(with_controller, peak):
+        # each arm starts from a clean registry: the off arm's windowed
+        # shed counts and latency samples stay visible for a full window
+        # (60s) and would otherwise feed the on arm's controller a
+        # pressure signal from load it never saw
+        obs.reset()
+        ex = serving.Executor(res, "ivf_pq", index, ks=(k,),
+                              max_batch=max_batch, search_params=sp)
+        cfg = serving.ServerConfig(max_batch=max_batch,
+                                   max_wait_us=max_wait_us,
+                                   max_queue_rows=max_batch * 8)
+        srv = serving.Server(ex, cfg)
+        ctl = (serving.BrownoutController(srv, ladder, bcfg,
+                                          best_effort_tenants={"batch"})
+               if with_controller else None)
+        srv.start()
+        compiles = obs.registry().counter("xla.compiles")
+        try:
+            for m in (1, request_rows, max_batch):
+                srv.search(q[:m], k)
+            c0 = compiles.value
+            if peak is None:
+                peak = closed_loop(srv)
+            if ctl is not None:
+                ctl.start()
+            points = []
+            for mult in load_multipliers:
+                shed0 = {n: obs.registry().counter(n).value
+                         for n in _SHED_COUNTERS}
+                res0 = ctl.stats()["residency_s"] if ctl else None
+                step = open_loop_step(srv, max(mult * peak, request_rows))
+                offered = step["requests"] * request_rows
+                step["shed_fractions"] = {
+                    n.removeprefix("serving.shed."):
+                        round((obs.registry().counter(n).value - shed0[n])
+                              * request_rows / max(offered, 1), 4)
+                    for n in _SHED_COUNTERS}
+                if ctl is not None:
+                    res1 = ctl.stats()["residency_s"]
+                    step["brownout_residency_s"] = {
+                        name: round(res1[name] - res0[name], 2)
+                        for name in res1}
+                    step["level_end"] = ctl.state.level
+                point = dict(step, multiplier=mult,
+                             controller=with_controller)
+                _emit({"overload_point": point})
+                points.append(point)
+            return peak, points, int(compiles.value - c0)
+        finally:
+            if ctl is not None:
+                ctl.stop()
+            srv.stop()
+
+    out = []
+    with obs.collecting():
+        peak, points_off, recompiles_off = run_arm(False, None)
+        _, points_on, recompiles_on = run_arm(True, peak)
+
+    def at_2x(points):
+        return max(points, key=lambda p: p["multiplier"])
+
+    top_on, top_off = at_2x(points_on), at_2x(points_off)
+    out.append({
+        "metric": "overload_goodput_2x",
+        "value": top_on["goodput_rows_per_s"],
+        "unit": "rows/s",
+        "vs_baseline": round(top_on["goodput_rows_per_s"]
+                             / max(peak, 1e-9), 3),
+        "detail": {"closed_loop_peak_rows_per_s": round(peak, 1),
+                   "multiplier": top_on["multiplier"],
+                   "controller": True,
+                   "recompiles_steady": recompiles_on,
+                   "deadline_s": deadline_s,
+                   "ladder": [r.name for r in ladder],
+                   "admitted_p99_ms": top_on["admitted_p99_ms"],
+                   "shed_fractions": top_on["shed_fractions"],
+                   "brownout_residency_s":
+                       top_on.get("brownout_residency_s"),
+                   "points": points_on},
+    })
+    out.append({
+        "metric": "overload_goodput_2x_off",
+        "value": top_off["goodput_rows_per_s"],
+        "unit": "rows/s",
+        "vs_baseline": round(top_off["goodput_rows_per_s"]
+                             / max(peak, 1e-9), 3),
+        "detail": {"closed_loop_peak_rows_per_s": round(peak, 1),
+                   "multiplier": top_off["multiplier"],
+                   "controller": False,
+                   "recompiles_steady": recompiles_off,
+                   "deadline_s": deadline_s,
+                   "admitted_p99_ms": top_off["admitted_p99_ms"],
+                   "shed_fractions": top_off["shed_fractions"],
+                   "points": points_off},
+    })
+    return out
+
+
+def run_overload(conf_path: str) -> int:
+    """``--overload`` mode: the CI chaos smoke.  Builds the conf's
+    dataset, activates the conf's seed-pinned latency plan (the
+    ``serving.dispatch`` site — injected slowness is what turns 2x
+    offered load into a real brownout), runs :func:`bench_overload`,
+    and FAILS (exit 1) on goodput collapse at 2x with the controller,
+    steady-state recompiles, or a missing brownout event trail."""
+    from raft_tpu import DeviceResources
+    from raft_tpu.observability import flight as _flight
+    from raft_tpu.resilience import faults
+
+    with open(conf_path) as f:
+        conf = json.load(f)
+    res = DeviceResources(seed=0)
+    db, queries = _make_dataset(conf["dataset"])
+    s = conf["serving"]
+    o = conf.get("overload", {})
+    plan = faults.FaultPlan()          # seed pinned via RAFT_TPU_FAULT_SEED
+    for fp in o.get("faults", ()):
+        plan.delay_at(fp["site"], delay=fp["delay"],
+                      jitter=fp.get("jitter", 0.0))
+    _flight.clear()
+    with plan.active():
+        lines = bench_overload(
+            res, db, queries,
+            build_param=s.get("build_param"),
+            search_param=s.get("search_param"),
+            k=s.get("k", SERVING_K),
+            max_batch=s.get("max_batch", SERVING_MAX_BATCH),
+            max_wait_us=s.get("max_wait_us", 1000.0),
+            clients=s.get("clients", 8),
+            request_rows=o.get("request_rows", 64),
+            step_duration_s=o.get("step_duration_s", 2.0),
+            deadline_s=o.get("deadline_s", 0.25),
+            load_multipliers=tuple(o.get("load_multipliers",
+                                         OVERLOAD_MULTIPLIERS)),
+            ladder_divisors=tuple(o.get("ladder_divisors", (2, 4))),
+            best_effort_fraction=o.get("best_effort_fraction", 0.25),
+            brownout_conf=o.get("brownout"))
+    for line in lines:
+        _emit(line)
+    on = next(ln for ln in lines if ln["metric"] == "overload_goodput_2x")
+    failures = []
+    bar = o.get("min_goodput_fraction_at_2x", 0.7)
+    if on["vs_baseline"] < bar:
+        failures.append(
+            f"goodput collapse: {on['vs_baseline']:.2f}x the closed-loop "
+            f"peak at 2x offered load WITH the controller (bar: {bar:.2f}x)")
+    if on["detail"]["recompiles_steady"] != 0:
+        failures.append(
+            f"{on['detail']['recompiles_steady']} XLA recompiles during "
+            "the controller sweep (brownout transitions must be "
+            "recompile-free)")
+    if not _flight.events("serving.brownout.step_down"):
+        failures.append("no serving.brownout.step_down events landed in "
+                        "the flight recorder — the controller never "
+                        "engaged under 2x offered load")
+    for msg in failures:
+        print(f"OVERLOAD SMOKE FAIL: {msg}", flush=True)
+    if failures:
+        dumped = _flight.maybe_auto_dump("overload_smoke_failure")
+        if dumped:
+            print(f"flight dump: {dumped}", flush=True)
+    return 1 if failures else 0
+
+
 MUTATION_CHURN = 0.01          # writer deletes AND extends 1% per cycle
 
 
@@ -1389,6 +1695,12 @@ if __name__ == "__main__":
                 os.path.join(os.path.dirname(__file__), "conf",
                              "serving-smoke.json")
             sys.exit(run_serving(conf))
+        elif len(sys.argv) >= 2 and sys.argv[1] == "--overload":
+            _setup_jax_cache()
+            conf = sys.argv[2] if len(sys.argv) >= 3 else \
+                os.path.join(os.path.dirname(__file__), "conf",
+                             "overload-smoke.json")
+            sys.exit(run_overload(conf))
         else:
             main()
     finally:

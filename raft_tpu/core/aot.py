@@ -153,14 +153,19 @@ class ExecutableCache:
 
     def get(self, kind: str, res, index, *, batch: int, k: int,
             n_probes: int = 0, scan_mode: Optional[str] = None,
-            **export_kwargs) -> Callable:
+            rung: int = 0, **export_kwargs) -> Callable:
         """The warmed ``g(queries) -> (distances, indices)`` for one
         bucket, exporting + loading on first use.
 
         ``kind`` is one of ``"ivf_pq" | "ivf_flat" | "brute_force" |
         "cagra"``; ``batch`` is the bucket's (padded) query count and is
-        part of the cache key.  Extra keyword arguments are forwarded to
-        the exporter (and keyed on, sorted by name).
+        part of the cache key.  ``rung`` is the serving degradation-
+        ladder position (brownout, PR 12): it joins the cache key — like
+        ``scan_mode`` — but is NOT forwarded to the exporter, so two
+        rungs that happen to share search parameters still get distinct
+        warmed entries and a brownout transition can never alias a
+        colder rung onto a warm one.  Extra keyword arguments are
+        forwarded to the exporter (and keyed on, sorted by name).
         """
         extra = tuple(sorted(export_kwargs.items()))
         # generation rides in the key alongside the id()+weakref identity
@@ -175,7 +180,7 @@ class ExecutableCache:
                                     "generation", 0) or 0)
         key = (kind, id(index), int(getattr(index, "generation", 0) or 0),
                placement_gen, int(batch), int(k), int(n_probes),
-               scan_mode, extra)
+               scan_mode, int(rung), extra)
         from raft_tpu import observability as obs
         with self._lock:
             hit = self._entries.get(key)

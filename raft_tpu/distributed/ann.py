@@ -714,6 +714,18 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
         comms = handle.get_comms()
         queries = ensure_array(queries, "queries")
         failed = _degraded_set(index.n_shards, failed_shards)
+        # per-shard straggler injection (host-side, before dispatch):
+        # the SPMD merge completes when the slowest shard answers, so
+        # the scripted pause models a slow shard without touching the
+        # compiled program — every shard's candidates still merge,
+        # results stay exact.  No plan active → one None check.
+        stragglers = faults.straggler_pause(index.n_shards)
+        if stragglers:
+            _flight.record_event("distributed.straggler",
+                                 trace_id=_rtrace.current().trace_id
+                                 if _rtrace.current() else None,
+                                 delays_s=list(stragglers),
+                                 n_shards=index.n_shards)
         nq = int(queries.shape[0])
         k = int(k)
         routed = isinstance(index, RoutedIndex)
@@ -1639,6 +1651,12 @@ def search_flat(handle, params, index: DistributedFlatIndex, queries,
         leaves = (index.centers, index.list_data, index.list_indices,
                   index.list_sizes)
         failed = _degraded_set(index.n_shards, failed_shards)
+        # same straggler seam as search(): host-side pause, exact merge
+        stragglers = faults.straggler_pause(index.n_shards)
+        if stragglers:
+            _flight.record_event("distributed.straggler",
+                                 delays_s=list(stragglers),
+                                 n_shards=index.n_shards)
         d, i = _entry(
             "distributed.ann.search_flat",
             lambda: _dist_search_flat(leaves, queries, int(k), n_probes,

@@ -36,6 +36,13 @@ from raft_tpu.observability import trace as _trace
 
 DEFAULT_CAPACITY = 512
 
+#: hard ceiling on the ring size — the ring is a preallocated slot list,
+#: so an absurd capacity is an allocation bug, not a tuning choice
+MAX_CAPACITY = 1 << 20
+
+#: env var overriding the process-global recorder's ring capacity
+CAPACITY_ENV = "RAFT_TPU_FLIGHT_CAPACITY"
+
 _EVENT = 0
 _TRACE = 1
 
@@ -62,8 +69,10 @@ class FlightRecorder:
     """Fixed-capacity ring of ``(kind, seq, payload)`` records."""
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY) -> None:
-        if capacity <= 0:
-            raise ValueError("flight recorder capacity must be positive")
+        if not 0 < capacity <= MAX_CAPACITY:
+            raise ValueError(
+                f"flight recorder capacity must be in [1, {MAX_CAPACITY}], "
+                f"got {capacity}")
         self.capacity = int(capacity)
         self._slots: List[Optional[tuple]] = [None] * self.capacity
         self._seq = itertools.count()
@@ -162,7 +171,30 @@ class FlightRecorder:
 # ---------------------------------------------------------------------------
 # process-global recorder + module-level conveniences
 
-_RECORDER = FlightRecorder()
+
+def _env_capacity() -> int:
+    """Ring capacity for the process-global recorder:
+    ``$RAFT_TPU_FLIGHT_CAPACITY`` when set and valid, else the default.
+    Unparseable / out-of-bounds values fall back (with a warning) rather
+    than raise — a bad env var must not make ``import raft_tpu`` fail."""
+    raw = os.environ.get(CAPACITY_ENV)
+    if not raw:
+        return DEFAULT_CAPACITY
+    try:
+        cap = int(raw)
+        if not 0 < cap <= MAX_CAPACITY:
+            raise ValueError(raw)
+    except ValueError:
+        import warnings
+        warnings.warn(
+            f"ignoring {CAPACITY_ENV}={raw!r}: expected an integer in "
+            f"[1, {MAX_CAPACITY}]; using {DEFAULT_CAPACITY}",
+            RuntimeWarning, stacklevel=2)
+        return DEFAULT_CAPACITY
+    return cap
+
+
+_RECORDER = FlightRecorder(_env_capacity())
 
 #: env var naming the auto-dump destination (CI sets it; see test.yml)
 DUMP_ENV = "RAFT_TPU_FLIGHT_DUMP"

@@ -30,6 +30,7 @@ from raft_tpu.resilience.faults import (  # noqa: F401
     inject,
     is_active,
     maybe_fail,
+    straggler_pause,
 )
 from raft_tpu.resilience.retry import (  # noqa: F401
     DEFAULT_POLICY,
@@ -73,4 +74,5 @@ __all__ = [
     "retry_call",
     "retryable",
     "save_index",
+    "straggler_pause",
 ]

@@ -49,6 +49,20 @@ plan's seeded RNG (``RAFT_TPU_FAULT_SEED`` pins the default seed) so a
 probabilistic schedule replays identically.  Fired injections bump
 ``resilience.fault.injected.<site>`` in the observability registry when
 collection is enabled.
+
+Latency injection (the overload / slow-shard regime) uses the same
+sites and the same determinism contract: :meth:`FaultPlan.delay_at`
+scripts a spec that *sleeps* ``delay + jitter * rng()`` seconds instead
+of raising (jitter draws come from the plan's seeded RNG, so a jittered
+schedule replays identically under a pinned seed), bumping
+``resilience.fault.delayed.<site>``.  Per-shard stragglers are scripted
+with :meth:`FaultPlan.straggle_shard`; ``distributed.ann`` calls
+:func:`straggler_pause` once per search, which host-side pauses for the
+slowest scripted shard — the SPMD dispatch returns when the last shard
+answers, results stay exact, only latency moves.  All sleeping happens
+inside this module (the graftlint timing-discipline pass keeps
+``time.sleep`` out of everything outside ``raft_tpu/resilience/``),
+through the monkeypatchable ``_sleep`` seam.
 """
 
 from __future__ import annotations
@@ -58,11 +72,16 @@ import dataclasses
 import os
 import random
 import threading
-from typing import Callable, Iterator, List, Optional, Tuple
+import time
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 from raft_tpu.core.error import RaftError
 
 _SEED_ENV = "RAFT_TPU_FAULT_SEED"
+
+# test seam (mirrors retry._sleep): fault delays pause through this so
+# latency tests can count sleeps without slowing the suite down
+_sleep = time.sleep
 
 
 class FaultInjected(RaftError):
@@ -79,18 +98,29 @@ class FaultSpec:
     """One scripted failure: fire at ``site`` up to ``times`` times
     (None = unbounded), skipping the first ``after`` matching calls,
     each firing gated by probability ``p`` from the plan's seeded RNG.
-    ``exc`` is an exception class or zero/one-arg factory."""
+    ``exc`` is an exception class or zero/one-arg factory.
+
+    A spec with ``delay > 0`` (or ``jitter > 0``) is a *latency* spec:
+    instead of raising it sleeps ``delay + jitter * rng()`` seconds when
+    it fires (``exc`` is ignored).  Jitter draws come from the plan's
+    seeded RNG, so the schedule is deterministic under a pinned seed."""
 
     site: str
     times: Optional[int] = 1
     exc: Callable[..., BaseException] = TransientFault
     after: int = 0
     p: float = 1.0
+    delay: float = 0.0
+    jitter: float = 0.0
     _seen: int = 0
     _fired: int = 0
 
     def matches(self, site: str) -> bool:
         return self.site == site
+
+    @property
+    def is_delay(self) -> bool:
+        return self.delay > 0.0 or self.jitter > 0.0
 
     @property
     def fired(self) -> int:
@@ -111,6 +141,7 @@ class FaultPlan:
         self._rng = random.Random(seed)
         self._specs: List[FaultSpec] = []
         self._failed_shards: set = set()
+        self._stragglers: Dict[int, Tuple[float, float]] = {}
         self._lock = threading.Lock()
 
     # -- scripting ---------------------------------------------------------
@@ -120,6 +151,31 @@ class FaultPlan:
         """Script a failure at ``site``; returns self for chaining."""
         self._specs.append(FaultSpec(site=site, times=times, exc=exc,
                                      after=after, p=p))
+        return self
+
+    def delay_at(self, site: str, *, delay: float, jitter: float = 0.0,
+                 times: Optional[int] = None, after: int = 0,
+                 p: float = 1.0) -> "FaultPlan":
+        """Script injected latency at ``site``: each firing sleeps
+        ``delay + jitter * rng()`` seconds (unbounded by default — a
+        latency regime usually spans the whole scenario).  Returns self
+        for chaining."""
+        if delay < 0 or jitter < 0:
+            raise ValueError("delay and jitter must be non-negative")
+        self._specs.append(FaultSpec(site=site, times=times, after=after,
+                                     p=p, delay=delay, jitter=jitter))
+        return self
+
+    def straggle_shard(self, shard: int, *, delay: float,
+                       jitter: float = 0.0) -> "FaultPlan":
+        """Make distributed-index shard ``shard`` a straggler: every
+        routed search pauses ``delay + jitter * rng()`` seconds before
+        its merge (via :func:`straggler_pause`).  Unlike
+        :meth:`fail_shards` the shard still answers — results stay
+        exact, only latency moves."""
+        if delay < 0 or jitter < 0:
+            raise ValueError("delay and jitter must be non-negative")
+        self._stragglers[int(shard)] = (float(delay), float(jitter))
         return self
 
     def fail_shards(self, *shards: int) -> "FaultPlan":
@@ -135,6 +191,8 @@ class FaultPlan:
 
     # -- evaluation --------------------------------------------------------
     def _check(self, site: str) -> None:
+        pause = 0.0
+        err: Optional[BaseException] = None
         with self._lock:
             for spec in self._specs:
                 if not spec.matches(site):
@@ -147,11 +205,40 @@ class FaultPlan:
                 if spec.p < 1.0 and self._rng.random() >= spec.p:
                     continue
                 spec._fired += 1
+                if spec.is_delay:
+                    # draw jitter under the lock (deterministic order),
+                    # sleep after releasing it — a straggling site must
+                    # not serialize checks at unrelated sites
+                    pause += spec.delay + (
+                        spec.jitter * self._rng.random() if spec.jitter else 0.0)
+                    _count_delayed(site)
+                    continue
                 _count(site)
                 try:
-                    raise spec.exc(f"injected fault at {site!r}")
+                    err = spec.exc(f"injected fault at {site!r}")
                 except TypeError:
-                    raise spec.exc()  # zero-arg factories
+                    err = spec.exc()  # zero-arg factories
+                break
+        # a site scripting both latency and failure sleeps FIRST: the
+        # injected slowness must be observable even on the failing call
+        if pause > 0.0:
+            _sleep(pause)
+        if err is not None:
+            raise err
+
+    def _straggler_delays(self, n_shards: int) -> Tuple[float, ...]:
+        """Per-shard injected delays for one routed search (0.0 for
+        non-stragglers); jitter draws happen under the lock so the
+        schedule replays under a pinned seed."""
+        with self._lock:
+            if not self._stragglers:
+                return ()
+            out = []
+            for s in range(n_shards):
+                delay, jitter = self._stragglers.get(s, (0.0, 0.0))
+                out.append(delay + (jitter * self._rng.random()
+                                    if jitter else 0.0))
+            return tuple(out)
 
     @contextlib.contextmanager
     def active(self) -> Iterator["FaultPlan"]:
@@ -194,6 +281,12 @@ def _count(site: str) -> None:
         obs.registry().counter(f"resilience.fault.injected.{site}").inc()
 
 
+def _count_delayed(site: str) -> None:
+    from raft_tpu import observability as obs
+    if obs.enabled():
+        obs.registry().counter(f"resilience.fault.delayed.{site}").inc()
+
+
 @contextlib.contextmanager
 def inject(*args, seed: Optional[int] = None, **at_kwargs) -> Iterator[FaultPlan]:
     """Shorthand: ``with inject("comms.allreduce", times=1): ...``
@@ -227,3 +320,22 @@ def failed_shards(n_shards: int) -> Tuple[int, ...]:
         return ()
     return tuple(sorted(s for s in plan._failed_shards
                         if 0 <= s < n_shards))
+
+
+def straggler_pause(n_shards: int) -> Tuple[float, ...]:
+    """The distributed-search straggler hook: host-side pause for the
+    slowest scripted shard, returning the per-shard delay vector (empty
+    when no plan scripts stragglers).  **No plan active → a single None
+    check.**  The sleep lives here, not in ``distributed.ann``, because
+    the timing-discipline lint confines ``time.sleep`` to the resilience
+    layer; the SPMD dispatch semantics ("the merge completes when the
+    last shard answers") make one max-delay pause per search the honest
+    host-side model — every shard's results still merge, exactly."""
+    plan = _ACTIVE
+    if plan is None:
+        return ()
+    delays = plan._straggler_delays(n_shards)
+    if delays and max(delays) > 0.0:
+        _count_delayed("distributed.straggler")
+        _sleep(max(delays))
+    return delays

@@ -20,7 +20,15 @@ RAFT-class deployment interposes between users and the GPU/TPU):
   degraded-mode shard masking and ``health_check`` compose unchanged);
 - :mod:`~raft_tpu.serving.server` — the ``Server`` front end:
   ``submit() -> Future``, boundary validation per request, serving
-  counters + latency histograms at enqueue→dispatch→complete;
+  counters + latency histograms at enqueue→dispatch→complete, plus the
+  generation watchdog: N integrity strikes within a window auto-roll
+  the executor back to the retained last-known-good index;
+- :mod:`~raft_tpu.serving.brownout` — adaptive overload degradation:
+  a :class:`BrownoutController` watches windowed latency/queue/shed
+  telemetry and steps the bucket down/up a pre-declared, pre-warmed
+  degradation ladder (reduced ``n_probes`` → … → best-effort-tenant
+  shed) with hysteresis and dwell — goodput degrades instead of
+  collapsing, with zero steady-state recompiles;
 - :mod:`~raft_tpu.serving.rebalancer` — crash-safe background index
   maintenance for the mutable IVF indexes: overfull-list re-clustering
   + tombstone compaction, checkpointed stages
@@ -39,12 +47,19 @@ Quick tour::
 
 from raft_tpu.serving.admission import (  # noqa: F401
     AdmissionQueue,
+    BrownedOut,
     Overloaded,
     QuotaExceeded,
     Request,
     TokenBucket,
 )
 from raft_tpu.serving.batcher import DynamicBatcher  # noqa: F401
+from raft_tpu.serving.brownout import (  # noqa: F401
+    BrownoutConfig,
+    BrownoutController,
+    BrownoutState,
+    Rung,
+)
 from raft_tpu.serving.buckets import (  # noqa: F401
     bucket_for,
     bucket_sizes,
@@ -64,10 +79,15 @@ from raft_tpu.serving.server import Server, ServerConfig  # noqa: F401
 
 __all__ = [
     "AdmissionQueue",
+    "BrownedOut",
+    "BrownoutConfig",
+    "BrownoutController",
+    "BrownoutState",
     "DistributedExecutor",
     "DynamicBatcher",
     "Executor",
     "Overloaded",
+    "Rung",
     "QuotaExceeded",
     "RebalanceConfig",
     "Rebalancer",

@@ -12,9 +12,14 @@ instead of wasting a bucket slot.
 
 Counters (collection-gated): ``serving.admitted``,
 ``serving.shed.queue_full``, ``serving.shed.quota``,
-``serving.shed.deadline``.  Every shed additionally lands an anomaly
-event of the same name in the always-on flight recorder (flight.py),
-carrying the request's trace id when tracing is enabled.
+``serving.shed.deadline``, and — while a brownout ladder's top rung is
+active — ``serving.shed.brownout`` for best-effort-tenant requests
+refused at the door (see :mod:`raft_tpu.serving.brownout`).  Every shed
+additionally lands an anomaly event of the same name in the always-on
+flight recorder (flight.py), carrying the request's trace id when
+tracing is enabled.  Exactly ONE shed counter ticks per shed request:
+each check below raises immediately, and a request refused here never
+reaches the dispatcher's dispatch-time deadline accounting.
 """
 
 from __future__ import annotations
@@ -40,6 +45,12 @@ class Overloaded(RaftError):
 class QuotaExceeded(Overloaded):
     """The tenant's token bucket is empty.  A subclass of
     :class:`Overloaded` so quota-blind clients need one handler."""
+
+
+class BrownedOut(Overloaded):
+    """Shed because the brownout ladder's active rung drops best-effort
+    tenants.  A subclass of :class:`Overloaded`: same client contract
+    (retry with backoff), distinct type for tests and dashboards."""
 
 
 class TokenBucket:
@@ -105,9 +116,12 @@ class AdmissionQueue:
 
     def __init__(self, max_queue_rows: int,
                  quotas: Optional[Dict[str, Tuple[float, float]]] = None,
-                 clock=time.monotonic) -> None:
+                 clock=time.monotonic, brownout=None) -> None:
         self._max_rows = int(max_queue_rows)
         self._clock = clock
+        # shared BrownoutState (serving.brownout) — read lock-free per
+        # offer; None for a standalone queue (tests, direct use)
+        self.brownout = brownout
         self._buckets = {t: TokenBucket(r, b, clock)
                          for t, (r, b) in (quotas or {}).items()}
         self._lock = threading.Lock()
@@ -118,15 +132,30 @@ class AdmissionQueue:
     # ---- admission ------------------------------------------------------
 
     def offer(self, req: Request) -> None:
-        """Admit or shed (raises :class:`Overloaded` / subclasses)."""
+        """Admit or shed (raises :class:`Overloaded` / subclasses).
+        Checks are ordered deadline → brownout → quota → queue bound and
+        each raises immediately, so a shed request ticks exactly one
+        ``serving.shed.*`` counter."""
+        bo = self.brownout
+        level = bo.level if bo is not None else 0
         if req.deadline is not None and req.deadline.expired:
             _count("serving.shed.deadline")
             _flight.record_event("serving.shed.deadline",
                                  trace_id=req.trace_id,
                                  tenant=req.tenant, rows=req.n,
-                                 phase="submit")
+                                 phase="submit", level=level)
             raise Overloaded(
                 "serving: request deadline already expired at submit")
+        if (bo is not None and bo.shed_best_effort
+                and req.tenant in bo.best_effort_tenants):
+            _count("serving.shed.brownout")
+            _flight.record_event("serving.shed.brownout",
+                                 trace_id=req.trace_id,
+                                 tenant=req.tenant, rows=req.n,
+                                 level=level)
+            raise BrownedOut(
+                f"serving: best-effort tenant {req.tenant!r} shed at "
+                f"brownout level {level} — retry with backoff")
         bucket = self._buckets.get(req.tenant)
         if bucket is not None and not bucket.try_acquire(req.n):
             _count("serving.shed.quota")
@@ -144,7 +173,7 @@ class AdmissionQueue:
                                      trace_id=req.trace_id,
                                      tenant=req.tenant, rows=req.n,
                                      queued_rows=self._rows,
-                                     bound=self._max_rows)
+                                     bound=self._max_rows, level=level)
                 raise Overloaded(
                     f"serving: queue full ({self._rows} rows queued, "
                     f"bound {self._max_rows}) — retry with backoff")

@@ -28,6 +28,7 @@ import numpy as np
 from raft_tpu import observability as obs
 from raft_tpu.observability import flight as _flight
 from raft_tpu.observability import trace as _trace
+from raft_tpu.resilience import faults as _faults
 from raft_tpu.resilience.retry import DeadlineExceededError
 from raft_tpu.serving.admission import AdmissionQueue
 from raft_tpu.serving.buckets import bucket_for
@@ -35,16 +36,29 @@ from raft_tpu.serving.buckets import bucket_for
 
 class DynamicBatcher:
     """Owns the dispatcher thread between an admission queue and an
-    executor (``raft_tpu.serving.executor.Executor``)."""
+    executor (``raft_tpu.serving.executor.Executor``).
+
+    ``brownout`` is the server's shared
+    :class:`~raft_tpu.serving.brownout.BrownoutState`: each cut batch
+    executes at the state's current executor rung (one lock-free int
+    read — every rung is pre-warmed, so a level change never compiles).
+    ``on_error`` is called with the exception after a batch dispatch
+    fails (after the per-request futures are failed) — the server's
+    generation watchdog listens here for :class:`IntegrityError`.
+    """
 
     def __init__(self, queue: AdmissionQueue, executor, *,
                  max_batch: int, max_wait_us: float,
-                 on_batch: Optional[Callable] = None) -> None:
+                 on_batch: Optional[Callable] = None,
+                 brownout=None,
+                 on_error: Optional[Callable] = None) -> None:
         self.queue = queue
         self.executor = executor
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_us) * 1e-6
         self._on_batch = on_batch
+        self._on_error = on_error
+        self.brownout = brownout
         self._stop = False
         self._thread: Optional[threading.Thread] = None
 
@@ -114,17 +128,30 @@ class DynamicBatcher:
 
     def _dispatch(self, batch) -> None:
         t_dispatch = time.monotonic()
+        bo = self.brownout
+        level = bo.level if bo is not None else 0
+        rung = bo.rung if bo is not None else 0
         live = []
         for r in batch:
             if r.deadline is not None and r.deadline.expired:
-                _count("serving.expired")
+                # the dispatch-phase half of the deadline-shed ledger:
+                # SAME counter as the submit-phase check in admission
+                # (phase distinguishes them on the flight event), so
+                # `serving.shed.deadline` is the one total a dashboard
+                # needs, and each request ticks it exactly once —
+                # admission raises before enqueue, this path only sees
+                # requests admission let through
+                _count("serving.shed.deadline")
                 _flight.record_event("serving.shed.deadline",
                                      trace_id=r.trace_id, tenant=r.tenant,
                                      rows=r.n, phase="dispatch",
-                                     queued_s=t_dispatch - r.t_enqueue)
+                                     queued_s=t_dispatch - r.t_enqueue,
+                                     level=level)
                 if r.trace is not None:
                     r.trace.span("serving.queue", r.t_enqueue, t_dispatch)
                     r.trace.annotate("shed", True)
+                    if level:
+                        r.trace.annotate("brownout_level", level)
                     _flight.record_trace(r.trace.close(t_dispatch))
                 r.future.set_exception(DeadlineExceededError(
                     f"serving: deadline expired after "
@@ -159,8 +186,13 @@ class DynamicBatcher:
             off += r.n
         t_exec0 = time.monotonic()
         try:
+            # named fault site: latency plans here (faults.delay_at) are
+            # how the chaos bench/CI slow the serving path down on
+            # demand; inactive it is one None check on the hot path
+            _faults.maybe_fail("serving.dispatch")
             with _trace.activating(batch_rec):
-                d, i = self.executor.search_bucket(jnp.asarray(buf), n, k)
+                d, i = self.executor.search_bucket(jnp.asarray(buf), n, k,
+                                                   rung=rung)
                 # graftlint: disable=host-sync -- THE one readback: results must leave the device to resolve request futures
                 d, i = np.asarray(d), np.asarray(i)
         except BaseException as e:  # noqa: BLE001 - forwarded per request
@@ -176,12 +208,17 @@ class DynamicBatcher:
             _flight.maybe_auto_dump("serving.batch_error")
             for r in live:
                 r.future.set_exception(e)
+            if self._on_error is not None:
+                self._on_error(e)
             return
         t_done = time.monotonic()
         if batch_rec is not None:
             batch_rec.span("serving.batch_cut", t_dispatch, t_exec0,
                            rows=n, bucket=bucket, requests=len(live))
             batch_rec.span("serving.exec", t_exec0, t_done)
+            if level:
+                batch_rec.annotate("brownout_level", level)
+                batch_rec.annotate("rung", rung)
         self._record(live, n, bucket, t_dispatch, t_done)
         off = 0
         worst = np.inf if self.executor.select_min else -np.inf

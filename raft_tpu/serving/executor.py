@@ -60,11 +60,20 @@ class Executor:
     ``search_params`` is the algorithm's SearchParams (n_probes etc.) —
     fixed for the executor's lifetime, part of every bucket's compiled
     shape.  ``ks`` is the closed set of supported k values.
+
+    ``ladder`` (brownout, PR 12) is an optional sequence of ADDITIONAL
+    SearchParams variants — the degraded operating points the brownout
+    controller steps through under overload.  Rung 0 is always
+    ``search_params`` (full quality); rung ``i`` serves ``ladder[i-1]``.
+    The rung set is closed and part of every warmed shape: ``warmup()``
+    compiles every (bucket, k, rung) once, and the rung joins the AOT
+    cache key (see :meth:`ExecutableCache.get`), so a brownout
+    transition is a dict lookup — zero recompiles, zero host syncs.
     """
 
     def __init__(self, res, kind: str, index, *, ks: Sequence[int] = (10,),
                  max_batch: int = 1024, search_params=None,
-                 warm: str = "aot") -> None:
+                 ladder: Sequence = (), warm: str = "aot") -> None:
         expects(kind in _KINDS,
                 f"serving: unknown executor kind {kind!r} (one of {_KINDS})")
         expects(warm in ("aot", "jit"),
@@ -75,10 +84,27 @@ class Executor:
         self.ks = tuple(int(k) for k in ks)
         self.max_batch = int(max_batch)
         self.params = search_params
+        self._rung_params: Tuple = (search_params, *ladder)
         self.warm = warm
         self.buckets = bucket_sizes(self.max_batch)
-        self._fns: Dict[Tuple[int, int], Callable] = {}
+        self._fns: Dict[Tuple[int, int, int], Callable] = {}
         self._warmed = False
+
+    @property
+    def n_rungs(self) -> int:
+        """Number of degradation-ladder operating points (>= 1)."""
+        return len(self._rung_params)
+
+    def set_ladder(self, ladder: Sequence) -> None:
+        """Install the degraded-rung SearchParams variants (rungs 1..N).
+        Must happen before :meth:`warmup` — the rung set is part of the
+        closed warmed-shape contract, so growing it later would put a
+        compile on the serving path."""
+        expects(not self._warmed,
+                "serving: set_ladder after warmup would break the "
+                "zero-recompile contract — declare the ladder before "
+                "Server.start()")
+        self._rung_params = (self.params, *ladder)
 
     # ---- geometry -------------------------------------------------------
 
@@ -107,72 +133,80 @@ class Executor:
     # ---- warmup ---------------------------------------------------------
 
     def warmup(self) -> int:
-        """Compile every (bucket, k) once; returns the number of warmed
-        executables.  Idempotent."""
+        """Compile every (bucket, k, rung) once; returns the number of
+        warmed executables.  Idempotent."""
         if self._warmed:
             return len(self._fns)
         for b in self.buckets:
             for k in self.ks:
-                zeros = jnp.zeros((b, self.dim), self.query_dtype)
-                # b-1 valid rows also warms the padded-row mask ops at
-                # this bucket shape (mask shape is n_valid-independent)
-                d, i = self.search_bucket(zeros, max(1, b - 1), k)
-                jax.block_until_ready((d, i))
-                if obs.enabled():
-                    obs.registry().counter("serving.warmed_executables").inc()
+                for r in range(self.n_rungs):
+                    zeros = jnp.zeros((b, self.dim), self.query_dtype)
+                    # b-1 valid rows also warms the padded-row mask ops at
+                    # this bucket shape (mask shape is n_valid-independent)
+                    d, i = self.search_bucket(zeros, max(1, b - 1), k,
+                                              rung=r)
+                    jax.block_until_ready((d, i))
+                    if obs.enabled():
+                        obs.registry().counter(
+                            "serving.warmed_executables").inc()
         self._warmed = True
         return len(self._fns)
 
-    def _obtain(self, bucket: int, k: int) -> Callable:
-        key = (bucket, k)
+    def _obtain(self, bucket: int, k: int, rung: int = 0) -> Callable:
+        key = (bucket, k, rung)
         fn = self._fns.get(key)
         if fn is not None:
             return fn
-        fn = self._build_fn(self.index, bucket, k)
+        fn = self._build_fn(self.index, bucket, k, rung)
         self._fns[key] = fn
         return fn
 
-    def _build_fn(self, index, bucket: int, k: int) -> Callable:
+    def _build_fn(self, index, bucket: int, k: int, rung: int = 0
+                  ) -> Callable:
         """One bucket executable against an EXPLICIT index — the builder
         :meth:`swap_index` uses to assemble a replacement table without
         touching the published one."""
+        params = self._rung_params[rung]
         fn = None
         if self.warm == "aot":
             try:
-                fn = self._aot_fn(index, bucket, k)
+                fn = self._aot_fn(index, bucket, k, params, rung)
             except Exception as e:  # noqa: BLE001 - exporter refusal
                 warnings.warn(
                     f"serving: AOT export failed for {self.kind} bucket "
-                    f"({bucket}, {k}) — falling back to live search: {e}",
+                    f"({bucket}, {k}, rung {rung}) — falling back to live "
+                    f"search: {e}",
                     stacklevel=2)
         if fn is None:
-            fn = self._live_fn(index, k)
+            fn = self._live_fn(index, k, params)
         return fn
 
-    def _aot_fn(self, index, bucket: int, k: int) -> Callable:
+    def _aot_fn(self, index, bucket: int, k: int, params, rung: int
+                ) -> Callable:
         cache = _aot_executables()
         if self.kind == "ivf_pq":
-            n_probes = min(self.params.n_probes, index.n_lists)
-            mode = getattr(self.params, "scan_mode", "auto")
+            n_probes = min(params.n_probes, index.n_lists)
+            mode = getattr(params, "scan_mode", "auto")
             if mode not in ("recon", "codes", "lut", "fused"):
                 mode = ("recon" if index.list_recon is not None
                         else "lut")
             return cache.get("ivf_pq", self.res, index, batch=bucket,
-                             k=k, n_probes=n_probes, scan_mode=mode)
+                             k=k, n_probes=n_probes, scan_mode=mode,
+                             rung=rung)
         if self.kind == "ivf_flat":
-            n_probes = min(self.params.n_probes, index.n_lists)
+            n_probes = min(params.n_probes, index.n_lists)
             return cache.get("ivf_flat", self.res, index, batch=bucket,
-                             k=k, n_probes=n_probes)
+                             k=k, n_probes=n_probes, rung=rung)
         if self.kind == "brute_force":
             return cache.get("brute_force", self.res, index,
-                             batch=bucket, k=k)
+                             batch=bucket, k=k, rung=rung)
         # cagra: export when the packed walk calibrates, else live
-        itopk = max(getattr(self.params, "itopk_size", 64), k)
-        width = getattr(self.params, "search_width", 1)
+        itopk = max(getattr(params, "itopk_size", 64), k)
+        width = getattr(params, "search_width", 1)
         return cache.get("cagra", self.res, index, batch=bucket, k=k,
-                         itopk=itopk, search_width=width)
+                         rung=rung, itopk=itopk, search_width=width)
 
-    def _live_fn(self, index, k: int) -> Callable:
+    def _live_fn(self, index, k: int, params) -> Callable:
         # live module entry points under validation policy "off": the
         # server already boundary-checked each request at submit, and
         # padded zero rows must not be re-flagged.  The closure captures
@@ -196,7 +230,7 @@ class Executor:
 
         def live(queries):
             with config.validation_policy("off"):
-                return mod.search(self.res, self.params, index,
+                return mod.search(self.res, params, index,
                                   queries, k)
         return live
 
@@ -219,14 +253,15 @@ class Executor:
         dim = self._index_dim(new_index)
         expects(dim == self.dim,
                 f"serving: swap_index dim mismatch ({dim} != {self.dim})")
-        fns: Dict[Tuple[int, int], Callable] = {}
+        fns: Dict[Tuple[int, int, int], Callable] = {}
         for b in self.buckets:
             for k in self.ks:
-                fn = self._build_fn(new_index, b, k)
-                if self._warmed:
-                    zeros = jnp.zeros((b, dim), self.query_dtype)
-                    jax.block_until_ready(fn(zeros))
-                fns[(b, k)] = fn
+                for r in range(self.n_rungs):
+                    fn = self._build_fn(new_index, b, k, r)
+                    if self._warmed:
+                        zeros = jnp.zeros((b, dim), self.query_dtype)
+                        jax.block_until_ready(fn(zeros))
+                    fns[(b, k, r)] = fn
         self.index, self._fns = new_index, fns
         if obs.enabled():
             obs.registry().counter("serving.generation_swaps").inc()
@@ -240,20 +275,27 @@ class Executor:
 
     # ---- the hot path ---------------------------------------------------
 
-    def search_bucket(self, queries, n_valid: int, k: int
+    def search_bucket(self, queries, n_valid: int, k: int, rung: int = 0
                       ) -> Tuple[jax.Array, jax.Array]:
         """Search a padded bucket batch; rows past ``n_valid`` come back
-        masked (id -1 / worst distance) through the integrity mask path."""
+        masked (id -1 / worst distance) through the integrity mask path.
+        ``rung`` selects the degradation-ladder operating point (0 =
+        full quality); every rung is warmed, so the selection is a dict
+        lookup, never a compile."""
         bucket = queries.shape[0]
+        expects(0 <= rung < self.n_rungs,
+                f"serving: rung {rung} outside the declared ladder "
+                f"(n_rungs={self.n_rungs})")
         # one capture of the published table: a concurrent swap_index
         # replaces self._fns wholesale, so everything below dispatches
         # against a single consistent generation
         fns = self._fns
-        fn = fns.get((bucket, k))
+        fn = fns.get((bucket, k, rung))
         expects(fn is not None or not self._warmed,
-                f"serving: shape ({bucket}, {k}) is not a warmed bucket")
+                f"serving: shape ({bucket}, {k}, rung {rung}) is not a "
+                f"warmed bucket")
         if fn is None:
-            fn = self._obtain(bucket, k)
+            fn = self._obtain(bucket, k, rung)
         d, i = fn(queries)
         if n_valid < bucket:
             d, i = _boundary.mask_search_outputs(
@@ -314,7 +356,8 @@ class DistributedExecutor(Executor):
             centers = self.index.centers
         return centers.dtype
 
-    def _aot_fn(self, index, bucket: int, k: int) -> Callable:
+    def _aot_fn(self, index, bucket: int, k: int, params, rung: int
+                ) -> Callable:
         raise NotImplementedError("distributed indexes are jit-warmed")
 
     def prewarm_shard_artifacts(self, scan_mode: str = "fused") -> int:
@@ -352,13 +395,13 @@ class DistributedExecutor(Executor):
                     n += 1
         return n
 
-    def _live_fn(self, index, k: int) -> Callable:
+    def _live_fn(self, index, k: int, params) -> Callable:
         from raft_tpu import config
         from raft_tpu.distributed import ann
 
         def live(queries):
             with config.validation_policy("off"):
-                return ann.search(self.handle, self.params, index,
+                return ann.search(self.handle, params, index,
                                   queries, k,
                                   failed_shards=self.failed_shards)
         return live

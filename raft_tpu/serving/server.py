@@ -23,21 +23,24 @@ smoke).
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from concurrent.futures import Future
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from raft_tpu import observability as obs
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import ensure_array
+from raft_tpu.integrity import IntegrityError
 from raft_tpu.integrity import boundary as _boundary
 from raft_tpu.observability import flight as _flight
 from raft_tpu.observability import trace as _trace
 from raft_tpu.resilience.retry import Deadline
 from raft_tpu.serving.admission import AdmissionQueue, Overloaded, Request
 from raft_tpu.serving.batcher import DynamicBatcher
+from raft_tpu.serving.brownout import BrownoutState
 
 
 @dataclasses.dataclass
@@ -57,6 +60,11 @@ class ServerConfig:
     tenant_quotas: Optional[Dict[str, Tuple[float, float]]] = None
     # default per-request deadline (seconds); None = no deadline
     default_deadline_s: Optional[float] = None
+    # generation watchdog (auto-rollback): N integrity strikes within
+    # rollback_window_s seconds swap back to the retained last-known-good
+    # index generation.  0 disables the watchdog.
+    rollback_strikes: int = 0
+    rollback_window_s: float = 30.0
 
 
 class Server:
@@ -68,12 +76,25 @@ class Server:
         self.config = config or ServerConfig()
         expects(self.config.max_batch <= executor.max_batch,
                 "serving: config.max_batch exceeds the executor's bucket set")
+        # one BrownoutState shared with admission and the batcher: the
+        # controller (serving.brownout) writes it, the hot path reads it
+        # lock-free.  Level 0 with no controller attached — a plain
+        # server behaves exactly as before.
+        self.brownout = BrownoutState()
         self.queue = AdmissionQueue(self.config.max_queue_rows,
-                                    self.config.tenant_quotas)
+                                    self.config.tenant_quotas,
+                                    brownout=self.brownout)
         self.batcher = DynamicBatcher(self.queue, executor,
                                       max_batch=self.config.max_batch,
-                                      max_wait_us=self.config.max_wait_us)
+                                      max_wait_us=self.config.max_wait_us,
+                                      brownout=self.brownout,
+                                      on_error=self._on_batch_error)
         self._started = False
+        # generation watchdog state: the last-known-good index retained
+        # by swap_index, and the strike timestamps within the window
+        self._last_good = None
+        self._strikes: List[float] = []
+        self._watchdog_lock = threading.Lock()
 
     # ---- lifecycle ------------------------------------------------------
 
@@ -106,11 +127,83 @@ class Server:
         one atomic publish, so requests in flight finish on the
         generation they started on, later requests see only the new one,
         and steady-state traffic after the swap triggers zero recompiles.
-        Returns the number of bucket executables built."""
+        The swapped-out index is RETAINED as the last-known-good
+        generation for the watchdog (see :meth:`note_integrity_strike`),
+        and the strike window resets — strikes against the old
+        generation must not indict the new one.  Returns the number of
+        bucket executables built."""
+        old = self.executor.index
         with obs.stage("serving.generation_swap") as st:
             n = self.executor.swap_index(new_index)
             st.fence()
+        with self._watchdog_lock:
+            self._last_good = old
+            self._strikes.clear()
         return n
+
+    # ---- generation watchdog (auto-rollback) ----------------------------
+
+    def _on_batch_error(self, exc: BaseException) -> None:
+        # integrity failures are the watchdog's signal: a bad generation
+        # corrupts results; transient executor errors (OOM, interrupt)
+        # are the retry layer's problem, not a generation's guilt
+        if isinstance(exc, IntegrityError):
+            self.note_integrity_strike(f"batch_error: {exc}")
+
+    def check_canary(self, res) -> bool:
+        """Run the canary health check against the CURRENT generation;
+        a floor violation is one watchdog strike.  Returns True when the
+        index passes (or carries no canaries).  Call this from the ops
+        loop (or a rebalancer hook) after swaps — sustained post-swap
+        canary failure is exactly the regime auto-rollback exists for."""
+        from raft_tpu.integrity import canary as _canary
+        report = _canary.health_check(res, self.executor.index,
+                                      raise_on_fail=False)
+        if report is not None and not report.ok:
+            self.note_integrity_strike(
+                f"canary: recall {report.recall:.3f} < floor "
+                f"{report.floor:.3f}")
+            return False
+        return True
+
+    def note_integrity_strike(self, reason: str) -> bool:
+        """Record one integrity strike against the current generation;
+        on the Nth strike (``rollback_strikes``) within
+        ``rollback_window_s``, swap back to the retained last-known-good
+        generation.  Returns True when this strike triggered the
+        rollback."""
+        limit = self.config.rollback_strikes
+        if limit <= 0:
+            return False
+        now = time.monotonic()
+        if obs.enabled():
+            obs.registry().counter("serving.integrity_strikes").inc()
+        with self._watchdog_lock:
+            horizon = now - self.config.rollback_window_s
+            self._strikes = [t for t in self._strikes if t > horizon]
+            self._strikes.append(now)
+            n_strikes = len(self._strikes)
+            if n_strikes < limit or self._last_good is None:
+                return False
+            # rollback: take the retained generation and clear it so a
+            # still-failing environment cannot ping-pong the swap —
+            # the NEXT rollback needs a NEW good generation first
+            target, self._last_good = self._last_good, None
+            self._strikes.clear()
+        bad_gen = getattr(self.executor.index, "generation", None)
+        with obs.stage("serving.generation_swap") as st:
+            self.executor.swap_index(target)
+            st.fence()
+        if obs.enabled():
+            obs.registry().counter("serving.auto_rollbacks").inc()
+        # always-on flight event: THE post-mortem marker — which
+        # generation was indicted, by how many strikes, and why
+        _flight.record_event("serving.auto_rollback",
+                             bad_generation=bad_gen,
+                             restored_generation=getattr(
+                                 target, "generation", None),
+                             strikes=n_strikes, reason=reason)
+        return True
 
     # ---- request path ---------------------------------------------------
 
@@ -165,6 +258,11 @@ class Server:
             rt.annotate("tenant", tenant)
             rt.annotate("rows", n)
             rt.annotate("k", k)
+            # a degraded bucket stamps every trace — including one shed
+            # below — with the level that served (or refused) it
+            lvl = self.brownout.level
+            if lvl:
+                rt.annotate("brownout_level", lvl)
         try:
             self.queue.offer(req)
         except Overloaded:
@@ -198,6 +296,7 @@ class Server:
             "queue_requests": len(self.queue),
             "buckets": list(self.executor.buckets),
             "ks": list(self.executor.ks),
+            "brownout_level": self.brownout.level,
             "counters": {name: v
                          for name, v in snap.get("counters", {}).items()
                          if name.startswith(("serving.", "xla."))},

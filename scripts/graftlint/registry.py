@@ -23,8 +23,8 @@ hand-maintained list:
 Dynamic names resolve one level of indirection: when the name argument
 is a bare parameter of the enclosing function (the ``_count(name)``
 helper idiom), the extractor collects the literal arguments of every
-same-module call to that function — so ``_count("serving.expired")``
-defines ``serving.expired``, and ``_entry("distributed.ann.build",
+same-module call to that function — so ``_count("serving.shed.deadline")``
+defines ``serving.shed.deadline``, and ``_entry("distributed.ann.build",
 ...)`` defines the ``distributed.ann.build`` fault site fired by the
 ``maybe_fail(site)`` inside ``_entry``.
 

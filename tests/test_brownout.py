@@ -1,0 +1,689 @@
+"""raft_tpu.serving.brownout — adaptive degradation + auto-rollback.
+
+Covers the PR 12 robustness surface: ladder/config validation, the
+rung-extended executor (every (bucket, k, rung) warmed, rung part of the
+AOT cache key, zero recompiles across transitions), the controller's
+step_down/step_up decisions under injected clocks (hysteresis + dwell
+pin oscillation), exactly-one-shed-counter deadline accounting at every
+brownout level, the generation watchdog's strike/rollback matrix, and
+the flight recorder's configurable capacity.
+"""
+
+import time
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import observability as obs
+from raft_tpu import serving
+from raft_tpu.core import aot
+from raft_tpu.integrity import IntegrityError
+from raft_tpu.neighbors import ivf_pq
+from raft_tpu.observability import flight, trace
+from raft_tpu.resilience.retry import Deadline, DeadlineExceededError
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    obs.disable()
+    obs.reset()
+    trace.disable_tracing()
+    flight.clear()
+    yield
+    obs.disable()
+    obs.reset()
+    trace.disable_tracing()
+    flight.clear()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _drop_compile_caches():
+    # rung warm-ups and rollback swaps compile many executables; release
+    # them at teardown so later modules don't inherit the JIT mappings
+    yield
+    jax.clear_caches()
+
+
+def _dataset(n=4000, dim=32, seed=0):
+    rng = np.random.default_rng(seed)
+    db = rng.normal(size=(n, dim)).astype(np.float32)
+    q = rng.normal(size=(64, dim)).astype(np.float32)
+    return jnp.asarray(db), jnp.asarray(q)
+
+
+@pytest.fixture(scope="module")
+def pq_setup():
+    from raft_tpu import DeviceResources
+    res = DeviceResources(seed=42)
+    db, q = _dataset()
+    index = ivf_pq.build(
+        res, ivf_pq.IndexParams(n_lists=32, pq_dim=8, kmeans_n_iters=4), db)
+    sp = ivf_pq.SearchParams(n_probes=8)
+    return res, db, q, index, sp
+
+
+@pytest.fixture(scope="module")
+def canary_setup(pq_setup):
+    res, db, q, _, sp = pq_setup
+    index = ivf_pq.build(
+        res, ivf_pq.IndexParams(n_lists=32, pq_dim=8, kmeans_n_iters=4,
+                                canary_queries=16, canary_k=5,
+                                canary_floor=0.2), db)
+    return res, db, q, index, sp
+
+
+def _executor(pq_setup, max_batch=16, ks=(5,), warm="jit"):
+    res, _, _, index, sp = pq_setup
+    return serving.Executor(res, "ivf_pq", index, ks=ks,
+                            max_batch=max_batch, search_params=sp,
+                            warm=warm)
+
+
+def _ladder():
+    """full quality -> reduced n_probes -> best-effort shed (shed-only
+    top rung inherits the degraded executables)."""
+    return [
+        serving.Rung("full"),
+        serving.Rung("probes/4", params=ivf_pq.SearchParams(n_probes=4)),
+        serving.Rung("shed-best-effort", shed_best_effort=True),
+    ]
+
+
+def _bcfg(**kw):
+    kw.setdefault("step_down_p99_s", 0.5)
+    kw.setdefault("step_up_p99_s", 0.1)
+    kw.setdefault("dwell_s", 1.0)
+    return serving.BrownoutConfig(**kw)
+
+
+def _mk(pq_setup, *, t=None, tenants=(), bcfg=None, cfg=None, warm="jit"):
+    """Server + controller pair (controller BEFORE start, per contract);
+    ``t`` injects the controller clock as a one-element list."""
+    ex = _executor(pq_setup, warm=warm)
+    srv = serving.Server(ex, cfg or serving.ServerConfig(
+        max_batch=16, max_wait_us=5_000, max_queue_rows=8))
+    clock = (lambda: t[0]) if t is not None else time.monotonic
+    ctl = serving.BrownoutController(srv, _ladder(), bcfg or _bcfg(),
+                                     best_effort_tenants=tenants,
+                                     clock=clock)
+    return srv, ctl
+
+
+# ---------------------------------------------------------------------------
+# ladder + config validation
+
+
+class TestLadderValidation:
+    def test_hysteresis_gap_enforced(self):
+        with pytest.raises(Exception, match="hysteresis"):
+            _bcfg(step_up_p99_s=0.5, step_down_p99_s=0.5).validate()
+        with pytest.raises(Exception, match="queue_low"):
+            _bcfg(queue_low_fraction=0.5, queue_high_fraction=0.5).validate()
+        with pytest.raises(Exception, match="dwell"):
+            _bcfg(dwell_s=-1.0).validate()
+        with pytest.raises(Exception, match="interval"):
+            _bcfg(interval_s=0.0).validate()
+        with pytest.raises(Exception, match="shed_step_down"):
+            _bcfg(shed_step_down=0).validate()
+
+    def test_ladder_needs_two_rungs(self, pq_setup):
+        srv = serving.Server(_executor(pq_setup),
+                             serving.ServerConfig(max_batch=16))
+        with pytest.raises(Exception, match="at least"):
+            serving.BrownoutController(srv, [serving.Rung("full")])
+
+    def test_rung_zero_must_be_undegraded(self, pq_setup):
+        srv = serving.Server(_executor(pq_setup),
+                             serving.ServerConfig(max_batch=16))
+        bad = [serving.Rung("half", params=ivf_pq.SearchParams(n_probes=4)),
+               serving.Rung("quarter",
+                            params=ivf_pq.SearchParams(n_probes=2))]
+        with pytest.raises(Exception, match="rung 0"):
+            serving.BrownoutController(srv, bad)
+        with pytest.raises(Exception, match="rung 0"):
+            serving.BrownoutController(
+                srv, [serving.Rung("full", shed_best_effort=True),
+                      serving.Rung("half",
+                                   params=ivf_pq.SearchParams(n_probes=4))])
+
+    def test_set_ladder_after_warmup_rejected(self, pq_setup):
+        ex = _executor(pq_setup)
+        ex.warmup()
+        with pytest.raises(Exception, match="zero-recompile"):
+            ex.set_ladder([ivf_pq.SearchParams(n_probes=4)])
+
+    def test_shed_only_rung_inherits_executables(self, pq_setup):
+        srv, ctl = _mk(pq_setup)
+        # ladder level 2 is shed-only (params=None) -> same executor rung
+        # as level 1: no extra warmup, no extra cache entries
+        assert ctl._exec_rung == [0, 1, 1]
+        assert srv.executor.n_rungs == 2
+
+    def test_brownedout_is_overloaded(self):
+        assert issubclass(serving.BrownedOut, serving.Overloaded)
+
+
+# ---------------------------------------------------------------------------
+# the rung-extended executor
+
+
+class TestRungExecutor:
+    def test_warmup_covers_every_rung(self, pq_setup):
+        res, _, _, index, sp = pq_setup
+        ex = serving.Executor(
+            res, "ivf_pq", index, ks=(5,), max_batch=16, search_params=sp,
+            ladder=(ivf_pq.SearchParams(n_probes=2),), warm="jit")
+        n = ex.warmup()
+        assert n == len(ex.buckets) * len(ex.ks) * 2
+        assert {r for (_, _, r) in ex._fns} == {0, 1}
+
+    def test_degraded_rung_uses_its_params(self, pq_setup):
+        res, _, q, index, _ = pq_setup
+        sp2 = ivf_pq.SearchParams(n_probes=2)
+        ex = serving.Executor(
+            res, "ivf_pq", index, ks=(5,), max_batch=16,
+            search_params=ivf_pq.SearchParams(n_probes=8),
+            ladder=(sp2,), warm="jit")
+        d, i = ex.search_bucket(q[:8], 8, 5, rung=1)
+        dd, ii = ivf_pq.search(res, sp2, index, q[:8], 5)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(ii))
+        np.testing.assert_allclose(np.asarray(d), np.asarray(dd), rtol=1e-5)
+
+    def test_rung_outside_ladder_rejected(self, pq_setup):
+        ex = _executor(pq_setup)
+        with pytest.raises(Exception, match="rung"):
+            ex.search_bucket(jnp.zeros((4, 32), np.float32), 4, 5, rung=1)
+
+    def test_aot_cache_key_includes_rung(self, pq_setup):
+        res, _, q, index, _ = pq_setup
+        cache = aot.ExecutableCache()
+        a = cache.get("ivf_pq", res, index, batch=4, k=5, n_probes=8,
+                      scan_mode="recon", rung=0)
+        b = cache.get("ivf_pq", res, index, batch=4, k=5, n_probes=8,
+                      scan_mode="recon", rung=1)
+        assert a is not b
+        assert cache.get("ivf_pq", res, index, batch=4, k=5, n_probes=8,
+                         scan_mode="recon", rung=0) is a
+        d, i = b(q[:4])
+        assert d.shape == (4, 5) and i.shape == (4, 5)
+
+    def test_zero_recompiles_across_rung_transitions(self, pq_setup):
+        """The tentpole contract: every rung pre-warmed at start, so a
+        brownout transition (one int store) never compiles — asserted
+        with the same xla.compiles tripwire as the bucket contract."""
+        srv, ctl = _mk(pq_setup, warm="aot",
+                       cfg=serving.ServerConfig(max_batch=16,
+                                                max_wait_us=2_000))
+        q = np.asarray(pq_setup[2])
+        with obs.collecting():
+            srv.start()
+            try:
+                for lvl in (0, 1, 2, 0):
+                    srv.brownout.rung = ctl._exec_rung[lvl]
+                    srv.brownout.level = lvl
+                    for m in (1, 3, 16):
+                        srv.search(q[:m], 5)
+                c0 = obs.registry().counter("xla.compiles").value
+                for lvl in (2, 1, 0, 1, 2):
+                    srv.brownout.rung = ctl._exec_rung[lvl]
+                    srv.brownout.level = lvl
+                    for m in (2, 16, 5):
+                        srv.search(q[:m], 5)
+                c1 = obs.registry().counter("xla.compiles").value
+            finally:
+                srv.stop()
+        assert c1 == c0, f"{c1 - c0} recompiles across rung transitions"
+
+
+# ---------------------------------------------------------------------------
+# the controller's decisions (synchronous evaluate, injected clock)
+
+
+class TestController:
+    def test_latency_steps_down_to_the_floor(self, pq_setup):
+        t = [0.0]
+        srv, ctl = _mk(pq_setup, t=t)
+        with obs.collecting():
+            h = obs.registry().histogram("serving.latency.total")
+            for _ in range(10):
+                h.observe(1.0)                    # p99 well above 0.5
+            assert ctl.evaluate() is None         # dwell since construction
+            t[0] += 1.5
+            assert ctl.evaluate() == "step_down"
+            assert srv.brownout.level == 1 and srv.brownout.rung == 1
+            assert obs.registry().gauge("serving.brownout.level").value == 1
+            assert ctl.evaluate() is None         # dwell pins the next step
+            t[0] += 1.5
+            assert ctl.evaluate() == "step_down"  # still hot -> level 2
+            assert srv.brownout.level == 2
+            assert srv.brownout.shed_best_effort
+            t[0] += 1.5
+            assert ctl.evaluate() is None         # already at the floor
+        evs = flight.events("serving.brownout.step_down")
+        assert [(e["attrs"]["from_level"], e["attrs"]["to_level"])
+                for e in evs] == [(0, 1), (1, 2)]
+        assert evs[0]["attrs"]["rung"] == "probes/4"
+        assert evs[0]["attrs"]["p99_s"] >= 0.5
+
+    def test_hysteresis_pins_midband_and_calm_steps_up(self, pq_setup,
+                                                       monkeypatch):
+        import importlib
+        # the package's registry() accessor shadows the submodule attr
+        _registry = importlib.import_module(
+            "raft_tpu.observability.registry")
+        T = [1000.0]
+        monkeypatch.setattr(_registry, "_now", lambda: T[0])
+        t = [0.0]
+        srv, ctl = _mk(pq_setup, t=t)
+        with obs.collecting():
+            h = obs.registry().histogram("serving.latency.total")
+            h.observe(1.0)
+            t[0] += 1.5
+            assert ctl.evaluate() == "step_down"
+            # the hot sample ages out of the window; mid-band latency
+            # (between step_up 0.1 and step_down 0.5) arrives instead
+            T[0] += 300.0
+            for _ in range(5):
+                h.observe(0.3)
+            for _ in range(5):
+                t[0] += 1.5
+                assert ctl.evaluate() is None      # hysteresis: no flap
+            assert srv.brownout.level == 1
+            # a calm (empty) window recovers one level
+            T[0] += 300.0
+            t[0] += 1.5
+            assert ctl.evaluate() == "step_up"
+            assert srv.brownout.level == 0 and srv.brownout.rung == 0
+            assert obs.registry().gauge("serving.brownout.level").value == 0
+        evs = flight.events("serving.brownout.step_up")
+        assert len(evs) == 1
+        assert evs[0]["attrs"]["from_level"] == 1
+        assert evs[0]["attrs"]["to_level"] == 0
+
+    def test_pressure_sheds_step_down_quota_excluded(self, pq_setup):
+        t = [0.0]
+        srv, ctl = _mk(pq_setup, t=t)
+        with obs.collecting():
+            reg = obs.registry()
+            reg.counter("serving.shed.quota").inc(5)   # policy, not pressure
+            t[0] += 1.5
+            assert ctl.evaluate() is None
+            reg.counter("serving.shed.deadline").inc()
+            t[0] += 1.5
+            assert ctl.evaluate() == "step_down"
+            assert flight.events("serving.brownout.step_down")[0][
+                "attrs"]["window_sheds"] == 1
+
+    def test_queue_pressure_steps_down(self, pq_setup):
+        t = [0.0]
+        srv, ctl = _mk(pq_setup, t=t)          # max_queue_rows=8, high=0.5
+        q = pq_setup[2]
+        srv.start()
+        try:
+            srv.batcher.stop(drain=False)      # park: submissions stay queued
+            fut = srv.submit(q[:4], 5)         # 4 rows >= 0.5 * 8
+            t[0] += 1.5
+            assert ctl.evaluate() == "step_down"
+            assert srv.brownout.level == 1
+            srv.batcher.start()                # drain at the degraded rung
+            d, i = fut.result(timeout=30)
+            assert d.shape == (4, 5)
+        finally:
+            srv.stop()
+
+    def test_best_effort_tenant_shed_exactly_once(self, pq_setup):
+        srv, ctl = _mk(pq_setup, tenants={"batch"})
+        q = pq_setup[2]
+        with obs.collecting():
+            srv.start()
+            try:
+                # the state the controller would publish at the top rung
+                srv.brownout.rung = ctl._exec_rung[2]
+                srv.brownout.shed_best_effort = True
+                srv.brownout.level = 2
+                with pytest.raises(serving.BrownedOut):
+                    srv.submit(q[:2], 5, tenant="batch")
+                # paying tenants still served at the degraded rung
+                d, i = srv.search(q[:3], 5, tenant="paying")
+                assert d.shape == (3, 5)
+            finally:
+                srv.stop()
+            reg = obs.registry()
+            assert reg.counter("serving.shed.brownout").value == 1
+            for other in ("serving.shed.deadline", "serving.shed.queue_full",
+                          "serving.shed.quota"):
+                assert reg.counter(other).value == 0, other
+        evs = flight.events("serving.shed.brownout")
+        assert len(evs) == 1
+        assert evs[0]["attrs"]["tenant"] == "batch"
+        assert evs[0]["attrs"]["level"] == 2
+
+    def test_stats_track_residency(self, pq_setup):
+        t = [0.0]
+        srv, ctl = _mk(pq_setup, t=t)
+        with obs.collecting():
+            obs.registry().histogram("serving.latency.total").observe(1.0)
+            t[0] += 2.0
+            assert ctl.evaluate() == "step_down"
+            t[0] += 3.0
+            s = ctl.stats()
+        assert s["level"] == 1 and s["rung"] == "probes/4"
+        assert s["transitions"] == 1
+        assert s["residency_s"]["full"] == pytest.approx(2.0)
+        assert s["residency_s"]["probes/4"] == pytest.approx(3.0)
+
+    def test_disabled_collection_is_calm(self, pq_setup):
+        # no registry signal at all: the controller must idle at level 0,
+        # not oscillate on missing telemetry
+        t = [10.0]
+        srv, ctl = _mk(pq_setup, t=t)
+        t[0] += 5.0
+        assert ctl.evaluate() is None
+        assert srv.brownout.level == 0
+
+    def test_background_loop_lifecycle(self, pq_setup):
+        srv, ctl = _mk(pq_setup, bcfg=_bcfg(dwell_s=0.0, interval_s=0.01))
+        with ctl:
+            time.sleep(0.05)
+        assert ctl._thread is None
+        assert srv.brownout.level == 0
+
+    def test_brownout_level_annotated_on_traces(self, pq_setup):
+        srv, ctl = _mk(pq_setup, cfg=serving.ServerConfig(
+            max_batch=16, max_wait_us=2_000))
+        q = np.asarray(pq_setup[2])
+        with trace.tracing_scope():
+            srv.start()
+            try:
+                srv.brownout.rung = ctl._exec_rung[1]
+                srv.brownout.level = 1
+                srv.search(q[:2], 5)
+            finally:
+                srv.stop()
+        recs = [r for r in flight.traces() if r.name == "serving.request"]
+        assert recs and recs[-1].attrs["brownout_level"] == 1
+
+
+# ---------------------------------------------------------------------------
+# deadline accounting at every brownout level (exactly one shed counter)
+
+
+class TestDeadlineAtEveryLevel:
+    @pytest.mark.parametrize("level", [0, 1, 2])
+    def test_queue_expiry_ticks_one_counter(self, pq_setup, level):
+        srv, ctl = _mk(pq_setup, cfg=serving.ServerConfig(
+            max_batch=16, max_wait_us=200_000))
+        q = pq_setup[2]
+        t = [0.0]
+        with obs.collecting():
+            srv.start()
+            try:
+                srv.brownout.rung = ctl._exec_rung[level]
+                srv.brownout.level = level
+                dead = Deadline(0.05, clock=lambda: t[0])
+                doomed = srv.submit(q[:2], 5, deadline=dead)
+                t[0] += 1.0                       # budget lapses queued
+                ok = srv.submit(q[:3], 5)
+                assert ok.result(timeout=10)[0].shape == (3, 5)
+                with pytest.raises(DeadlineExceededError):
+                    doomed.result(timeout=10)
+            finally:
+                srv.stop()
+            # exactly ONE shed counter for the shed request, at any level
+            assert obs.registry().counter(
+                "serving.shed.deadline").value == 1
+            assert obs.registry().counter(
+                "serving.shed.brownout").value == 0
+        evs = flight.events("serving.shed.deadline")
+        assert [e["attrs"]["phase"] for e in evs] == ["dispatch"]
+        assert evs[0]["attrs"]["level"] == level
+
+    def test_submit_expiry_ticks_one_counter(self, pq_setup):
+        srv, ctl = _mk(pq_setup)
+        with obs.collecting():
+            srv.start()
+            try:
+                srv.brownout.rung = ctl._exec_rung[1]
+                srv.brownout.level = 1
+                with pytest.raises(serving.Overloaded):
+                    srv.submit(pq_setup[2][:2], 5, deadline=Deadline(0.0))
+            finally:
+                srv.stop()
+            assert obs.registry().counter(
+                "serving.shed.deadline").value == 1
+        evs = flight.events("serving.shed.deadline")
+        assert [e["attrs"]["phase"] for e in evs] == ["submit"]
+        assert evs[0]["attrs"]["level"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the generation watchdog (auto-rollback)
+
+
+class TestWatchdog:
+    def test_disabled_by_default(self, pq_setup):
+        srv = serving.Server(_executor(pq_setup),
+                             serving.ServerConfig(max_batch=16))
+        assert srv.note_integrity_strike("test") is False
+        assert flight.events("serving.auto_rollback") == []
+
+    def test_below_threshold_no_rollback(self, pq_setup):
+        res, _, _, index, _ = pq_setup
+        srv = serving.Server(
+            _executor(pq_setup),
+            serving.ServerConfig(max_batch=16, rollback_strikes=3))
+        mutated = ivf_pq.delete(res, index, [0, 1, 2])
+        srv.swap_index(mutated)
+        assert srv.note_integrity_strike("one") is False
+        assert srv.note_integrity_strike("two") is False
+        assert srv.executor.index is mutated
+        assert flight.events("serving.auto_rollback") == []
+
+    def test_rollback_restores_last_good_and_passes_canary(self,
+                                                           canary_setup):
+        from raft_tpu.integrity import canary as _canary
+        res, _, q, index, sp = canary_setup
+        ex = serving.Executor(res, "ivf_pq", index, ks=(5,), max_batch=16,
+                              search_params=sp, warm="jit")
+        cfg = serving.ServerConfig(max_batch=16, max_wait_us=2_000,
+                                   rollback_strikes=2)
+        q = np.asarray(q)
+        with obs.collecting():
+            with serving.Server(ex, cfg) as srv:
+                srv.search(q[:3], 5)
+                mutated = ivf_pq.delete(res, index, [0, 1, 2])
+                srv.swap_index(mutated)       # retains `index` as last-good
+                assert srv.note_integrity_strike("canary floor") is False
+                assert srv.note_integrity_strike("canary floor") is True
+                assert srv.executor.index is index
+                # the restored generation passes its own canary check
+                assert _canary.health_check(res, srv.executor.index).ok
+                # and keeps serving recompile-free (the rollback swap
+                # re-warmed the table before publishing it)
+                c0 = obs.registry().counter("xla.compiles").value
+                for m in (1, 3, 8):
+                    srv.search(q[:m], 5)
+                c1 = obs.registry().counter("xla.compiles").value
+                assert c1 == c0, f"{c1 - c0} recompiles after rollback"
+            reg = obs.registry()
+            assert reg.counter("serving.auto_rollbacks").value == 1
+            assert reg.counter("serving.integrity_strikes").value == 2
+        evs = flight.events("serving.auto_rollback")
+        assert len(evs) == 1
+        at = evs[0]["attrs"]
+        assert at["strikes"] == 2
+        assert at["restored_generation"] == getattr(index, "generation",
+                                                    None)
+        assert "canary floor" in at["reason"]
+
+    def test_window_prunes_old_strikes(self, pq_setup, monkeypatch):
+        import raft_tpu.serving.server as server_mod
+        res, _, _, index, _ = pq_setup
+        srv = serving.Server(
+            _executor(pq_setup),
+            serving.ServerConfig(max_batch=16, rollback_strikes=2,
+                                 rollback_window_s=1.0))
+        mutated = ivf_pq.delete(res, index, [0, 1, 2])
+        srv.swap_index(mutated)
+        t = [0.0]
+        monkeypatch.setattr(server_mod, "time",
+                            types.SimpleNamespace(monotonic=lambda: t[0]))
+        assert srv.note_integrity_strike("a") is False
+        t[0] = 5.0                              # first strike ages out
+        assert srv.note_integrity_strike("b") is False
+        t[0] = 5.5                              # two strikes inside 1.0s
+        assert srv.note_integrity_strike("c") is True
+        assert srv.executor.index is index
+
+    def test_batch_integrity_error_strikes_and_rolls_back(self, pq_setup,
+                                                          monkeypatch):
+        res, _, q, index, _ = pq_setup
+        ex = _executor(pq_setup)
+        cfg = serving.ServerConfig(max_batch=16, max_wait_us=2_000,
+                                   rollback_strikes=1)
+        q = np.asarray(q)
+        with serving.Server(ex, cfg) as srv:
+            mutated = ivf_pq.delete(res, index, [0, 1, 2])
+            srv.swap_index(mutated)
+            orig = ex.search_bucket
+            trip = [True]
+
+            def poisoned(queries, n_valid, k, rung=0):
+                if trip[0]:
+                    trip[0] = False
+                    raise IntegrityError("post-swap corruption",
+                                         invariant="test.trip")
+                return orig(queries, n_valid, k, rung)
+
+            monkeypatch.setattr(ex, "search_bucket", poisoned)
+            with pytest.raises(IntegrityError):
+                srv.search(q[:2], 5, timeout=30)
+            # the rollback runs on the dispatcher thread after the futures
+            # fail; wait for the swap to land
+            deadline = time.monotonic() + 30
+            while (srv.executor.index is not index
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert srv.executor.index is index
+            d, i = srv.search(q[:3], 5, timeout=30)
+            assert d.shape == (3, 5)
+        evs = flight.events("serving.auto_rollback")
+        assert len(evs) == 1
+        assert "batch_error" in evs[0]["attrs"]["reason"]
+
+    def test_non_integrity_batch_errors_do_not_strike(self, pq_setup,
+                                                      monkeypatch):
+        res, _, q, index, _ = pq_setup
+        ex = _executor(pq_setup)
+        cfg = serving.ServerConfig(max_batch=16, max_wait_us=2_000,
+                                   rollback_strikes=1)
+        q = np.asarray(q)
+        with serving.Server(ex, cfg) as srv:
+            mutated = ivf_pq.delete(res, index, [0, 1, 2])
+            srv.swap_index(mutated)
+            orig = ex.search_bucket
+            trip = [True]
+
+            def flaky(queries, n_valid, k, rung=0):
+                if trip[0]:
+                    trip[0] = False
+                    raise RuntimeError("transient executor hiccup")
+                return orig(queries, n_valid, k, rung)
+
+            monkeypatch.setattr(ex, "search_bucket", flaky)
+            with pytest.raises(RuntimeError):
+                srv.search(q[:2], 5, timeout=30)
+            d, i = srv.search(q[:3], 5, timeout=30)
+            assert d.shape == (3, 5)
+            assert srv.executor.index is mutated    # no rollback
+        assert flight.events("serving.auto_rollback") == []
+
+    def test_check_canary_failure_strikes(self, canary_setup, monkeypatch):
+        from raft_tpu.integrity import canary as _canary
+        res, _, _, index, sp = canary_setup
+        ex = serving.Executor(res, "ivf_pq", index, ks=(5,), max_batch=16,
+                              search_params=sp, warm="jit")
+        srv = serving.Server(ex, serving.ServerConfig(max_batch=16,
+                                                      rollback_strikes=1))
+        mutated = ivf_pq.delete(res, index, [0, 1, 2])
+        srv.swap_index(mutated)
+        bad = _canary.CanaryReport(recall=0.05, floor=0.5, n_queries=4, k=5)
+        monkeypatch.setattr(_canary, "health_check", lambda *a, **k: bad)
+        assert srv.check_canary(res) is False
+        # single-strike config: the canary strike rolled back synchronously
+        assert srv.executor.index is index
+        evs = flight.events("serving.auto_rollback")
+        assert len(evs) == 1
+        assert "canary" in evs[0]["attrs"]["reason"]
+
+    def test_check_canary_passing_and_canaryless(self, pq_setup,
+                                                 canary_setup):
+        res = pq_setup[0]
+        # canary-less index: health_check returns None -> healthy
+        srv = serving.Server(_executor(pq_setup),
+                             serving.ServerConfig(max_batch=16,
+                                                  rollback_strikes=1))
+        assert srv.check_canary(res) is True
+        # canary-carrying healthy index: report.ok -> no strike
+        _, _, _, cindex, sp = canary_setup
+        ex = serving.Executor(res, "ivf_pq", cindex, ks=(5,), max_batch=16,
+                              search_params=sp, warm="jit")
+        srv2 = serving.Server(ex, serving.ServerConfig(max_batch=16,
+                                                       rollback_strikes=1))
+        assert srv2.check_canary(res) is True
+        assert flight.events("serving.auto_rollback") == []
+
+    def test_no_second_rollback_without_new_good(self, pq_setup):
+        res, _, _, index, _ = pq_setup
+        srv = serving.Server(
+            _executor(pq_setup),
+            serving.ServerConfig(max_batch=16, rollback_strikes=1))
+        mutated = ivf_pq.delete(res, index, [0, 1, 2])
+        srv.swap_index(mutated)
+        assert srv.note_integrity_strike("first") is True
+        # last-good was consumed: a still-failing environment must not
+        # ping-pong back onto the generation it just indicted
+        assert srv.note_integrity_strike("second") is False
+        assert srv.executor.index is index
+        assert len(flight.events("serving.auto_rollback")) == 1
+
+
+# ---------------------------------------------------------------------------
+# flight recorder capacity (satellite)
+
+
+class TestFlightCapacity:
+    def test_ring_wraps_at_capacity(self):
+        fr = flight.FlightRecorder(capacity=4)
+        for j in range(6):
+            fr.record_event("ringtest.evt", j=j)
+        evs = fr.events("ringtest.evt")
+        assert len(evs) == 4
+        assert [e["attrs"]["j"] for e in evs] == [2, 3, 4, 5]
+
+    def test_capacity_bounds_checked(self):
+        for bad in (0, -3, flight.MAX_CAPACITY + 1):
+            with pytest.raises(ValueError):
+                flight.FlightRecorder(capacity=bad)
+        assert flight.FlightRecorder(capacity=1).capacity == 1
+        assert flight.FlightRecorder(
+            capacity=flight.MAX_CAPACITY).capacity == flight.MAX_CAPACITY
+
+    def test_env_capacity_valid(self, monkeypatch):
+        monkeypatch.setenv(flight.CAPACITY_ENV, "64")
+        assert flight._env_capacity() == 64
+
+    def test_env_capacity_unset_uses_default(self, monkeypatch):
+        monkeypatch.delenv(flight.CAPACITY_ENV, raising=False)
+        assert flight._env_capacity() == flight.DEFAULT_CAPACITY
+
+    @pytest.mark.parametrize("bad", ["notanint", "0", "-5",
+                                     str(flight.MAX_CAPACITY + 1)])
+    def test_env_capacity_invalid_warns_and_falls_back(self, monkeypatch,
+                                                       bad):
+        monkeypatch.setenv(flight.CAPACITY_ENV, bad)
+        with pytest.warns(RuntimeWarning):
+            assert flight._env_capacity() == flight.DEFAULT_CAPACITY

@@ -314,6 +314,29 @@ class TestRoutedAnn:
         np.testing.assert_allclose(np.asarray(rd), np.asarray(bd),
                                    rtol=1e-5, atol=1e-5)
 
+    def test_straggler_injected_routed_search_is_exact(self, rhandle, data,
+                                                       built, monkeypatch):
+        """PR 12 satellite: a straggler-injected ROUTED search still
+        merges the exact single-index answer — the scripted slow shard
+        delays the merge (host-side pause in resilience.faults), it does
+        not drop candidates."""
+        from raft_tpu.core.outputs import raw
+        from raft_tpu.distributed import ann
+        from raft_tpu.resilience import FaultPlan, faults
+        slept = []
+        monkeypatch.setattr(faults, "_sleep", slept.append)
+        _, q = data
+        base, ridx = built
+        sp = ivf_pq.SearchParams(n_probes=self.NL, scan_mode="recon")
+        bd, bi = raw(ivf_pq.search)(rhandle, sp, base, q, self.K)
+        plan = FaultPlan(seed=3).straggle_shard(1, delay=0.04)
+        with plan.active():
+            rd, ri = ann.search(rhandle, sp, ridx, q, self.K)
+        np.testing.assert_array_equal(np.asarray(ri), np.asarray(bi))
+        np.testing.assert_allclose(np.asarray(rd), np.asarray(bd),
+                                   rtol=1e-5, atol=1e-5)
+        assert slept == [0.04]
+
     def test_scan_work_and_gather_shape_tripwire(self, rhandle, data,
                                                  built):
         """Acceptance criterion: per-shard scanned candidates at the

@@ -122,6 +122,106 @@ class TestFaults:
 
 
 # ---------------------------------------------------------------------------
+# latency / straggler injection (PR 12)
+# ---------------------------------------------------------------------------
+
+class TestLatencyInjection:
+    @pytest.fixture(autouse=True)
+    def _recorded_sleep(self, monkeypatch):
+        # late-bound so a test may swap self.slept for a fresh list
+        self.slept = []
+        monkeypatch.setattr(faults, "_sleep",
+                            lambda s: self.slept.append(s))
+
+    def test_delay_spec_sleeps_instead_of_raising(self):
+        plan = FaultPlan(seed=0).delay_at("site.lat", delay=0.25)
+        with plan.active():
+            faults.maybe_fail("site.lat")     # must NOT raise
+            faults.maybe_fail("site.lat")     # unbounded by default
+        assert self.slept == [0.25, 0.25]
+        assert plan.specs[0].fired == 2
+
+    def test_times_and_after_bound_delays(self):
+        plan = FaultPlan(seed=0).delay_at("site.lat", delay=0.1,
+                                          times=1, after=1)
+        with plan.active():
+            faults.maybe_fail("site.lat")     # skipped (after=1)
+            faults.maybe_fail("site.lat")     # fires
+            faults.maybe_fail("site.lat")     # budget spent
+        assert self.slept == [0.1]
+
+    def test_jitter_is_seed_deterministic(self):
+        def run(seed):
+            slept = []
+            self.slept = slept  # capture this run only
+            plan = FaultPlan(seed=seed).delay_at("site.jit", delay=0.01,
+                                                 jitter=0.05)
+            with plan.active():
+                for _ in range(8):
+                    faults.maybe_fail("site.jit")
+            return slept
+
+        a, b = run(99), run(99)
+        assert a == b
+        assert all(0.01 <= s <= 0.06 for s in a)
+        assert len(set(a)) > 1                # jitter actually varies
+        assert run(100) != a                  # and the seed matters
+
+    def test_delay_counter(self):
+        obs.reset()
+        with obs.collecting():
+            plan = FaultPlan(seed=0).delay_at("site.cnt", delay=0.2)
+            with plan.active():
+                faults.maybe_fail("site.cnt")
+        c = obs.snapshot()["counters"]
+        assert c.get("resilience.fault.delayed.site.cnt") == 1
+        assert "resilience.fault.injected.site.cnt" not in c
+
+    def test_delay_and_failure_coexist_at_one_site(self):
+        plan = (FaultPlan(seed=0)
+                .delay_at("site.both", delay=0.3)
+                .at("site.both", times=1))
+        with plan.active():
+            with pytest.raises(TransientFault):
+                faults.maybe_fail("site.both")   # slept, then raised
+            faults.maybe_fail("site.both")       # failure budget spent
+        assert self.slept == [0.3, 0.3]
+
+    def test_negative_delay_rejected(self):
+        with pytest.raises(ValueError):
+            FaultPlan(seed=0).delay_at("site.x", delay=-1.0)
+        with pytest.raises(ValueError):
+            FaultPlan(seed=0).straggle_shard(0, delay=0.1, jitter=-0.1)
+
+    def test_straggler_pause_inactive_is_noop(self):
+        assert faults.straggler_pause(8) == ()
+        assert self.slept == []
+
+    def test_straggler_pause_sleeps_the_max(self):
+        obs.reset()
+        plan = (FaultPlan(seed=0)
+                .straggle_shard(1, delay=0.2)
+                .straggle_shard(3, delay=0.1))
+        with obs.collecting(), plan.active():
+            delays = faults.straggler_pause(4)
+        assert delays == (0.0, 0.2, 0.0, 0.1)
+        assert self.slept == [0.2]            # ONE pause: the slowest shard
+        c = obs.snapshot()["counters"]
+        assert c.get("resilience.fault.delayed.distributed.straggler") == 1
+
+    def test_straggler_jitter_deterministic(self):
+        def run(seed):
+            plan = FaultPlan(seed=seed).straggle_shard(2, delay=0.05,
+                                                       jitter=0.02)
+            with plan.active():
+                return [faults.straggler_pause(4) for _ in range(4)]
+
+        a, b = run(5), run(5)
+        assert a == b
+        assert all(0.05 <= d[2] <= 0.07 and d[0] == 0.0 for d in a)
+
+
+# ---------------------------------------------------------------------------
 # retry / deadline
 # ---------------------------------------------------------------------------
 
@@ -614,6 +714,30 @@ class TestDistributedResilience:
         assert list(np.asarray(status)) == [1, 0, 1, 1, 1, 1, 1, 1]
         ids = np.asarray(i)
         assert not ((ids >= per) & (ids < 2 * per)).any()
+
+    def test_straggler_injected_search_merges_exact(self, handle,
+                                                    dist_index, monkeypatch):
+        """A straggler-injected sharded search still merges EXACT results
+        — the slow shard eventually answers, only latency moves — and the
+        pause + per-shard delay vector land in the flight recorder."""
+        from raft_tpu.observability import flight
+        slept = []
+        monkeypatch.setattr(faults, "_sleep", slept.append)
+        ann, ivf_pq, idx, q = dist_index
+        sp = ivf_pq.SearchParams(n_probes=8)
+        d0, i0 = ann.search(handle, sp, idx, q, 5)
+        flight.clear()
+        plan = FaultPlan(seed=1).straggle_shard(2, delay=0.05, jitter=0.01)
+        with plan.active():
+            d1, i1 = ann.search(handle, sp, idx, q, 5)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_allclose(np.asarray(d0), np.asarray(d1))
+        assert slept and all(0.05 <= s <= 0.06 for s in slept)
+        evs = flight.events("distributed.straggler")
+        assert len(evs) == 1
+        delays = evs[0]["attrs"]["delays_s"]
+        assert evs[0]["attrs"]["n_shards"] == 8
+        assert delays[2] > 0.0 and delays[0] == 0.0
 
     def test_degraded_search_explicit_flags(self, handle, dist_index):
         ann, ivf_pq, idx, q = dist_index
