@@ -21,6 +21,7 @@ is ``{}``).
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -53,10 +54,36 @@ def _write_bench_artifact() -> str:
         while os.path.exists(os.path.join(here, f"BENCH_r{n:02d}.json")):
             n += 1
         path = os.path.join(here, f"BENCH_r{n:02d}.json")
-    with open(path, "w") as f:
-        json.dump({"results": _EMITTED}, f, indent=2)
+    try:
+        with open(path, "w") as f:
+            json.dump({"results": _EMITTED}, f, indent=2)
+    except OSError as e:
+        print(f"BENCH FATAL: cannot write ${BENCH_OUT_ENV} artifact "
+              f"{path!r}: {e} — the run's machine-readable record is "
+              f"LOST", file=sys.stderr, flush=True)
+        raise
     print(f"bench artifact: {path}", flush=True)
     return path
+
+
+def _check_bench_out_writable() -> None:
+    """Pre-flight for ``$RAFT_TPU_BENCH_OUT``: fail LOUDLY (exit 2)
+    before the run when the artifact path can't be written, instead of
+    burning the whole benchmark and silently dropping its record at the
+    end (the failure mode the round-7 re-anchor flagged)."""
+    path = os.environ.get(BENCH_OUT_ENV)
+    if not path:
+        return
+    existed = os.path.exists(path)
+    try:
+        with open(path, "a"):
+            pass
+    except OSError as e:
+        print(f"BENCH FATAL: ${BENCH_OUT_ENV}={path!r} is not writable: "
+              f"{e}", file=sys.stderr, flush=True)
+        raise SystemExit(2)
+    if not existed:
+        os.remove(path)     # probe only — leave no empty artifact
 
 N_DB = 1_000_000
 N_QUERIES = 5_000
@@ -1047,6 +1074,331 @@ def run_overload(conf_path: str) -> int:
     return 1 if failures else 0
 
 
+INGEST_WRITE_ROWS = 32         # rows per Server.write() batch
+
+
+def bench_ingest(res, db, queries, *, build_param=None, search_param=None,
+                 k=SERVING_K, max_batch=SERVING_MAX_BATCH,
+                 max_wait_us=1000.0, clients=8, request_rows=32,
+                 duration_s=2.0, write_rows=INGEST_WRITE_ROWS,
+                 write_multiplier=2.0, write_rate_rows_per_s=None,
+                 memtable_capacity=1 << 16, calib_s=0.5,
+                 wal_dir=None) -> list:
+    """Durable streaming ingest (PR 13) under concurrent serving load.
+
+    One IVF-PQ server with the WAL-backed delta tier attached, three
+    phases:
+
+    1. closed-loop READ baseline — delta merge warmed, no writer;
+    2. calibrate the closed-loop write peak (one synchronous writer:
+       WAL append + fsync group commit + memtable apply per batch),
+       then an OPEN-LOOP writer at ``write_multiplier`` x the target
+       rate — ``write_rate_rows_per_s`` when the conf pins one (the
+       smoke operating point: a host-peak-relative rate saturates a
+       CPU core with fsync spin and measures GIL contention, not the
+       serving path), else the calibrated peak —
+       concurrent with the same closed-loop readers — writes the
+       admission path can't absorb shed with typed ``Overloaded``
+       (backpressure by design, counted, never crashing the writer);
+    3. kill-and-recover — drop the ingest server without folding,
+       replay the WAL into a fresh one, and verify EVERY acked id is
+       present: the zero-acked-write-loss durability contract.
+
+    Emits ``ingest_writes_per_s`` (acked write throughput + visibility
+    p50/p99 from the ``serving.ingest.visibility`` histogram),
+    ``ingest_qps_concurrent`` (``vs_baseline`` = fraction of the
+    no-writer closed loop — the CI gate, bar 0.8x) and
+    ``ingest_recovery`` (acked vs recovered rows, replay wall clock).
+    The memtable is pre-sized to ``memtable_capacity`` so it never
+    regrows mid-run: ``recompiles_steady`` samples ``xla.compiles``
+    across phase 2 and must be zero (the write->search->write loop is
+    value-only traffic through shape-static merge kernels)."""
+    import shutil
+    import tempfile
+    import threading
+
+    from raft_tpu import observability as obs
+    from raft_tpu import serving
+    from raft_tpu.neighbors import ivf_pq
+
+    bp = build_param or {"nlist": 1024, "pq_dim": 32}
+    spc = search_param or {"nprobe": 32}
+    index = ivf_pq.build(
+        res, ivf_pq.IndexParams(n_lists=bp["nlist"], pq_dim=bp["pq_dim"],
+                                kmeans_n_iters=bp.get("kmeans_n_iters", 10)),
+        db)
+    sp = ivf_pq.SearchParams(n_probes=spc["nprobe"],
+                             scan_mode=spc.get("scan_mode", "auto"),
+                             per_probe_topk=spc.get("per_probe_topk", 0))
+    q = np.asarray(queries)
+    if q.shape[0] < max_batch:
+        q = np.concatenate([q] * int(np.ceil(max_batch / q.shape[0])))
+    db_h = np.asarray(db)
+    n, dim = db_h.shape
+    wrows = np.ascontiguousarray(db_h[:write_rows])
+    wal_root = wal_dir or tempfile.mkdtemp(prefix="raft-tpu-bench-ingest-")
+
+    def mk_ingest():
+        # max_memtable_rows == capacity: admission sheds before a regrow
+        # could change the merge kernel's shapes mid-measurement; tombs
+        # sized to match (every first-seen upserted id costs one
+        # tombstone masking its potential main-index copy)
+        return serving.IngestServer(
+            res,
+            serving.IngestConfig(wal_dir=os.path.join(wal_root, "wal"),
+                                 memtable_capacity=memtable_capacity,
+                                 tomb_capacity=memtable_capacity,
+                                 max_memtable_rows=memtable_capacity),
+            dim=dim)
+
+    out = []
+    state = {"acked": [], "shed": 0, "errors": 0}
+    next_id = [n]
+
+    def write_batch(srv):
+        nid = next_id[0]
+        ids = np.arange(nid, nid + write_rows, dtype=np.int64)
+        next_id[0] = nid + write_rows
+        try:
+            srv.write(ids, wrows)
+        except serving.Overloaded:
+            state["shed"] += 1
+            return False
+        except Exception:  # noqa: BLE001 - bench keeps writing
+            state["errors"] += 1
+            return False
+        state["acked"].append(nid)
+        return True
+
+    with obs.collecting():
+        ex = serving.Executor(res, "ivf_pq", index, ks=(k,),
+                              max_batch=max_batch, search_params=sp)
+        cfg = serving.ServerConfig(max_batch=max_batch,
+                                   max_wait_us=max_wait_us,
+                                   max_queue_rows=max_batch * 16)
+        srv = serving.Server(ex, cfg)
+        ig = mk_ingest()
+        ig.recover(base_index=index)
+        srv.attach_ingest(ig)
+        srv.start()
+        compiles = obs.registry().counter("xla.compiles")
+        try:
+            # warm EVERY bucket through the delta merge (one write so
+            # the memtable view is live) — the dynamic batcher
+            # coalesces concurrent clients into intermediate buckets —
+            # then fence the compile count
+            write_batch(srv)
+            for m in serving.bucket_sizes(max_batch):
+                srv.search(q[:m], k)
+            c0 = compiles.value
+
+            def closed_loop(dur, lats=None):
+                done = [0] * clients
+                stop_at = time.perf_counter() + dur
+
+                def client(j):
+                    base = (j * 131) % max(1, q.shape[0] - request_rows)
+                    sub = q[base:base + request_rows]
+                    while time.perf_counter() < stop_at:
+                        t0 = time.perf_counter()
+                        srv.search(sub, k)
+                        if lats is not None:
+                            lats.append(time.perf_counter() - t0)
+                        done[j] += sub.shape[0]
+
+                ts = [threading.Thread(target=client, args=(j,))
+                      for j in range(clients)]
+                t0 = time.perf_counter()
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                return sum(done) / (time.perf_counter() - t0)
+
+            # ---- phase 1: no-writer read baseline --------------------
+            baseline_qps = closed_loop(duration_s)
+
+            # ---- calibrate the closed-loop write peak ----------------
+            stop_at = time.perf_counter() + calib_s
+            t0 = time.perf_counter()
+            calib_batches = 0
+            while time.perf_counter() < stop_at:
+                write_batch(srv)
+                calib_batches += 1
+            write_peak = (calib_batches * write_rows
+                          / (time.perf_counter() - t0))
+
+            # ---- phase 2: open-loop writer at 2x, concurrent reads ---
+            acked0, shed0 = len(state["acked"]), state["shed"]
+            stop_writer = threading.Event()
+
+            def writer():
+                base = write_rate_rows_per_s or write_peak
+                rate = max(write_multiplier * base, write_rows)
+                interval = write_rows / rate
+                next_t = time.perf_counter()
+                while not stop_writer.is_set():
+                    lag = next_t - time.perf_counter()
+                    if lag > 0:
+                        time.sleep(lag)
+                    write_batch(srv)
+                    next_t += interval
+
+            lats = []
+            wt = threading.Thread(target=writer, daemon=True)
+            t_phase = time.perf_counter()
+            wt.start()
+            concurrent_qps = closed_loop(duration_s, lats)
+            stop_writer.set()
+            wt.join(timeout=30.0)
+            elapsed = time.perf_counter() - t_phase
+            recompiles_steady = int(compiles.value - c0)
+            acked_rows = (len(state["acked"]) - acked0) * write_rows
+            offered_rows = ((len(state["acked"]) - acked0
+                             + state["shed"] - shed0) * write_rows)
+            h = obs.registry().histogram("serving.ingest.visibility")
+            vis_p50_ms = round(h.quantile(0.5) * 1e3, 3)
+            vis_p99_ms = round(h.quantile(0.99) * 1e3, 3)
+            ig_stats = ig.stats()
+        finally:
+            srv.stop()
+
+        # ---- phase 3: kill-and-recover (no fold ran: every acked ----
+        # row must come back out of the WAL replay)
+        acked_ids = set(state["acked"])
+        ig.close()              # the "kill": nothing folded, no flush
+        ig2 = mk_ingest()
+        t0 = time.perf_counter()
+        ig2.recover(base_index=index)
+        recovery_s = time.perf_counter() - t0
+        live_ids, _, _ = ig2.memtable.fold_payload()
+        recovered = {int(i) for i in live_ids}
+        lost = sorted(a for a in acked_ids if a not in recovered)
+        ig2.close()
+    if wal_dir is None:
+        shutil.rmtree(wal_root, ignore_errors=True)
+
+    frac = concurrent_qps / max(baseline_qps, 1e-9)
+    p50, p95, p99 = ((float(v) * 1e3
+                      for v in np.percentile(lats, [50, 95, 99]))
+                     if lats else (0.0, 0.0, 0.0))
+    out.append({
+        "metric": "ingest_writes_per_s",
+        "value": round(acked_rows / elapsed, 1),
+        "unit": "rows/s",
+        "vs_baseline": 1.0,
+        "detail": {"write_rows": write_rows,
+                   "write_peak_rows_per_s": round(write_peak, 1),
+                   "write_multiplier": write_multiplier,
+                   "offered_rows_per_s": round(offered_rows / elapsed, 1),
+                   "shed_batches": state["shed"],
+                   "writer_errors": state["errors"],
+                   "visibility_p50_ms": vis_p50_ms,
+                   "visibility_p99_ms": vis_p99_ms,
+                   "wal_bytes_final": ig_stats["wal_bytes"],
+                   "memtable_rows_final": ig_stats["memtable_rows"]},
+    })
+    out.append({
+        "metric": "ingest_qps_concurrent",
+        "value": round(concurrent_qps, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(frac, 3),
+        "detail": {"baseline_qps_no_writer": round(baseline_qps, 1),
+                   "fraction_of_baseline": round(frac, 3),
+                   "recompiles_steady": recompiles_steady,
+                   "read_p50_ms": round(p50, 3),
+                   "read_p95_ms": round(p95, 3),
+                   "read_p99_ms": round(p99, 3),
+                   "clients": clients, "request_rows": request_rows,
+                   "max_batch": max_batch},
+    })
+    out.append({
+        "metric": "ingest_recovery",
+        "value": round(recovery_s, 3),
+        "unit": "s",
+        "vs_baseline": 1.0,
+        "detail": {"acked_batches": len(acked_ids),
+                   "acked_rows": len(acked_ids) * write_rows,
+                   "recovered_rows": len(recovered),
+                   "lost_batches": len(lost),
+                   "zero_acked_loss": not lost},
+    })
+    return out
+
+
+def run_ingest(conf_path: str) -> int:
+    """``--ingest`` mode: the CI durability smoke.  Builds the conf's
+    dataset, runs :func:`bench_ingest` (open-loop writer at 2x the
+    calibrated write peak concurrent with closed-loop reads, then
+    kill-and-recover), and FAILS (exit 1) on concurrent-read QPS below
+    the bar, ANY acked-write loss after recovery, steady-state
+    recompiles, or a missing WAL-replay event trail."""
+    from raft_tpu import DeviceResources
+    from raft_tpu.observability import flight as _flight
+
+    with open(conf_path) as f:
+        conf = json.load(f)
+    res = DeviceResources(seed=0)
+    db, queries = _make_dataset(conf["dataset"])
+    s = conf["serving"]
+    g = conf.get("ingest", {})
+    _flight.clear()
+    lines = bench_ingest(
+        res, db, queries,
+        build_param=s.get("build_param"),
+        search_param=s.get("search_param"),
+        k=s.get("k", SERVING_K),
+        max_batch=s.get("max_batch", SERVING_MAX_BATCH),
+        max_wait_us=s.get("max_wait_us", 1000.0),
+        clients=s.get("clients", 8),
+        request_rows=g.get("request_rows", 32),
+        duration_s=g.get("duration_s", 2.0),
+        write_rows=g.get("write_rows", INGEST_WRITE_ROWS),
+        write_multiplier=g.get("write_multiplier", 2.0),
+        write_rate_rows_per_s=g.get("write_rate_rows_per_s"),
+        memtable_capacity=g.get("memtable_capacity", 1 << 16),
+        calib_s=g.get("calib_s", 0.5))
+    for line in lines:
+        _emit(line)
+    by = {ln["metric"]: ln for ln in lines}
+    failures = []
+    bar = g.get("min_qps_fraction_of_baseline", 0.8)
+    qps = by["ingest_qps_concurrent"]
+    if qps["vs_baseline"] < bar:
+        failures.append(
+            f"concurrent-read QPS {qps['vs_baseline']:.2f}x the "
+            f"no-writer baseline under open-loop writer load "
+            f"(bar: {bar:.2f}x)")
+    if qps["detail"]["recompiles_steady"] != 0:
+        failures.append(
+            f"{qps['detail']['recompiles_steady']} XLA recompiles "
+            "during the write->search steady state (the pre-sized "
+            "memtable merge must be shape-static)")
+    rec = by["ingest_recovery"]
+    if not rec["detail"]["zero_acked_loss"]:
+        failures.append(
+            f"ACKED WRITE LOSS: {rec['detail']['lost_batches']} acked "
+            f"batches missing after WAL replay "
+            f"({rec['detail']['acked_rows']} rows acked, "
+            f"{rec['detail']['recovered_rows']} recovered)")
+    if by["ingest_writes_per_s"]["detail"]["writer_errors"]:
+        failures.append(
+            f"{by['ingest_writes_per_s']['detail']['writer_errors']} "
+            "non-Overloaded writer errors (backpressure must be the "
+            "only shed path)")
+    if not _flight.events("serving.ingest.replay"):
+        failures.append("no serving.ingest.replay events landed in the "
+                        "flight recorder — recovery never replayed the "
+                        "WAL")
+    for msg in failures:
+        print(f"INGEST SMOKE FAIL: {msg}", flush=True)
+    if failures:
+        dumped = _flight.maybe_auto_dump("ingest_smoke_failure")
+        if dumped:
+            print(f"flight dump: {dumped}", flush=True)
+    return 1 if failures else 0
+
+
 MUTATION_CHURN = 0.01          # writer deletes AND extends 1% per cycle
 
 
@@ -1679,12 +2031,16 @@ def main() -> None:
     # the same serving stack under 1% delete + 1% extend mutation churn
     for line in bench_mutation(res, db[:SERVING_N], queries[:2048]):
         _emit(line)
+    # WAL-backed streaming ingest: open-loop writer at 2x the write
+    # peak concurrent with reads, then kill-and-recover (zero acked
+    # loss); the CI smoke runs the conf/ingest-smoke.json variant
+    for line in bench_ingest(res, db[:SERVING_N], queries[:2048]):
+        _emit(line)
     _emit({"integrity_counters": _integrity_counters()})
 
 
 if __name__ == "__main__":
-    import sys
-
+    _check_bench_out_writable()
     try:
         if len(sys.argv) >= 3 and sys.argv[1] == "--conf":
             _setup_jax_cache()
@@ -1701,6 +2057,12 @@ if __name__ == "__main__":
                 os.path.join(os.path.dirname(__file__), "conf",
                              "overload-smoke.json")
             sys.exit(run_overload(conf))
+        elif len(sys.argv) >= 2 and sys.argv[1] == "--ingest":
+            _setup_jax_cache()
+            conf = sys.argv[2] if len(sys.argv) >= 3 else \
+                os.path.join(os.path.dirname(__file__), "conf",
+                             "ingest-smoke.json")
+            sys.exit(run_ingest(conf))
         else:
             main()
     finally:

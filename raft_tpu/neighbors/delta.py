@@ -1,0 +1,352 @@
+"""The delta tier: an always-mutable memtable absorbing streaming writes.
+
+Every batch index in :mod:`raft_tpu.neighbors` mutates by *snapshot* —
+``extend`` / ``delete`` / ``upsert`` return a NEW index one generation
+up, and the serving tier re-warms an executable table per generation.
+That is the right contract for batch updates and exactly the wrong one
+for sustained write traffic: a generation bump per write puts an AOT
+re-warm on the write path.  This module is the LSM answer (ROADMAP
+item 2): a small, flat, **always-mutable** :class:`Memtable` that
+absorbs upserts/deletes at host-memory speed and is searched alongside
+the main index by treating the delta as one more "shard" in the PR 8
+``finalize_topk`` k-bounded merge.
+
+Design invariants:
+
+- **Single deterministic mutation path.**  :meth:`Memtable.apply` is
+  the only way state changes — live writes and WAL replay
+  (:mod:`raft_tpu.serving.ingest`) drive the same code, so recovery is
+  bit-identical by construction, not by testing luck.  Records carry a
+  log sequence number; ``apply`` is idempotent under replay
+  (``lsn <= applied_lsn`` is a no-op), which makes duplicated replay
+  after a torn-tail repair safe.
+- **Shape-static device snapshot.**  The scan arrays are fixed at
+  ``capacity`` rows (empty slots carry id -1 and ride the existing
+  id<0 mask seam), so steady-state searches with the delta attached
+  never recompile.  Filling past capacity doubles it under a
+  generation bump — ONE expected recompile per regrow, never one per
+  write (the PR 10 shape-static discipline, asserted via
+  ``xla.compiles`` in the serving tests).
+- **Tombstones mask the main index through the id<0 seam.**  A delete
+  (and the delete-half of an upsert whose id may live in the main
+  index) records the id in the tombstone set; :func:`merge_with_main`
+  rewrites matching main-index hits to worst-distance / id -1 — the
+  same sentinel convention every scan kernel already honors — before
+  the shared :func:`~raft_tpu.neighbors.grouped.finalize_topk`
+  epilogue selects the public top-k.
+- **Upserts never double-tombstone.**  An id already live in the
+  memtable overwrites its slot in place; the main-index tombstone was
+  recorded once at first sight and is NOT re-emitted — rapid same-id
+  churn costs one slot and one tombstone total (the upsert double-work
+  fix; see ``tests/test_ingest.py`` churn regression).
+
+Folding the memtable into the main index (the compaction half of the
+LSM) lives in :mod:`raft_tpu.serving.ingest`; this module only exposes
+the deterministic state and :meth:`Memtable.fold_payload`.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import threading
+from typing import Optional, Tuple
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.error import expects
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.matrix.select_k import select_k
+from raft_tpu.neighbors import grouped
+
+#: WAL / memtable record opcodes (stable wire values — see ingest.py)
+OP_UPSERT = 1
+OP_DELETE = 2
+
+_SQRT_METRICS = (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded)
+_SUPPORTED_METRICS = (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
+                      DistanceType.L2Unexpanded,
+                      DistanceType.L2SqrtUnexpanded,
+                      DistanceType.InnerProduct)
+
+
+@dataclasses.dataclass(frozen=True)
+class Record:
+    """One logged write: ``lsn`` orders and deduplicates replay, ``op``
+    is :data:`OP_UPSERT` / :data:`OP_DELETE`, ``ids`` the source ids and
+    ``vectors`` the (n, dim) float32 rows (upserts only)."""
+
+    lsn: int
+    op: int
+    ids: np.ndarray
+    vectors: Optional[np.ndarray] = None
+
+
+def _scan_body(data, ids, queries, k: int, metric):
+    """Brute-force delta scan producing PUBLIC-form distances (sqrt
+    applied for the sqrt-L2 metrics) so they merge against the main
+    index's output without rescaling.  Empty slots (id -1) ride the
+    worst-distance sentinel, the same convention every kernel's
+    tombstone mask uses."""
+    nq = queries.shape[0]
+    cap = data.shape[0]
+    f32q = queries.astype(jnp.float32)
+    if metric == DistanceType.InnerProduct:
+        d = f32q @ data.T
+        worst = -jnp.inf
+        select_min = False
+    else:
+        qq = jnp.sum(f32q * f32q, axis=1, keepdims=True)
+        xx = jnp.sum(data * data, axis=1)[None, :]
+        d = jnp.maximum(qq + xx - 2.0 * (f32q @ data.T), 0.0)
+        worst = jnp.inf
+        select_min = True
+    d = jnp.where(ids[None, :] < 0, worst, d)
+    bids = jnp.broadcast_to(ids[None, :], (nq, cap))
+    kf = min(k, cap)
+    best_d, best_i = select_k(d, kf, in_idx=bids, select_min=select_min)
+    if kf < k:
+        best_d = jnp.pad(best_d, ((0, 0), (0, k - kf)),
+                         constant_values=worst)
+        best_i = jnp.pad(best_i, ((0, 0), (0, k - kf)),
+                         constant_values=-1)
+    best_i = jnp.maximum(best_i, -1)
+    if metric in _SQRT_METRICS:
+        best_d = jnp.where(jnp.isfinite(best_d),
+                           jnp.sqrt(jnp.maximum(best_d, 0.0)), best_d)
+    return best_d, best_i
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def _delta_scan(data, ids, queries, k: int, metric):
+    return _scan_body(data, ids, queries, k, metric)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def _merge_with_main(main_d, main_i, queries, data, ids, tombs,
+                     k: int, metric):
+    """Delta-as-extra-shard merge: scan the memtable, mask tombstoned
+    main-index hits to the worst/-1 sentinel (the id<0 seam), then run
+    the shared :func:`grouped.finalize_topk` epilogue over the
+    concatenated (nq, 2k) candidates — exactly the PR 8 k-bounded
+    routed-shard merge shape with the delta as one more shard."""
+    nq = main_d.shape[0]
+    select_min = metric != DistanceType.InnerProduct
+    worst = jnp.inf if select_min else -jnp.inf
+    dd, di = _scan_body(data, ids, queries, k, metric)
+    hit = (main_i >= 0) & jnp.isin(main_i, tombs)
+    md = jnp.where(hit, worst, main_d)
+    mi = jnp.where(hit, -1, main_i)
+    alld = jnp.concatenate([md, dd], axis=1)
+    alli = jnp.concatenate([mi, di], axis=1)
+    # main_d is already public-form (sqrt applied by the main search)
+    # and _scan_body matches it, so the epilogue must not re-sqrt
+    return grouped.finalize_topk(alld, alli, nq, k, select_min,
+                                 sqrt=False, select_k_fn=select_k)
+
+
+def merge_with_main(main_d, main_i, queries, data, ids, tombs, *,
+                    k: int, metric) -> Tuple[jax.Array, jax.Array]:
+    """Public wrapper over the jitted merge (static ``k`` / ``metric``)."""
+    return _merge_with_main(main_d, main_i, queries, data, ids, tombs,
+                            k=int(k), metric=DistanceType(metric))
+
+
+class Memtable:
+    """Host-canonical mutable row store with a shape-static device view.
+
+    The canonical state is host numpy (``apply`` is a few row writes —
+    no device round trip on the write path); the device snapshot used by
+    searches is refreshed lazily and swapped as one tuple, so a
+    concurrent search sees either the old or the new snapshot wholesale.
+    ``capacity`` (rows) and ``tomb_capacity`` (tombstone slots) are the
+    compiled shapes; filling either doubles it under a generation bump.
+    """
+
+    def __init__(self, dim: int, *, capacity: int = 1024,
+                 tomb_capacity: int = 1024,
+                 metric=DistanceType.L2Expanded) -> None:
+        expects(dim > 0, "delta: dim must be positive")
+        expects(capacity > 0 and tomb_capacity > 0,
+                "delta: capacity and tomb_capacity must be positive")
+        self.dim = int(dim)
+        self.metric = DistanceType(metric)
+        expects(self.metric in _SUPPORTED_METRICS,
+                f"delta: unsupported memtable metric {self.metric!r}")
+        self.capacity = int(capacity)
+        self.tomb_capacity = int(tomb_capacity)
+        self.generation = 0
+        self.applied_lsn = 0
+        self._data = np.zeros((self.capacity, self.dim), np.float32)
+        self._ids = np.full(self.capacity, -1, np.int32)
+        self._slot_lsn = np.zeros(self.capacity, np.int64)
+        self._slot_of: dict = {}      # id -> slot
+        self._tombs: dict = {}        # id -> lsn (masks the main index)
+        self._n_used = 0              # append high-water mark
+        self._lock = threading.Lock()
+        self._dirty = True
+        self._dev: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None
+
+    # ---- introspection --------------------------------------------------
+
+    @property
+    def live_rows(self) -> int:
+        return len(self._slot_of)
+
+    @property
+    def n_tombstones(self) -> int:
+        return len(self._tombs)
+
+    @property
+    def select_min(self) -> bool:
+        return self.metric != DistanceType.InnerProduct
+
+    def digest(self) -> str:
+        """SHA-256 over the canonical state — the bit-identical-recovery
+        assertion surface (kill-matrix tests compare digests across
+        independent replays)."""
+        with self._lock:
+            h = hashlib.sha256()
+            h.update(np.int64([self.capacity, self.tomb_capacity,
+                               self._n_used, self.applied_lsn]).tobytes())
+            h.update(self._data.tobytes())
+            h.update(self._ids.tobytes())
+            h.update(self._slot_lsn.tobytes())
+            for i, lsn in sorted(self._tombs.items()):
+                h.update(np.int64([i, lsn]).tobytes())
+            return h.hexdigest()
+
+    # ---- the one mutation path ------------------------------------------
+
+    def apply(self, rec: Record) -> bool:
+        """Apply one record; returns False for an already-applied lsn
+        (duplicate-replay idempotence).  Both the live write path and
+        WAL replay come through here — determinism by construction."""
+        with self._lock:
+            if rec.lsn <= self.applied_lsn:
+                return False
+            ids = np.asarray(rec.ids, np.int64).reshape(-1)
+            if rec.op == OP_UPSERT:
+                vecs = np.asarray(rec.vectors, np.float32)
+                expects(vecs.ndim == 2 and vecs.shape == (ids.size, self.dim),
+                        f"delta: upsert vectors must be ({ids.size}, "
+                        f"{self.dim}), got {vecs.shape}")
+                for j, raw in enumerate(ids):
+                    i = int(raw)
+                    expects(i >= 0, "delta: source ids must be >= 0")
+                    slot = self._slot_of.get(i)
+                    if slot is None:
+                        if self._n_used >= self.capacity:
+                            self._grow_rows()
+                        slot = self._n_used
+                        self._n_used += 1
+                        self._slot_of[i] = slot
+                        self._ids[slot] = i
+                        # first sight since the last fold: ONE tombstone
+                        # masks any published main-index copy.  An id
+                        # already live here was tombstoned then — an
+                        # overwrite must not emit a second (the upsert
+                        # double-work fix).
+                        if i not in self._tombs:
+                            if len(self._tombs) >= self.tomb_capacity:
+                                self._grow_tombs()
+                            self._tombs[i] = int(rec.lsn)
+                    self._data[slot] = vecs[j]
+                    self._slot_lsn[slot] = rec.lsn
+            elif rec.op == OP_DELETE:
+                for raw in ids:
+                    i = int(raw)
+                    slot = self._slot_of.pop(i, None)
+                    if slot is not None:
+                        self._ids[slot] = -1
+                        self._data[slot] = 0.0
+                        self._slot_lsn[slot] = rec.lsn
+                    if i not in self._tombs:
+                        if len(self._tombs) >= self.tomb_capacity:
+                            self._grow_tombs()
+                    self._tombs[i] = int(rec.lsn)
+            else:
+                raise ValueError(f"delta: unknown record op {rec.op!r}")
+            self.applied_lsn = int(rec.lsn)
+            self._dirty = True
+            return True
+
+    def _grow_rows(self) -> None:
+        """Double the row capacity.  A new compiled shape — ONE expected
+        recompile at the next search, bumped as a generation so caches
+        and tests can attribute it."""
+        new_cap = self.capacity * 2
+        data = np.zeros((new_cap, self.dim), np.float32)
+        ids = np.full(new_cap, -1, np.int32)
+        lsn = np.zeros(new_cap, np.int64)
+        data[:self.capacity] = self._data
+        ids[:self.capacity] = self._ids
+        lsn[:self.capacity] = self._slot_lsn
+        self._data, self._ids, self._slot_lsn = data, ids, lsn
+        self.capacity = new_cap
+        self.generation += 1
+        self._dirty = True
+
+    def _grow_tombs(self) -> None:
+        self.tomb_capacity *= 2
+        self.generation += 1
+        self._dirty = True
+
+    def reset(self) -> None:
+        """Drop all rows and tombstones (post-fold): shapes are kept, so
+        the next search is still a cache hit; ``applied_lsn`` resets with
+        the truncated WAL."""
+        with self._lock:
+            self._data[:] = 0.0
+            self._ids[:] = -1
+            self._slot_lsn[:] = 0
+            self._slot_of.clear()
+            self._tombs.clear()
+            self._n_used = 0
+            self.applied_lsn = 0
+            self.generation += 1
+            self._dirty = True
+
+    # ---- search side ----------------------------------------------------
+
+    def device_view(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """The shape-static ``(data, ids, tombs)`` snapshot searches
+        consume.  Refreshed only when dirty; published as one tuple so a
+        concurrent reader never sees data from one write and ids from
+        another."""
+        with self._lock:
+            if self._dirty or self._dev is None:
+                tombs = np.full(self.tomb_capacity, -1, np.int32)
+                if self._tombs:
+                    t = np.fromiter(self._tombs.keys(), np.int32,
+                                    len(self._tombs))
+                    tombs[:t.size] = t
+                self._dev = (jnp.asarray(self._data),
+                             jnp.asarray(self._ids),
+                             jnp.asarray(tombs))
+                self._dirty = False
+            return self._dev
+
+    def search(self, queries, k: int) -> Tuple[jax.Array, jax.Array]:
+        """Standalone delta search (tests / debugging — serving merges
+        via :func:`merge_with_main` instead)."""
+        data, ids, _ = self.device_view()
+        return _delta_scan(data, ids, jnp.asarray(queries), k=int(k),
+                           metric=self.metric)
+
+    # ---- fold side -------------------------------------------------------
+
+    def fold_payload(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Deterministic fold inputs: ``(live_ids, live_rows, tomb_ids)``
+        in slot order / sorted id order — what the ingest fold feeds the
+        main index's ``delete`` + ``extend`` under one generation bump."""
+        with self._lock:
+            live = np.nonzero(self._ids[:self._n_used] >= 0)[0]
+            live_ids = self._ids[live].astype(np.int32)
+            live_rows = self._data[live].astype(np.float32)
+            tomb_ids = np.array(sorted(self._tombs), np.int32)
+            return live_ids, live_rows, tomb_ids

@@ -34,7 +34,15 @@ RAFT-class deployment interposes between users and the GPU/TPU):
   + tombstone compaction, checkpointed stages
   (``resilience.CheckpointManager``), every swap-in gated behind
   ``integrity.verify`` + the recall canary, atomic generation swaps
-  through ``Server.swap_index``.
+  through ``Server.swap_index``;
+- :mod:`~raft_tpu.serving.ingest` — the durable write path:
+  ``Server.write()`` appends to a CRC-framed write-ahead log (fsync
+  group commit) before acknowledging, applies to the always-mutable
+  :class:`~raft_tpu.neighbors.delta.Memtable` searched alongside the
+  main index (the delta-as-extra-shard ``finalize_topk`` merge), and
+  periodically folds the memtable into the main index as a
+  checkpointed, gated compaction; ``recover()`` replays the WAL to
+  bit-identical state after a kill at any boundary.
 
 Quick tour::
 
@@ -70,6 +78,11 @@ from raft_tpu.serving.executor import (  # noqa: F401
     DistributedExecutor,
     Executor,
 )
+from raft_tpu.serving.ingest import (  # noqa: F401
+    IngestConfig,
+    IngestServer,
+    WriteAheadLog,
+)
 from raft_tpu.serving.rebalancer import (  # noqa: F401
     RebalanceConfig,
     Rebalancer,
@@ -86,6 +99,8 @@ __all__ = [
     "DistributedExecutor",
     "DynamicBatcher",
     "Executor",
+    "IngestConfig",
+    "IngestServer",
     "Overloaded",
     "Rung",
     "QuotaExceeded",
@@ -96,6 +111,7 @@ __all__ = [
     "Server",
     "ServerConfig",
     "TokenBucket",
+    "WriteAheadLog",
     "bucket_for",
     "bucket_sizes",
     "pad_rows",

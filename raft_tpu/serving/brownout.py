@@ -71,12 +71,17 @@ class Rung:
     ``kt`` reduced, refinement off) compiled as its own executable rung;
     ``None`` inherits the previous rung's executables — the idiom for a
     shed-only top rung.  ``shed_best_effort`` turns on admission-time
-    shedding of the best-effort tenant set while this rung is active.
+    shedding of the best-effort tenant set while this rung is active;
+    ``shed_best_effort_writes`` does the same for the WRITE path — the
+    ingest tier (:mod:`raft_tpu.serving.ingest`) sheds best-effort
+    tenants' writes with ``BrownedOut`` while the rung holds, keeping
+    fold pressure off an already-degraded read path.
     """
 
     name: str
     params: Optional[object] = None
     shed_best_effort: bool = False
+    shed_best_effort_writes: bool = False
 
 
 class BrownoutState:
@@ -85,12 +90,14 @@ class BrownoutState:
     attribute stores/loads (GIL-atomic) — admission and the batcher read
     it lock-free on every request/cut."""
 
-    __slots__ = ("level", "rung", "shed_best_effort", "best_effort_tenants")
+    __slots__ = ("level", "rung", "shed_best_effort",
+                 "shed_best_effort_writes", "best_effort_tenants")
 
     def __init__(self, best_effort_tenants: Iterable[str] = ()) -> None:
         self.level = 0
         self.rung = 0
         self.shed_best_effort = False
+        self.shed_best_effort_writes = False
         self.best_effort_tenants: FrozenSet[str] = frozenset(
             best_effort_tenants)
 
@@ -150,7 +157,8 @@ class BrownoutController:
         expects(len(ladder) >= 2,
                 "brownout: a ladder needs at least a full-quality rung "
                 "and one degraded rung")
-        expects(ladder[0].params is None and not ladder[0].shed_best_effort,
+        expects(ladder[0].params is None and not ladder[0].shed_best_effort
+                and not ladder[0].shed_best_effort_writes,
                 "brownout: rung 0 must be the undegraded operating point "
                 "(params=None, no shedding)")
         self.server = server
@@ -247,6 +255,7 @@ class BrownoutController:
         rung = self.ladder[new_level]
         self.state.rung = self._exec_rung[new_level]
         self.state.shed_best_effort = rung.shed_best_effort
+        self.state.shed_best_effort_writes = rung.shed_best_effort_writes
         self.state.level = new_level
         if obs.enabled():
             obs.registry().gauge("serving.brownout.level").set(new_level)

@@ -49,6 +49,7 @@ from raft_tpu.core.error import expects
 from raft_tpu.observability import flight as _flight
 from raft_tpu.distance.types import DistanceType
 from raft_tpu.integrity import boundary as _boundary
+from raft_tpu.neighbors import delta as _delta
 from raft_tpu.serving.buckets import bucket_sizes, pad_rows, valid_rows_mask
 
 _KINDS = ("ivf_pq", "ivf_flat", "cagra", "brute_force")
@@ -88,6 +89,7 @@ class Executor:
         self.warm = warm
         self.buckets = bucket_sizes(self.max_batch)
         self._fns: Dict[Tuple[int, int, int], Callable] = {}
+        self._delta = None
         self._warmed = False
 
     @property
@@ -105,6 +107,21 @@ class Executor:
                 "zero-recompile contract — declare the ladder before "
                 "Server.start()")
         self._rung_params = (self.params, *ladder)
+
+    def attach_delta(self, view: Callable) -> None:
+        """Attach the streaming-ingest delta tier: ``view`` is a
+        zero-arg callable (``Memtable.device_view``) returning the
+        shape-static ``(data, ids, tombs)`` snapshot.  Every
+        :meth:`search_bucket` then merges the memtable as one more
+        "shard" through the k-bounded ``finalize_topk`` epilogue, with
+        tombstones masking main-index hits through the id<0 seam.  Must
+        happen before :meth:`warmup` — the merge joins every warmed
+        bucket shape, keeping steady state compile-free."""
+        expects(not self._warmed,
+                "serving: attach_delta after warmup would break the "
+                "zero-recompile contract — attach the ingest tier before "
+                "Server.start()")
+        self._delta = view
 
     # ---- geometry -------------------------------------------------------
 
@@ -297,6 +314,13 @@ class Executor:
         if fn is None:
             fn = self._obtain(bucket, k, rung)
         d, i = fn(queries)
+        delta = self._delta
+        if delta is not None:
+            data, ids, tombs = delta()
+            d, i = _delta.merge_with_main(
+                d, i, queries, data, ids, tombs, k=k,
+                metric=getattr(self.index, "metric",
+                               DistanceType.L2Expanded))
         if n_valid < bucket:
             d, i = _boundary.mask_search_outputs(
                 d, i, valid_rows_mask(n_valid, bucket),

@@ -102,7 +102,7 @@ class Rebalancer:
     def __init__(self, res, index, *,
                  config: Optional[RebalanceConfig] = None,
                  checkpoint: Optional[Union[str, CheckpointManager]] = None,
-                 server=None) -> None:
+                 server=None, ingest=None) -> None:
         expects(isinstance(index, (ivf_flat.Index, ivf_pq.Index)),
                 "rebalancer: only IVF-Flat / IVF-PQ indexes rebalance "
                 "(CAGRA's delete shim requires a rebuild to reclaim rows)")
@@ -110,6 +110,12 @@ class Rebalancer:
         self.config = config or RebalanceConfig()
         self.checkpoint = as_manager(checkpoint)
         self.server = server
+        # streaming-ingest compaction hook: each background pass first
+        # offers the ingest tier a fold (its own checkpointed, gated
+        # stage — see serving/ingest.py); a published fold moves this
+        # rebalancer's base forward so a later pass never swaps a
+        # pre-fold generation back in
+        self.ingest = ingest
         self.last_good = index
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -163,6 +169,21 @@ class Rebalancer:
         self._save_stage("compact", work)
 
         return self._gate_and_swap(work)
+
+    # ---- streaming-ingest compaction hook -------------------------------
+
+    def maybe_fold_ingest(self):
+        """Offer the attached ingest tier a threshold-triggered memtable
+        fold (the LSM compaction stage); a published fold becomes this
+        rebalancer's new base.  No-op without an ingest tier.  Returns
+        the folded index or None."""
+        if self.ingest is None:
+            return None
+        folded = self.ingest.maybe_fold()
+        if folded is not None:
+            with self._lock:
+                self.last_good = folded
+        return folded
 
     # ---- crash recovery -------------------------------------------------
 
@@ -349,6 +370,7 @@ class Rebalancer:
         def loop():
             while not self._stop.wait(self.config.interval_s):
                 try:
+                    self.maybe_fold_ingest()
                     self.run_once()
                 except Exception:  # noqa: BLE001 - keep last_good serving
                     self._stats["errors"] += 1

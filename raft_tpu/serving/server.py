@@ -90,6 +90,7 @@ class Server:
                                       brownout=self.brownout,
                                       on_error=self._on_batch_error)
         self._started = False
+        self.ingest = None          # durable write path (attach_ingest)
         # generation watchdog state: the last-known-good index retained
         # by swap_index, and the strike timestamps within the window
         self._last_good = None
@@ -118,6 +119,31 @@ class Server:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+    def attach_ingest(self, ingest) -> "Server":
+        """Attach a durable write path (:class:`serving.IngestServer`)
+        BEFORE :meth:`start`: binding joins the memtable's device view to
+        the executor's delta-merge seam (part of every warmed shape) and
+        routes fold publications through :meth:`swap_index`.  Run
+        ``ingest.recover(...)`` first — writes refuse until recovery has
+        replayed the WAL."""
+        expects(not self._started,
+                "serving: attach_ingest after start would break the "
+                "zero-recompile contract — attach before Server.start()")
+        self.ingest = ingest
+        ingest.bind(self)
+        return self
+
+    def write(self, ids, vectors=None, *, op: str = "upsert",
+              tenant: str = "default") -> int:
+        """Durably ingest one upsert/delete batch; returns the record's
+        LSN once it is fsync-durable AND searchable (see
+        :meth:`serving.IngestServer.write` for the ack contract and the
+        :class:`Overloaded` shed taxonomy)."""
+        expects(self.ingest is not None,
+                "serving: no ingest tier attached — Server.write needs "
+                "attach_ingest before start()")
+        return self.ingest.write(ids, vectors, op=op, tenant=tenant)
 
     def swap_index(self, new_index) -> int:
         """Swap the executor onto a new index generation while serving.

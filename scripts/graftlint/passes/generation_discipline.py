@@ -26,6 +26,15 @@ Two rules:
   string, e.g. via ``getattr``) — every executable-cache key carries
   the generation, and routed paths additionally carry the placement
   generation.
+- ``generation-discipline`` (fold publishing, PR 13): a serving-layer
+  function with ``fold`` in its name — the LSM compaction folding the
+  streaming-ingest memtable into the main index — that derives a new
+  index (``delete`` / ``extend`` / ``upsert`` / ``compact`` /
+  ``replace`` / an ``*Index`` constructor) must publish it through
+  ``swap_index`` or a generation bump, and must NEVER assign to a
+  published index's array leaves (``list_data``, ``centers``, …) in
+  place: in-flight readers pinned on the old generation would observe
+  the mutation mid-scan.
 """
 
 from __future__ import annotations
@@ -44,6 +53,19 @@ from scripts.graftlint.core import (
 _SCOPE = ("raft_tpu/neighbors/", "raft_tpu/serving/",
           "raft_tpu/distributed/")
 _PARENT_PARAMS = {"index", "parent"}
+
+#: the array leaves of the index dataclasses — a fold writing any of
+#: these on an existing object is mutating a (potentially published)
+#: generation in place instead of building a candidate and swapping
+_INDEX_LEAF_ATTRS = {
+    "list_data", "list_indices", "list_sizes", "list_data_sq",
+    "centers", "codebooks", "list_codes", "list_recon", "rotation",
+    "dataset", "graph",
+}
+
+#: calls that DERIVE a new index from an existing one (snapshot
+#: mutations) — a fold touching these owes a publish
+_DERIVING_CALLS = {"delete", "extend", "upsert", "compact"}
 
 
 def _constructs_index(fn: ast.AST):
@@ -76,6 +98,25 @@ def _handles_generation(fn: ast.AST) -> bool:
             for t in targets:
                 if isinstance(t, ast.Attribute) and t.attr == "generation":
                     return True
+    return False
+
+
+def _derives_index(fn: ast.AST):
+    """First Call node applying a snapshot mutation (delete/extend/...)
+    — evidence the function produces a new index generation."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            callee = terminal_name(node.func)
+            if callee in _DERIVING_CALLS:
+                return node
+    return None
+
+
+def _calls_name(fn: ast.AST, name: str) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            if terminal_name(node.func) == name:
+                return True
     return False
 
 
@@ -148,4 +189,39 @@ class GenerationDisciplinePass:
                         f"cache key in {node.name} does not include the "
                         f"index generation — a recycled id() can pair a "
                         f"stale executable with a newer generation"))
+        # fold-publishing rule: serving-layer folds (the streaming-ingest
+        # memtable compaction) publish candidates, never mutate in place
+        for mod in project.walk("raft_tpu/serving/"):
+            for fn, stack in walk_functions(mod.tree):
+                if "fold" not in fn.name.lower():
+                    continue
+                for node in ast.walk(fn):
+                    if not isinstance(node, (ast.Assign, ast.AugAssign)):
+                        continue
+                    targets = (node.targets if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for t in targets:
+                        if (isinstance(t, ast.Attribute)
+                                and t.attr in _INDEX_LEAF_ATTRS):
+                            out.append(Diagnostic(
+                                mod.rel, node.lineno,
+                                "generation-discipline",
+                                f"'{fn.name}' writes index leaf "
+                                f"'.{t.attr}' in place — a fold must "
+                                f"build a candidate and publish via "
+                                f"swap_index; in-flight readers pinned "
+                                f"on the old generation would observe "
+                                f"the mutation mid-scan"))
+                deriver = _constructs_index(fn) or _derives_index(fn)
+                if deriver is None:
+                    continue
+                if (_handles_generation(fn)
+                        or _calls_name(fn, "swap_index")):
+                    continue
+                out.append(Diagnostic(
+                    mod.rel, deriver.lineno, "generation-discipline",
+                    f"'{fn.name}' folds into a new index without "
+                    f"publishing it — route the candidate through "
+                    f"swap_index (or bump .generation) so warmed "
+                    f"executables never alias a stale generation"))
         return out

@@ -116,6 +116,44 @@ class TestGenerationDiscipline:
                "        return self._entries.get(key)\n")
         assert lint({"raft_tpu/serving/x.py": src}) == []
 
+    # -- fold publishing (PR 13: streaming-ingest memtable compaction) --
+
+    def test_fold_mutating_index_leaf_in_place_flagged(self):
+        src = ("def fold(self, base, rows):\n"
+               "    base.list_data = rows\n"
+               "    return base\n")
+        diags = lint({"raft_tpu/serving/x.py": src})
+        assert "generation-discipline" in rules_of(diags)
+        assert any("in place" in d.message for d in diags)
+
+    def test_fold_without_publish_flagged(self):
+        src = ("def fold(self, base, rows, ids):\n"
+               "    cand = extend(self.res, base, rows, ids)\n"
+               "    return cand\n")
+        diags = lint({"raft_tpu/serving/x.py": src})
+        assert [d.rule for d in diags] == ["generation-discipline"]
+        assert "swap_index" in diags[0].message
+
+    def test_fold_via_swap_index_clean(self):
+        src = ("def fold(self, base, rows, ids):\n"
+               "    cand = extend(self.res, base, rows, ids)\n"
+               "    self.server.swap_index(cand)\n"
+               "    return cand\n")
+        assert lint({"raft_tpu/serving/x.py": src}) == []
+
+    def test_fold_via_generation_bump_clean(self):
+        src = ("def fold(self, base, rows, ids):\n"
+               "    cand = extend(self.res, base, rows, ids)\n"
+               "    cand.generation = base.generation + 1\n"
+               "    return cand\n")
+        assert lint({"raft_tpu/serving/x.py": src}) == []
+
+    def test_fold_rule_scoped_to_serving(self):
+        # build-time layers fold freely (e.g. kmeans folds)
+        src = ("def fold_batches(self, base, rows, ids):\n"
+               "    return extend(self.res, base, rows, ids)\n")
+        assert lint({"raft_tpu/ops/x.py": src}) == []
+
 
 # ---------------------------------------------------------------------------
 # mask-seam
@@ -475,6 +513,21 @@ class TestLiveTree:
         assert reg.resolves_metric("comms.allreduce.calls")
         assert not reg.resolves_metric("serving.admited")
         assert "integrity.health_check" in d["stages"]
+        # the streaming-ingest surface (PR 13): counters, the
+        # visibility histogram, fault sites, and flight events all
+        # registered from their literal call sites
+        for name in ("serving.ingest.appended", "serving.ingest.acked",
+                     "serving.ingest.replayed", "serving.ingest.folds",
+                     "serving.ingest.truncations"):
+            assert name in d["counters"], name
+        assert "serving.ingest.visibility" in d["histograms"]
+        for site in ("ingest.append", "ingest.fsync", "ingest.apply",
+                     "ingest.fold", "ingest.truncate"):
+            assert site in d["fault_sites"], site
+        assert "serving.ingest.fold" in d["events"]
+        assert "serving.ingest.replay" in d["events"]
+        assert "serving.ingest.backpressure" in d["events"]
+        assert "serving.ingest.fold" in d["stages"]
         # trace spans (serving.request registers through the
         # start_request parameter default) and flight anomaly events
         assert "serving.request" in d["spans"]
