@@ -121,11 +121,29 @@ OPERATING_POINTS = (
     dict(n_probes=72, refine_ratio=2, scan_mode="recon8"),
     dict(n_probes=72, refine_ratio=2, scan_mode="recon8", per_probe_topk=4),
     # round-7 fused in-kernel top-k: scan + extraction in ONE stage,
-    # candidate distance matrices never reach HBM
+    # candidate distance matrices never reach HBM (since round 14 these
+    # resolve merge_window="auto" — the windowed merge engine)
     dict(n_probes=72, refine_ratio=2, scan_mode="fused"),
     dict(n_probes=72, refine_ratio=2, scan_mode="fused", per_probe_topk=4),
     dict(n_probes=96, refine_ratio=2, scan_mode="fused", per_probe_topk=4),
+    # round-14 A/B anchor: the same point pinned to the per-step merge
+    # (W=1, the round-7 behavior) — auto minus this is the windowed gain
+    dict(n_probes=72, refine_ratio=2, scan_mode="fused", per_probe_topk=4,
+         merge_window=1),
 )
+
+# Round-14 windowed fused-scan grid: (k, merge_window) at batch 1024 and
+# matched kt=16 — large k exceeds the fused VMEM budget at the flagship
+# batch, so the large-k serving bucket's batch is the operating shape.
+# merge_window 0 = "auto" (largest W the budget admits); k=128 carries an
+# explicit W=2 beside auto to expose the window axis itself, and k=128/256
+# have NO W=1 point because the per-step merge gates at k <= 64 — exactly
+# the gate the windowed engine lifts.
+FUSED_WINDOWED_GRID = (
+    (10, 1), (10, 0), (64, 1), (64, 0), (128, 2), (128, 0), (256, 0),
+)
+FUSED_WINDOWED_BATCH = 1024
+FUSED_WINDOWED_KT = 16
 MIN_RECALL = 0.95
 # SIFT-like synthetic data: descriptors have low intrinsic dimensionality
 # (~16) embedded in 128-d; uniform random 128-d is adversarial to PQ (all
@@ -236,6 +254,45 @@ def _search_stage_probe(res, index, queries) -> dict:
     return out
 
 
+def _fused_windowed_grid(res, index, queries) -> list:
+    """Round-14 grid: the windowed fused-scan merge engine across
+    (k, merge_window) at batch :data:`FUSED_WINDOWED_BATCH` and matched
+    kt.  Results are bit-identical across W (the merge is
+    order-insensitive over the finite-sentinel staging ring) — only QPS
+    moves, so the grid reports QPS plus the fused_fallback tick delta
+    that proves the fused kernel actually served the point (large k is
+    exactly where the old per-step merge used to fall back)."""
+    from raft_tpu import observability as obs
+    from raft_tpu.neighbors import ivf_pq
+
+    q = queries[:FUSED_WINDOWED_BATCH]
+    points = []
+    for k, mw in FUSED_WINDOWED_GRID:
+        sp = ivf_pq.SearchParams(n_probes=72, scan_mode="fused",
+                                 per_probe_topk=FUSED_WINDOWED_KT,
+                                 merge_window=mw or "auto")
+        with obs.collecting() as reg:
+            before = reg.snapshot()["counters"].get(
+                "ivf_pq.search.fused_fallback", 0)
+            d, i = ivf_pq.search(res, sp, index, q, k)       # warm
+            np.asarray(i)
+            after = reg.snapshot()["counters"].get(
+                "ivf_pq.search.fused_fallback", 0)
+        _check_sane("ivf_pq_fused_windowed", i, N_DB, d)
+        t0 = time.perf_counter()
+        for _ in range(RUNS):
+            _, i = ivf_pq.search(res, sp, index, q, k)
+        np.asarray(i)
+        qps = q.shape[0] / ((time.perf_counter() - t0) / RUNS)
+        point = {"k": k, "merge_window": mw or "auto",
+                 "batch": int(q.shape[0]), "kt": FUSED_WINDOWED_KT,
+                 "qps": round(qps, 1),
+                 "fused_fallback_ticks": after - before}
+        _emit({"fused_windowed_point": point})
+        points.append(point)
+    return points
+
+
 def bench_ivf_pq(res, db, queries, gt_i=None) -> dict:
     from raft_tpu.neighbors import ivf_pq
 
@@ -255,6 +312,7 @@ def bench_ivf_pq(res, db, queries, gt_i=None) -> dict:
     _print_stage_breakdown("ivf_pq", index)
     stage_probe = _search_stage_probe(res, index, queries)
     _emit({"search_stage_probe": stage_probe})
+    windowed_points = _fused_windowed_grid(res, index, queries)
 
     from raft_tpu.neighbors.refine import refine as refine_fn
 
@@ -267,7 +325,8 @@ def bench_ivf_pq(res, db, queries, gt_i=None) -> dict:
             n_probes=n_probes,
             scan_mode=pt.get("scan_mode", "auto"),
             per_probe_topk=pt.get("per_probe_topk", 0),
-            packed_extract=pt.get("packed_extract", False))
+            packed_extract=pt.get("packed_extract", False),
+            merge_window=pt.get("merge_window", "auto"))
         kk = K * refine_ratio
 
         def query():
@@ -318,6 +377,7 @@ def bench_ivf_pq(res, db, queries, gt_i=None) -> dict:
                    "scan_bytes_per_row": grouped.scan_traffic(
                        index.rot_dim, index.pq_dim, index.pq_bits),
                    "search_stage_probe": stage_probe,
+                   "fused_windowed_grid": windowed_points,
                    "operating_point": chosen},
     }
 
@@ -528,7 +588,8 @@ SERVING_K = 10
 def bench_serving(res, db, queries, *, build_param=None, search_param=None,
                   k=SERVING_K, max_batch=SERVING_MAX_BATCH,
                   max_wait_us=1000.0, clients=8, request_rows=32,
-                  duration_s=2.0, offered_fraction=0.7) -> list:
+                  duration_s=2.0, offered_fraction=0.7,
+                  large_k=None) -> list:
     """Online serving over a warmed IVF-PQ index vs the raw batch path.
 
     Closed loop (``clients`` synchronous threads, ``request_rows`` rows
@@ -546,7 +607,11 @@ def bench_serving(res, db, queries, *, build_param=None, search_param=None,
     recorder.  The ``xla.compiles`` counter is sampled around the whole
     measured window — steady state must be recompile-free *with tracing
     enabled* (the closed bucket-shape contract; CI fails the smoke job
-    otherwise).
+    otherwise).  When the conf declares a ``large_k`` bucket, that k is
+    added to the executor's closed k set and replayed inside the
+    measured window: the AOT cache key carries ``merge_window`` for
+    fused large-k plans, and the zero-recompile assertion must hold
+    across that dimension too.
     """
     import threading
 
@@ -587,7 +652,8 @@ def bench_serving(res, db, queries, *, build_param=None, search_param=None,
         np.asarray(i)
     raw_qps = iters * max_batch / (time.perf_counter() - t0)
 
-    ex = serving.Executor(res, "ivf_pq", index, ks=(k,),
+    ks = (k,) if not large_k else (k, int(large_k))
+    ex = serving.Executor(res, "ivf_pq", index, ks=ks,
                           max_batch=max_batch, search_params=sp)
     out = []
     with obs.collecting():
@@ -599,6 +665,8 @@ def bench_serving(res, db, queries, *, build_param=None, search_param=None,
             # mask ops) before the measured window
             for m in (1, request_rows, max_batch):
                 srv.search(q[:m], k)
+            if large_k:
+                srv.search(q[:request_rows], int(large_k))
             c0 = obs.registry().counter("xla.compiles").value
 
             # ---- closed loop: tracing off, then tracing on ----------
@@ -625,6 +693,12 @@ def bench_serving(res, db, queries, *, build_param=None, search_param=None,
             serving_qps = closed_loop()
             with _trace.tracing_scope():
                 traced_qps = closed_loop()
+            # large-k bucket replay inside the measured window: its AOT
+            # plan (keyed on merge_window for fused scans) was warmed at
+            # start(), so these must hit the cache without a compile
+            if large_k:
+                for _ in range(4):
+                    srv.search(q[:request_rows], int(large_k))
             # sampled AFTER the traced arm: tracing must add zero
             # compiles on warmed traffic, not just zero in its own arm
             recompiles = (obs.registry().counter("xla.compiles").value
@@ -678,6 +752,7 @@ def bench_serving(res, db, queries, *, build_param=None, search_param=None,
                    "recompiles_steady": int(recompiles),
                    "clients": clients, "request_rows": request_rows,
                    "max_batch": max_batch, "max_wait_us": max_wait_us,
+                   "large_k": int(large_k) if large_k else None,
                    "batch_fill_p50": fill.get("p50")},
     })
     frac = traced_qps / max(serving_qps, 1e-9)
@@ -737,7 +812,8 @@ def run_serving(conf_path: str) -> int:
         clients=s.get("clients", 8),
         request_rows=s.get("request_rows", 32),
         duration_s=s.get("duration_s", 2.0),
-        offered_fraction=s.get("offered_fraction", 0.7))
+        offered_fraction=s.get("offered_fraction", 0.7),
+        large_k=s.get("large_k"))
     for line in lines:
         _emit(line)
     qps_line = lines[0]["detail"]

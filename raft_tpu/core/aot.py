@@ -251,7 +251,8 @@ def executables() -> ExecutableCache:
 
 def export_ivf_pq_search(res, index, n_probes: int, k: int, batch: int,
                          *, scan_mode: str = "recon",
-                         group_capacity: int = 0) -> io.BytesIO:
+                         group_capacity: int = 0,
+                         merge_window=0) -> io.BytesIO:
     """Export the flagship IVF-PQ search at fixed (batch, k, n_probes)
     into a self-contained artifact (reference analogue: serialized index
     + the prebuilt search instantiation).
@@ -278,8 +279,20 @@ def export_ivf_pq_search(res, index, n_probes: int, k: int, batch: int,
       kernel, so an artifact warmed under either mode answers
       identically while carrying its own distinct
       :class:`ExecutableCache` key component.
+
+    ``merge_window`` ("auto" | int, see
+    :data:`raft_tpu.neighbors.ivf_pq.SearchParams.merge_window`) windows
+    the baked grouped scan's staged scatter (the XLA twin of the fused
+    kernels' staging ring) and keys the artifact in
+    :class:`ExecutableCache` — serving pre-warms one executable per
+    (bucket, k, merge_window) point, so the live Pallas dispatch and the
+    exported twin share a cache dimension.  Ignored by the non-grouped
+    exports, where there is no staged scatter to window.
     """
     from raft_tpu.neighbors import grouped, ivf_pq
+    from raft_tpu.ops import vmem_budget as vb
+
+    merge_window = vb.merge_window_request(merge_window)
 
     expects(scan_mode in ("recon", "codes", "lut", "fused"),
             "aot: scan_mode must be 'recon', 'codes', 'lut' or 'fused'")
@@ -310,7 +323,7 @@ def export_ivf_pq_search(res, index, n_probes: int, k: int, batch: int,
                 return ivf_pq._search_impl_recon_grouped(
                     centers, list_recon, list_recon_sq, list_indices,
                     rotation, queries, probes, k, metric, n_groups,
-                    block)
+                    block, merge_window=merge_window)
         else:
             def fn(centers, list_recon, list_recon_sq, list_indices,
                    rotation, queries):
@@ -351,7 +364,8 @@ def export_ivf_pq_search(res, index, n_probes: int, k: int, batch: int,
 def export_ivf_pq_routed_search(res, index, shard: int, n_probes: int,
                                 k: int, batch: int, *,
                                 scan_mode: str = "recon",
-                                group_capacity: int = 0) -> io.BytesIO:
+                                group_capacity: int = 0,
+                                merge_window=0) -> io.BytesIO:
     """Export ONE shard's routed (``placement="by_list"``) search
     program at fixed (batch, k, n_probes): replicated coarse routing +
     ownership mask + the shard-local scan over the owned lists +
@@ -374,8 +388,15 @@ def export_ivf_pq_routed_search(res, index, shard: int, n_probes: int,
 
     ``shard_map`` itself is not exportable — this bakes the shard's
     leaves plus the replicated routing arrays (coarse centers, rotation,
-    owner, local_slot) into a single-device program instead."""
+    owner, local_slot) into a single-device program instead.
+
+    ``merge_window`` windows the fused export's staged scatter exactly
+    as in :func:`export_ivf_pq_search` (and keys the artifact the same
+    way)."""
     from raft_tpu.neighbors import grouped, ivf_pq
+    from raft_tpu.ops import vmem_budget as vb
+
+    merge_window = vb.merge_window_request(merge_window)
 
     expects(getattr(index, "placement", None) is not None,
             "aot: export_ivf_pq_routed_search needs a RoutedIndex "
@@ -411,7 +432,7 @@ def export_ivf_pq_routed_search(res, index, shard: int, n_probes: int,
             return ivf_pq._search_impl_recon_grouped(
                 local_centers, list_recon, list_recon_sq, list_indices,
                 rotation, queries, local_probes, k, metric, n_groups,
-                block)
+                block, merge_window=merge_window)
     else:
         def fn(coarse, rotation, owner, local_slot, local_centers,
                list_recon, list_recon_sq, list_indices, queries):

@@ -71,6 +71,7 @@ from raft_tpu.neighbors import ivf_pq
 from raft_tpu.neighbors import mutate as _mutate
 from raft_tpu.observability import flight as _flight
 from raft_tpu.observability import trace as _rtrace
+from raft_tpu.ops import vmem_budget as vb
 from raft_tpu.resilience import faults
 from raft_tpu.resilience import retry as _retry
 
@@ -139,6 +140,10 @@ class _ScanResolution:
     exact: bool = True
     kt: int = 0
     use_pallas: bool = False
+    # fused merge window W (ops.vmem_budget), resolved host-statically
+    # alongside the form so the jitted dispatch carries it as a static
+    # argument; 0 for the non-fused forms
+    merge_window: int = 0
 
 
 def _note_lowered(mode: str) -> None:
@@ -153,15 +158,21 @@ def _note_lowered(mode: str) -> None:
                          requested=mode)
 
 
-def _note_fused_fallback() -> None:
+def _note_fused_fallback(reason: str = "backend") -> None:
     """Fused requested but the Pallas kernel gates failed: the XLA
     grouped twin runs instead (same ladder as single-index; NOT a
-    distributed lowering, so the status vector stays SHARD_OK)."""
+    distributed lowering, so the status vector stays SHARD_OK).
+    ``reason`` carries the same codes as the single-index path
+    (ivf_pq._search_checked.note_fused_fallback): kernel reject codes
+    ("dtype" / "k-too-large" / "bucket-too-wide" / "itopk-gate") or
+    "backend" for off-TPU / non-f32-id misses."""
     from raft_tpu import observability as obs
     if obs.enabled():
         obs.registry().counter("ivf_pq.search.fused_fallback").inc()
+        obs.registry().counter(
+            f"ivf_pq.search.fused_fallback.reason.{reason}").inc()
     rec = _rtrace.current()
-    _flight.record_event("ivf_pq.fused_fallback",
+    _flight.record_event("ivf_pq.fused_fallback", reason=reason,
                          trace_id=rec.trace_id if rec else None)
 
 
@@ -196,6 +207,8 @@ def _resolve_scan_mode(params, index, nq: int, n_probes: int,
         n_groups, exact = grouped.group_capacity(
             nq, n_probes, slots, est=getattr(index, "group_est", 0.0))
         metric_l2 = index.metric in ivf_pq._L2_METRICS
+        mw_req = vb.merge_window_request(
+            getattr(params, "merge_window", "auto"))
         if on_tpu:
             from raft_tpu.ops import pq_code_scan_pallas as pcs
             from raft_tpu.ops import pq_group_scan_pallas as pqp
@@ -206,23 +219,33 @@ def _resolve_scan_mode(params, index, nq: int, n_probes: int,
                     and ids_ok and metric_l2
                     and pcs.supported_fused_codes(
                         True, True, cap, rot, kt, k, nq,
-                        index.codebooks.shape[0], index.pq_bits)):
+                        index.codebooks.shape[0], index.pq_bits,
+                        merge_window=mw_req)):
                 # the 72 B/row headline: per-shard fused code scan
-                return _ScanResolution("fused_codes", lowered=False,
-                                       n_groups=n_groups, exact=exact,
-                                       kt=kt)
+                return _ScanResolution(
+                    "fused_codes", lowered=False, n_groups=n_groups,
+                    exact=exact, kt=kt,
+                    merge_window=pcs.fused_codes_merge_window(
+                        cap, rot, kt, k, nq, index.codebooks.shape[0],
+                        index.pq_bits, requested=mw_req))
             if ids_ok and pqp.supported_fused(metric_l2, cap, rot, kt,
-                                              k, nq):
-                return _ScanResolution("fused_recon", lowered=False,
-                                       n_groups=n_groups, exact=exact,
-                                       kt=kt)
+                                              k, nq,
+                                              merge_window=mw_req):
+                return _ScanResolution(
+                    "fused_recon", lowered=False, n_groups=n_groups,
+                    exact=exact, kt=kt,
+                    merge_window=pqp.fused_merge_window(
+                        cap, rot, kt, k, nq, requested=mw_req))
             if mode == "fused":
-                _note_fused_fallback()
+                _note_fused_fallback(
+                    (pqp.fused_reject_reason(metric_l2, cap, rot, kt, k,
+                                             nq, merge_window=mw_req)
+                     or "bucket-too-wide") if ids_ok else "backend")
             return _ScanResolution("grouped_recon", lowered=False,
                                    n_groups=n_groups, exact=exact, kt=kt,
                                    use_pallas=ids_ok)
         if mode == "fused":
-            _note_fused_fallback()
+            _note_fused_fallback("backend")
         return _ScanResolution("grouped_recon", lowered=False,
                                n_groups=n_groups, exact=exact, kt=kt)
 
@@ -250,19 +273,27 @@ def _resolve_scan_mode(params, index, nq: int, n_probes: int,
     if not want_fused:
         return _ScanResolution("probe_recon", lowered=False)
     n_groups, _ = grouped.group_capacity(nq, n_probes, n_lists_local)
+    mw_req = vb.merge_window_request(
+        getattr(params, "merge_window", "auto"))
     if on_tpu:
         from raft_tpu.ops import pq_group_scan_pallas as pqp
         metric_l2 = index.metric in ivf_pq._L2_METRICS
         ids_ok = grouped.ids_f32_exact(index, index.list_indices)
-        if ids_ok and pqp.supported_fused(metric_l2, cap, rot, kt, k, nq):
-            return _ScanResolution("fused_recon", lowered=False,
-                                   n_groups=n_groups, kt=kt)
+        if ids_ok and pqp.supported_fused(metric_l2, cap, rot, kt, k, nq,
+                                          merge_window=mw_req):
+            return _ScanResolution(
+                "fused_recon", lowered=False, n_groups=n_groups, kt=kt,
+                merge_window=pqp.fused_merge_window(cap, rot, kt, k, nq,
+                                                    requested=mw_req))
         if mode == "fused":
-            _note_fused_fallback()
+            _note_fused_fallback(
+                (pqp.fused_reject_reason(metric_l2, cap, rot, kt, k, nq,
+                                         merge_window=mw_req)
+                 or "bucket-too-wide") if ids_ok else "backend")
         return _ScanResolution("grouped_recon", lowered=False,
                                n_groups=n_groups, kt=kt, use_pallas=ids_ok)
     if mode == "fused":
-        _note_fused_fallback()
+        _note_fused_fallback("backend")
     return _ScanResolution("grouped_recon", lowered=False,
                            n_groups=n_groups, kt=kt)
 
@@ -586,10 +617,10 @@ def _merge_gathered(ld, li, q, k, metric, axis_name, failed):
 
 @functools.partial(jax.jit, static_argnames=(
     "k", "kt", "n_probes", "metric", "axis_name", "mesh", "n_groups",
-    "form", "use_pallas", "failed"))
+    "form", "use_pallas", "merge_window", "failed"))
 def _dist_search_grouped(index_leaves, queries, k, kt, n_probes, metric,
                          axis_name, mesh, n_groups, form,
-                         use_pallas=False, failed=()):
+                         use_pallas=False, merge_window=1, failed=()):
     """Data-parallel grouped/fused scan under ``shard_map`` (round 10):
     every shard runs the SAME formulation ladder the single-index search
     picks, at the worst-case static group capacity — the capacity is a
@@ -610,7 +641,7 @@ def _dist_search_grouped(index_leaves, queries, k, kt, n_probes, metric,
             ld, li = ivf_pq._search_impl_fused_recon_grouped(
                 centers[0], list_recon[0], list_recon_sq[0],
                 list_indices[0], rotation[0], q, probes, k, kt, metric,
-                n_groups)
+                n_groups, merge_window=merge_window)
         else:
             G = grouped.GROUP
             block = grouped.block_size(n_groups, G * cap * 8,
@@ -777,7 +808,8 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
                         sharded, replicated, queries, k, r.kt, n_probes,
                         index.metric, comms.axis_name, handle.mesh, ng,
                         r.form, pq_bits=int(index.pq_bits),
-                        use_pallas=r.use_pallas, failed=failed)
+                        use_pallas=r.use_pallas,
+                        merge_window=r.merge_window, failed=failed)
 
                 d, i, scanned, needed = _entry(
                     "distributed.ann.search",
@@ -831,7 +863,8 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
                 lambda: _dist_search_grouped(
                     leaves, queries, k, r.kt, n_probes, index.metric,
                     comms.axis_name, handle.mesh, r.n_groups, r.form,
-                    use_pallas=r.use_pallas, failed=failed),
+                    use_pallas=r.use_pallas,
+                    merge_window=r.merge_window, failed=failed),
                 retry_policy, deadline)
         if rec is not None and scanned is not None:
             # lazy attachment: `scanned` is a device array; annotate()
@@ -1299,11 +1332,12 @@ def _routed_leaves(index: "RoutedIndex", form: str):
 
 @functools.partial(jax.jit, static_argnames=(
     "k", "kt", "n_probes", "metric", "axis_name", "mesh", "n_groups",
-    "form", "pq_bits", "use_pallas", "failed"))
+    "form", "pq_bits", "use_pallas", "merge_window", "failed"))
 def _dist_search_routed_grouped(sharded, replicated, queries, k, kt,
                                 n_probes, metric, axis_name, mesh,
                                 n_groups, form, pq_bits=0,
-                                use_pallas=False, failed=()):
+                                use_pallas=False, merge_window=1,
+                                failed=()):
     """Routed (by_list) grouped/fused scan under ``shard_map``
     (round 10): the tentpole dispatch.  Replicated coarse routing picks
     the probe set, ownership maps it to local slots, and the shard scans
@@ -1343,11 +1377,12 @@ def _dist_search_routed_grouped(sharded, replicated, queries, k, kt,
             ld, li = ivf_pq._search_impl_fused_codes_grouped(
                 local_centers[0], rl[4], data[0], rownorm[0],
                 list_indices[0], rot, q, local_probes, k, kt, metric,
-                n_groups, pq_bits)
+                n_groups, pq_bits, merge_window=merge_window)
         elif form == "fused_recon":
             ld, li = ivf_pq._search_impl_fused_recon_grouped(
                 local_centers[0], data[0], rownorm[0], list_indices[0],
-                rot, q, local_probes, k, kt, metric, n_groups)
+                rot, q, local_probes, k, kt, metric, n_groups,
+                merge_window=merge_window)
         else:
             rot_dim = data.shape[3]
             G = grouped.GROUP

@@ -140,6 +140,15 @@ class SearchParams:
     walk_pdim: Optional[int] = None
     entry_points: int = 4096
     rerank_topk: int = 0
+    # Fused-hop merge engine ("auto" | int, parsed by
+    # ops.vmem_budget.merge_window_request like ivf_pq's knob): the hop
+    # kernel cannot defer merges ACROSS hops (parent selection consumes
+    # the merged buffer every hop), so >1 selects the staged WITHIN-hop
+    # merge — candidates are extracted into a sorted staging block and
+    # merged by one bitonic pass, lifting the itopk gate from 32 to 64.
+    # "auto" keeps the legacy in-pass merge where it is allowed and
+    # stages only for itopk > 32; 1 forces legacy.
+    merge_window: object = "auto"
 
 
 @jax.tree_util.register_pytree_node_class
@@ -1693,11 +1702,12 @@ def _select_parents(buf_d, buf_i, visited, search_width):
 
 @functools.partial(jax.jit, static_argnames=(
     "k", "itopk", "search_width", "max_iterations", "metric", "rerank",
-    "deg", "quant", "fused_hop", "pallas_interpret"))
+    "deg", "quant", "fused_hop", "merge_window", "pallas_interpret"))
 def _search_impl_walk(dataset, table, entry_proj, entry_sq, entry_ids,
                       proj, queries, k, itopk, search_width,
                       max_iterations, metric, rerank, deg, quant=False,
-                      scales=None, fused_hop=False, pallas_interpret=False):
+                      scales=None, fused_hop=False, merge_window=0,
+                      pallas_interpret=False):
     """Greedy walk over the packed neighborhood table.
 
     Walk distances are approximate (exact ||x||², PCA-projected bf16
@@ -1780,7 +1790,7 @@ def _search_impl_walk(dataset, table, entry_proj, entry_sq, entry_ids,
                 qp_t, q_sq, nb_p.reshape(nq, wd, pdim),
                 nb_sq.reshape(nq, wd), nb_id.reshape(nq, wd),
                 buf_d, buf_i, visited, itopk=itopk, ip_metric=ip_metric,
-                interpret=pallas_interpret)
+                interpret=pallas_interpret, merge_window=merge_window)
             return buf_d, buf_i, visited, it + 1
 
         ipx = jnp.einsum("qp,qwdp->qwd", qp_t, nb_p,
@@ -1955,11 +1965,19 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
             # into one Pallas kernel (serving buckets of 1-64; ids must
             # be f32-exact for the in-kernel id lanes)
             from raft_tpu.ops import cagra_hop_pallas as chp
+            from raft_tpu.ops import vmem_budget as vb
             wd = params.search_width * index.graph_degree
+            mw_req = vb.merge_window_request(
+                getattr(params, "merge_window", "auto"))
+            # the window doubles as the variant selector: 1 = legacy
+            # in-pass merge (itopk <= 32), 2 = staged bitonic merge
+            # (itopk <= 64); 0 = shape unsupported -> XLA hop
+            mw = chp.hop_merge_window(queries.shape[0], itopk, wd,
+                                      min(pdim, index.dim),
+                                      requested=mw_req)
             fused = (jax.default_backend() == "tpu"
                      and index.size < (1 << 24)
-                     and chp.supported_hop(queries.shape[0], itopk, wd,
-                                           min(pdim, index.dim)))
+                     and mw > 0)
             stage = ("cagra.search.fused_walk" if fused
                      else "cagra.search.walk")
             with obs.stage(stage) as st:
@@ -1968,7 +1986,8 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
                     cache.entry_sq, cache.entry_ids, cache.proj, queries,
                     k, itopk, params.search_width, max_iter, index.metric,
                     rerank, index.graph_degree, quant=cache.quant,
-                    scales=cache.scales, fused_hop=fused)
+                    scales=cache.scales, fused_hop=fused,
+                    merge_window=mw if fused else 0)
                 st.fence(out)
             return _mask_deleted(index, *out)
 

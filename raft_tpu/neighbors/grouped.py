@@ -338,7 +338,7 @@ def block_size(n_groups: int, *per_group_bytes: int,
 
 
 def scan_and_scatter(group_list, slot_pairs, P, cap, k, select_min, block,
-                     select_k_fn, distance_block, kt=0):
+                     select_k_fn, distance_block, kt=0, merge_window=0):
     """Shared scan driver: for each block of groups, compute distances via
     ``distance_block(gl, slot) -> ((B, GROUP, cap) masked distances,
     (B, cap) candidate ids)`` and take each pair-row's local top-kt.
@@ -351,7 +351,17 @@ def scan_and_scatter(group_list, slot_pairs, P, cap, k, select_min, block,
     ``take_along_axis`` does without materializing a (B, GROUP, cap) id
     tensor.  Sentinel slots scatter out of bounds and are dropped; the
     clamped tail block emits duplicate pairs with identical values, so the
-    final scatter stays idempotent."""
+    final scatter stays idempotent.
+
+    ``merge_window`` is the XLA twin of the fused kernels' staging ring
+    (ops.vmem_budget): 0 stages every block's outputs before the single
+    scatter (the round-7 shape, maximal staging footprint); W >= 1
+    scatters once per W-block window inside an outer scan, bounding the
+    staged (n_blocks * B * GROUP, kt) output pair to W blocks at the
+    cost of one (P, kt) carry copy per window instead of none.  Exact
+    either way — each pair-row is written with the same value no matter
+    which window carries it (overlap only at the clamped tail block,
+    which emits duplicates with identical values)."""
     n_groups = group_list.shape[0]
     worst = jnp.inf if select_min else -jnp.inf
     # kt (SearchParams.per_probe_topk) narrows the per-pair keep-set below
@@ -378,10 +388,32 @@ def scan_and_scatter(group_list, slot_pairs, P, cap, k, select_min, block,
                                  pos.reshape(block, GROUP, kt), axis=2)
         return None, (td, ti.reshape(block * GROUP, kt), slot.reshape(-1))
 
-    _, (tds, tis, flats) = jax.lax.scan(step, None, block_starts)
-    flat = flats.reshape(-1)
     outd = jnp.full((P, kt), worst, jnp.float32)
     outi = jnp.full((P, kt), -1, jnp.int32)
+
+    if 0 < merge_window < n_blocks:
+        W = merge_window
+        n_windows = -(-n_blocks // W)
+        # pad by repeating the last start: duplicate blocks re-write
+        # identical values, same idempotence as the clamped tail
+        pad = n_windows * W - n_blocks
+        starts = jnp.concatenate(
+            [block_starts, jnp.broadcast_to(block_starts[-1:], (pad,))])
+
+        def window(carry, wstarts):
+            od, oi = carry
+            _, (tds, tis, flats) = jax.lax.scan(step, None, wstarts)
+            flat = flats.reshape(-1)
+            od = od.at[flat].set(tds.reshape(-1, kt), mode="drop")
+            oi = oi.at[flat].set(tis.reshape(-1, kt), mode="drop")
+            return (od, oi), None
+
+        (outd, outi), _ = jax.lax.scan(window, (outd, outi),
+                                       starts.reshape(n_windows, W))
+        return outd, outi
+
+    _, (tds, tis, flats) = jax.lax.scan(step, None, block_starts)
+    flat = flats.reshape(-1)
     outd = outd.at[flat].set(tds.reshape(-1, kt), mode="drop")
     outi = outi.at[flat].set(tis.reshape(-1, kt), mode="drop")
     return outd, outi

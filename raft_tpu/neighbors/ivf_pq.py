@@ -159,6 +159,15 @@ class SearchParams:
     # cost of truncating ~log2(capacity) distance mantissa bits (~2^-13
     # relative at bench shapes; ordering-only effect, far below PQ noise).
     packed_extract: bool = False
+    # Fused-scan merge window W: the fused kernels stage each grid
+    # step's kt candidates into a VMEM ring and pay the full top-k merge
+    # only every W-th step (~W x fewer merge passes; bit-identical
+    # results — the merge is order-insensitive over the finite-sentinel
+    # ring).  "auto" (or 0) picks the largest W the kernel's VMEM budget
+    # admits via ops.vmem_budget; an explicit int >= 1 is honored as an
+    # upper bound (1 = the round-7 per-step merge).  Also selects the
+    # staged CAGRA-hop merge — see cagra.SearchParams.merge_window.
+    merge_window: object = "auto"
 
 
 @jax.tree_util.register_pytree_node_class
@@ -1126,11 +1135,13 @@ def _select_clusters(centers, rotation, queries, n_probes, metric,
 
 @functools.partial(jax.jit, static_argnames=("k", "metric", "n_groups",
                                              "block", "use_pallas",
-                                             "pallas_interpret", "kt"))
+                                             "pallas_interpret", "kt",
+                                             "merge_window"))
 def _search_impl_recon_grouped(centers, list_recon, list_recon_sq,
                                list_indices, rotation, queries, probes, k,
                                metric, n_groups, block, use_pallas=False,
-                               pallas_interpret=False, kt=0):
+                               pallas_interpret=False, kt=0,
+                               merge_window=0):
     """List-centric recon scan over fixed-size pair groups.
 
     See :mod:`raft_tpu.neighbors.grouped` for the design (and the measured
@@ -1200,7 +1211,7 @@ def _search_impl_recon_grouped(centers, list_recon, list_recon_sq,
 
     outd, outi = grouped.scan_and_scatter(
         group_list, slot_pairs, P, cap, k, not ip_metric, block,
-        select_k, distance_block, kt=kt)
+        select_k, distance_block, kt=kt, merge_window=merge_window)
     return grouped.finalize_topk(
         outd, outi, nq, k, not ip_metric,
         metric in (DistanceType.L2SqrtExpanded,
@@ -1336,11 +1347,12 @@ def _fused_epilogue(vals, ids, qorder, nq, k, metric):
 
 
 @functools.partial(jax.jit, static_argnames=("k", "kt", "metric", "n_groups",
-                                             "pq_bits", "pallas_interpret"))
+                                             "pq_bits", "merge_window",
+                                             "pallas_interpret"))
 def _search_impl_fused_codes_grouped(centers, codebooks, list_code_lanes,
                                      list_code_rsq, list_indices, rotation,
                                      queries, probes, k, kt, metric,
-                                     n_groups, pq_bits,
+                                     n_groups, pq_bits, merge_window=1,
                                      pallas_interpret=False):
     """Fused compact-code scan: the grouped code scan with the per-query
     top-k folded INTO the kernel (pq_code_scan_pallas
@@ -1365,15 +1377,17 @@ def _search_impl_fused_codes_grouped(centers, codebooks, list_code_lanes,
     vals, ids = pcs.grouped_code_scan_fused(
         group_list, slot_pairs, qrot[qorder], cf, list_code_lanes,
         codebooks, list_code_rsq, list_indices, kt, k, n_probes, pq_bits,
-        interpret=pallas_interpret)
+        interpret=pallas_interpret, merge_window=merge_window)
     return _fused_epilogue(vals, ids, qorder, nq, k, metric)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "kt", "metric", "n_groups",
+                                             "merge_window",
                                              "pallas_interpret"))
 def _search_impl_fused_recon_grouped(centers, list_recon, list_recon_sq,
                                      list_indices, rotation, queries,
                                      probes, k, kt, metric, n_groups,
+                                     merge_window=1,
                                      pallas_interpret=False):
     """Fused recon scan: :func:`_search_impl_recon_grouped`'s Pallas
     path with the per-query top-k folded into the kernel
@@ -1394,7 +1408,7 @@ def _search_impl_fused_recon_grouped(centers, list_recon, list_recon_sq,
     vals, ids = pqp.grouped_l2_scan_fused(
         group_list, slot_pairs, qrot[qorder], cf, list_recon,
         list_recon_sq, list_indices, kt, k, n_probes,
-        interpret=pallas_interpret)
+        interpret=pallas_interpret, merge_window=merge_window)
     return _fused_epilogue(vals, ids, qorder, nq, k, metric)
 
 
@@ -1576,13 +1590,20 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
         if mode in ("codes", "recon8") and index.metric not in _L2_METRICS:
             mode = "lut" if index.list_recon is None else "recon"
 
-        def note_fused_fallback():
+        def note_fused_fallback(reason="backend"):
+            # reason codes (shared with distributed.ann): "dtype",
+            # "k-too-large", "bucket-too-wide", "itopk-gate" from the
+            # kernel reject helpers; "backend" for off-TPU / non-f32-id
+            # misses; "mode" when the backing mode has no fused variant.
             if obs.enabled():
-                obs.registry().counter("ivf_pq.search.fused_fallback").inc()
+                reg = obs.registry()
+                reg.counter("ivf_pq.search.fused_fallback").inc()
+                reg.counter(
+                    f"ivf_pq.search.fused_fallback.reason.{reason}").inc()
             from raft_tpu.observability import flight as _flight
             from raft_tpu.observability import trace as _rtrace
             rec = _rtrace.current()
-            _flight.record_event("ivf_pq.fused_fallback",
+            _flight.record_event("ivf_pq.fused_fallback", reason=reason,
                                  trace_id=rec.trace_id if rec else None)
 
         tracing = (isinstance(queries, jax.core.Tracer)
@@ -1624,7 +1645,7 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
 
         if mode == "lut":
             if want_fused:
-                note_fused_fallback()
+                note_fused_fallback("mode")
             return lut_scan()
 
         from raft_tpu.neighbors import grouped
@@ -1665,6 +1686,9 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
         nq = queries.shape[0]
         rot = index.rot_dim
         kt = min(kt_req or k, cap)
+        from raft_tpu.ops import vmem_budget as vb
+        mw_req = vb.merge_window_request(
+            getattr(params, "merge_window", "auto"))
         G = grouped.GROUP
         on_tpu = jax.default_backend() == "tpu"
         # the fused kernels' one-hot id contraction is f32 — require
@@ -1681,7 +1705,11 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
             # re-decode every row anyway) — the LUT formulation computes
             # the same quantized distance
             if want_fused:
-                note_fused_fallback()
+                note_fused_fallback(
+                    "backend" if not (on_tpu and ids_ok) else
+                    pcs.fused_codes_reject_reason(
+                        True, True, cap, rot, kt, k, nq, index.pq_dim,
+                        index.pq_bits) or "bucket-too-wide")
             return lut_scan()
 
         with obs.stage("ivf_pq.search.coarse") as st:
@@ -1724,9 +1752,16 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
             if want_fused:
                 if pcs.supported_fused_codes(True, True, cap, rot, kt, k,
                                              nq, index.pq_dim,
-                                             index.pq_bits):
+                                             index.pq_bits,
+                                             merge_window=mw_req):
                     # one stage where code_scan + extraction used to be
-                    # two: the kernel output IS the final top-k
+                    # two: the kernel output IS the final top-k.  The
+                    # merge window is resolved host-statically from the
+                    # same shapes the gate saw (never from n_groups), so
+                    # the overflow re-dispatch reuses the choice.
+                    mw = pcs.fused_codes_merge_window(
+                        cap, rot, kt, k, nq, index.pq_dim, index.pq_bits,
+                        requested=mw_req)
                     return run_grouped(
                         "ivf_pq.search.fused_scan",
                         lambda ng: _search_impl_fused_codes_grouped(
@@ -1734,8 +1769,11 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
                             index.list_code_lanes, index.list_code_rsq,
                             index.list_indices, index.rotation, queries,
                             probes, k, kt, index.metric, ng,
-                            index.pq_bits))
-                note_fused_fallback()
+                            index.pq_bits, merge_window=mw))
+                note_fused_fallback(pcs.fused_codes_reject_reason(
+                    True, True, cap, rot, kt, k, nq, index.pq_dim,
+                    index.pq_bits, merge_window=mw_req)
+                    or "bucket-too-wide")
             return run_grouped(
                 "ivf_pq.search.code_scan",
                 lambda ng: _search_impl_codes_grouped(
@@ -1771,15 +1809,23 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
             from raft_tpu.ops import pq_group_scan_pallas as pqp
 
             if use_pallas and pqp.supported_fused(
-                    index.metric in _L2_METRICS, cap, rot, kt, k, nq):
+                    index.metric in _L2_METRICS, cap, rot, kt, k, nq,
+                    merge_window=mw_req):
+                mw = pqp.fused_merge_window(cap, rot, kt, k, nq,
+                                            requested=mw_req)
                 return run_grouped(
                     "ivf_pq.search.fused_scan",
                     lambda ng: _search_impl_fused_recon_grouped(
                         index.centers, index.list_recon,
                         index.list_recon_sq, index.list_indices,
                         index.rotation, queries, probes, k, kt,
-                        index.metric, ng))
-            note_fused_fallback()
+                        index.metric, ng, merge_window=mw))
+            note_fused_fallback(
+                "backend" if not use_pallas else
+                pqp.fused_reject_reason(index.metric in _L2_METRICS, cap,
+                                        rot, kt, k, nq,
+                                        merge_window=mw_req)
+                or "bucket-too-wide")
 
         def dispatch(ng):
             block = grouped.block_size(

@@ -38,37 +38,62 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from raft_tpu.ops import vmem_budget as vb
+
 # serving-bucket bounds: the fused hop targets the low-latency regime
 _HOP_MAX_BATCH = 64
-_HOP_MAX_ITOPK = 32
+_HOP_MAX_ITOPK = 32          # legacy in-pass merge (W=1)
+_HOP_MAX_ITOPK_STAGED = 64   # staged extraction + bitonic merge (W=2)
 _HOP_MAX_WD = 128
 _HOP_VMEM_BUDGET = 8 << 20
 _LANES = 128
 
 
-def supported_hop(nq: int, itopk: int, wd: int, pdim: int) -> bool:
-    """Static shape gate for the fused hop kernel (VMEM + unroll)."""
-    if not (0 < nq <= _HOP_MAX_BATCH and 0 < itopk <= _HOP_MAX_ITOPK):
-        return False
-    if not (0 < wd <= _HOP_MAX_WD and 0 < pdim <= 256):
-        return False
-    rows = itopk + wd
-    vmem = (wd * pdim * _LANES * 4          # neighbor lanes
-            + (pdim + 1) * _LANES * 4       # qpT + q_sq
-            + 2 * wd * _LANES * 4           # nb_sq / nb_id
-            + 9 * itopk * _LANES * 4        # buffer triple, in + out
-            + 4 * rows * _LANES * 4)        # merge working set
-    return vmem <= _HOP_VMEM_BUDGET
+def hop_merge_window(nq: int, itopk: int, wd: int, pdim: int,
+                     requested: int = 0) -> int:
+    """Host-static merge-window choice for the fused hop: 1 = legacy
+    in-pass merge (itopk <= 32), 2 = staged extraction + in-kernel
+    bitonic merge (itopk to 64), 0 = no variant fits (fall back to the
+    XLA hop).  The walk consumes the merged buffer every hop, so there
+    is no deeper window; ``requested`` 0 is auto."""
+    if not (0 < nq <= _HOP_MAX_BATCH and 0 < wd <= _HOP_MAX_WD
+            and 0 < pdim <= 256):
+        return 0
+    return vb.select_hop_window(requested, itopk=itopk, wd=wd, pdim=pdim,
+                                lanes=_LANES, budget=_HOP_VMEM_BUDGET,
+                                itopk_legacy_max=_HOP_MAX_ITOPK,
+                                itopk_staged_max=_HOP_MAX_ITOPK_STAGED)
 
 
-def _kernel_hop(qpT_ref, qsq_ref, nbp_ref, nbsq_ref, nbid_ref,
-                bufd_ref, bufi_ref, vis_ref,
-                od_ref, oi_ref, ov_ref, *,
-                itopk: int, wd: int, pdim: int, ip_metric: bool):
-    nq = qpT_ref.shape[1]
+def supported_hop(nq: int, itopk: int, wd: int, pdim: int,
+                  merge_window: int = 0) -> bool:
+    """Static shape gate for the fused hop kernel (VMEM + unroll); some
+    merge window — legacy or staged — must fit."""
+    return hop_merge_window(nq, itopk, wd, pdim, merge_window) > 0
+
+
+def hop_reject_reason(nq: int, itopk: int, wd: int, pdim: int,
+                      merge_window: int = 0) -> str:
+    """Reason code for a fused-hop gate miss ('' when supported):
+    'itopk-gate' (itopk past the staged bound, or its VMEM share is
+    what overflows), 'bucket-too-wide' (batch / width / pdim)."""
+    if supported_hop(nq, itopk, wd, pdim, merge_window):
+        return ""
+    if itopk > _HOP_MAX_ITOPK_STAGED:
+        return "itopk-gate"
+    if not (0 < nq <= _HOP_MAX_BATCH and 0 < wd <= _HOP_MAX_WD
+            and 0 < pdim <= 256):
+        return "bucket-too-wide"
+    if itopk > _HOP_MAX_ITOPK:
+        return "itopk-gate"
+    return "bucket-too-wide"
+
+
+def _hop_scores(qpT_ref, qsq_ref, nbp_ref, nbsq_ref, nbid_ref, wd, pdim,
+                ip_metric):
+    """Shared score block: wd unrolled VPU rows — the (wd, nq) distance
+    KEYS and f32 candidate ids, masked parents at (+inf, -1)."""
     qpT = qpT_ref[:]                                   # (pdim, nq)
-
-    # ---- score: wd unrolled VPU rows, candidates stay in VMEM ----------
     ip_rows = []
     for j in range(wd):
         nb_j = nbp_ref[j * pdim:(j + 1) * pdim, :]     # (pdim, nq)
@@ -82,6 +107,17 @@ def _kernel_hop(qpT_ref, qsq_ref, nbp_ref, nbsq_ref, nbid_ref,
     ok = cid >= 0.0
     d = jnp.where(ok, d, jnp.inf)
     cid = jnp.where(ok, cid, -1.0)
+    return d, cid
+
+
+def _kernel_hop(qpT_ref, qsq_ref, nbp_ref, nbsq_ref, nbid_ref,
+                bufd_ref, bufi_ref, vis_ref,
+                od_ref, oi_ref, ov_ref, *,
+                itopk: int, wd: int, pdim: int, ip_metric: bool):
+    nq = qpT_ref.shape[1]
+
+    d, cid = _hop_scores(qpT_ref, qsq_ref, nbp_ref, nbsq_ref, nbid_ref,
+                         wd, pdim, ip_metric)
 
     # ---- merge with in-pass dedupe -------------------------------------
     cat_v = jnp.concatenate([bufd_ref[:], d], axis=0)  # (rows, nq)
@@ -109,10 +145,96 @@ def _kernel_hop(qpT_ref, qsq_ref, nbp_ref, nbsq_ref, nbid_ref,
     ov_ref[:] = jnp.concatenate(out_s, axis=0)
 
 
+def _kernel_hop_staged(qpT_ref, qsq_ref, nbp_ref, nbsq_ref, nbid_ref,
+                       bufd_ref, bufi_ref, vis_ref,
+                       od_ref, oi_ref, ov_ref, stg_d, stg_i, *,
+                       itopk: int, wd: int, pdim: int, ip_metric: bool):
+    """Staged hop variant (merge window 2): instead of itopk
+    min-extraction rounds over ALL itopk+wd rows, candidates are
+    deduped, extracted SORTED into the (t, nq) staging block
+    (t = min(itopk, wd) — deeper ranks cannot survive the merge), and
+    folded into the buffer by one in-kernel bitonic merge — the exact
+    compare-exchange network of ``cagra._bitonic_merge`` (concat
+    [buffer | inf pad | staged DESCENDING] is bitonic; strict-> swaps
+    keep tie order), so outputs match the XLA twin.  This lifts the
+    itopk gate from 32 to 64: extraction passes shrink from
+    itopk*(itopk+wd) to t*wd row-ops plus a log2-depth merge."""
+    nq = qpT_ref.shape[1]
+
+    d, cid = _hop_scores(qpT_ref, qsq_ref, nbp_ref, nbsq_ref, nbid_ref,
+                         wd, pdim, ip_metric)
+
+    # ---- candidate-vs-buffer dedupe: membership kill against every
+    # buffer row (duplicate ids carry bitwise-identical keys, so the
+    # buffer copy — and its visited flag — is the one that survives) ----
+    bufi = bufi_ref[:]                                 # (itopk, nq)
+    dup = jnp.zeros(d.shape, jnp.bool_)
+    for j in range(itopk):
+        dup = dup | (cid == bufi[j:j + 1, :])
+    ok = (cid >= 0.0) & ~dup
+    d = jnp.where(ok, d, jnp.inf)
+    cid = jnp.where(ok, cid, -1.0)
+
+    # ---- staged extraction: top-t of the candidates, sorted, with
+    # in-pass self-dedupe; stored DESCENDING so the bitonic concat
+    # needs no runtime reverse.  Exhausted ranks emit (inf, -1) —
+    # exactly the XLA twin's killed/padded candidate rows ----
+    t = vb.hop_stage_rows(itopk, wd)
+    riota = jax.lax.broadcasted_iota(jnp.int32, (wd, nq), 0)
+    for j in range(t):
+        m = jnp.min(d, axis=0, keepdims=True)          # (1, nq)
+        rmin = jnp.min(jnp.where(d == m, riota, wd), axis=0, keepdims=True)
+        sel = riota == rmin
+        wi = jnp.sum(jnp.where(sel, cid, 0.0), axis=0, keepdims=True)
+        wi = jnp.where(jnp.isinf(m), -1.0, wi)
+        stg_d[t - 1 - j:t - j, :] = m
+        stg_i[t - 1 - j:t - j, :] = wi
+        kill = sel | ((cid == wi) & (wi >= 0.0))
+        d = jnp.where(kill, jnp.inf, d)
+
+    # ---- bitonic merge of the sorted buffer with the staged block ----
+    size = vb.hop_pow2(itopk + t)
+    pad = size - itopk - t
+    k_ = jnp.concatenate([bufd_ref[:],
+                          jnp.full((pad, nq), jnp.inf, jnp.float32),
+                          stg_d[:]], axis=0)           # (size, nq)
+    i_ = jnp.concatenate([bufi_ref[:],
+                          jnp.full((pad, nq), -1.0, jnp.float32),
+                          stg_i[:]], axis=0)
+    v_ = jnp.concatenate([vis_ref[:],
+                          jnp.zeros((pad + t, nq), jnp.float32)], axis=0)
+
+    srow = jax.lax.broadcasted_iota(jnp.int32, (size, nq), 0)
+
+    def roll(x, sh):
+        return jnp.concatenate([x[sh:], x[:sh]], axis=0)
+
+    s = size // 2
+    while s >= 1:
+        lo = (srow & s) == 0
+        up_k, dn_k = roll(k_, s), roll(k_, size - s)
+        up_i, dn_i = roll(i_, s), roll(i_, size - s)
+        up_v, dn_v = roll(v_, s), roll(v_, size - s)
+        swap_lo = k_ > up_k                            # strict: ties stay
+        swap_hi = dn_k > k_
+        k_ = jnp.where(lo, jnp.where(swap_lo, up_k, k_),
+                       jnp.where(swap_hi, dn_k, k_))
+        i_ = jnp.where(lo, jnp.where(swap_lo, up_i, i_),
+                       jnp.where(swap_hi, dn_i, i_))
+        v_ = jnp.where(lo, jnp.where(swap_lo, up_v, v_),
+                       jnp.where(swap_hi, dn_v, v_))
+        s //= 2
+    od_ref[:] = k_[:itopk]
+    oi_ref[:] = i_[:itopk]
+    ov_ref[:] = v_[:itopk]
+
+
 @functools.partial(jax.jit,
-                   static_argnames=("itopk", "ip_metric", "interpret"))
+                   static_argnames=("itopk", "ip_metric", "interpret",
+                                    "merge_window"))
 def fused_hop(qp_t, q_sq, nb_p, nb_sq, nb_id, buf_d, buf_i, visited, *,
-              itopk: int, ip_metric: bool, interpret: bool = False
+              itopk: int, ip_metric: bool, interpret: bool = False,
+              merge_window: int = 0
               ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One fused graph-walk hop.
 
@@ -127,8 +249,16 @@ def fused_hop(qp_t, q_sq, nb_p, nb_sq, nb_id, buf_d, buf_i, visited, *,
     Returns the merged (buf_d, buf_i int32, visited bool), sorted
     ascending-better, ids deduped — drop-in for the XLA
     ``_merge_candidates`` + ``_bitonic_merge`` pair.
+
+    ``merge_window`` selects the variant (0 auto): 1 = legacy in-pass
+    merge (itopk <= 32), 2 = staged extraction + in-kernel bitonic
+    merge (itopk to 64) — see :func:`hop_merge_window`.
     """
     nq, wd, pdim = nb_p.shape
+    if merge_window > 0:
+        mw = 2 if merge_window > 1 else 1
+    else:
+        mw = 1 if itopk <= _HOP_MAX_ITOPK else 2
     pad = _LANES - nq
 
     def col(x, fill):
@@ -146,10 +276,12 @@ def fused_hop(qp_t, q_sq, nb_p, nb_sq, nb_id, buf_d, buf_i, visited, *,
     bufi = col(buf_i, -1.0)
     vis = col(visited, 1.0)
 
+    kern = _kernel_hop if mw <= 1 else _kernel_hop_staged
     out = pl.pallas_call(
-        functools.partial(_kernel_hop, itopk=itopk, wd=wd, pdim=pdim,
+        functools.partial(kern, itopk=itopk, wd=wd, pdim=pdim,
                           ip_metric=ip_metric),
         out_shape=[jax.ShapeDtypeStruct((itopk, _LANES), jnp.float32)] * 3,
+        scratch_shapes=vb.hop_scratch(itopk, wd, mw, _LANES),
         interpret=interpret,
     )(qpT, qsq, nbp, nbsq, nbid, bufd, bufi, vis)
     od, oi, ov = (o[:, :nq].T for o in out)

@@ -50,15 +50,21 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from raft_tpu.neighbors.grouped import GROUP
+from raft_tpu.ops import vmem_budget as vb
 from raft_tpu.ops.pq_group_scan_pallas import (_KT_MAX, _KT_UNROLL,
                                                _extract_topk,
-                                               _fused_accumulate,
+                                               _fused_step,
                                                _gather_queries,
                                                _gather_queries_masked,
                                                _scratch_shapes)
 from raft_tpu.ops.pq_group_scan_pallas import _ACC_WORST  # noqa: F401 (re-export)
 
 _VMEM_BUDGET = 10 << 20
+# merge-side budget of the fused codes kernel: accumulator + staging
+# ring + merge transients, charged NEXT TO the streaming budget above
+# (raised from the round-7 2 MiB accumulator cap — the windowed merge
+# spends staging VMEM to buy back per-step merge passes)
+_FUSED_MERGE_BUDGET = 4 << 20
 
 
 def _round_up(x: int, m: int) -> int:
@@ -219,19 +225,23 @@ def _kernel_recon8(gl_ref, slot_ref, qrot_ref, cf_ref, data_ref, scale_ref,
 
 def _kernel_codes_fused(gl_ref, slot_ref, qrot_ref, cf_ref, codes_ref,
                         cb_ref, rsq_ref, ids_ref, vals_ref, ids_out_ref,
-                        acc_v, acc_i, *, kt, k, n_probes, P, pq_dim,
-                        pq_bits, n_groups):
+                        acc_v, acc_i, *stg, kt, k, n_probes, P, pq_dim,
+                        pq_bits, n_groups, merge_window):
     """Fused compact-code scan: the ``_kernel_codes`` decode + distance
     block feeding the in-kernel per-query accumulator
-    (pq_group_scan_pallas._fused_accumulate) instead of per-pair output
-    rows — candidates never reach HBM; the final (k, nq_pad) answers
-    flush once on the last grid step."""
+    (pq_group_scan_pallas._fused_step — per-step merge at W=1, staged
+    ring + windowed merge at W>1) instead of per-pair output rows —
+    candidates never reach HBM; the final (k, nq_pad) answers flush
+    once on the last grid step."""
     g = pl.program_id(0)
 
     @pl.when(g == 0)
     def _init():
         acc_v[:] = jnp.full(acc_v.shape, _ACC_WORST, jnp.float32)
         acc_i[:] = jnp.full(acc_i.shape, -1.0, jnp.float32)
+        if merge_window > 1:
+            stg[0][:] = jnp.full(stg[0].shape, _ACC_WORST, jnp.float32)
+            stg[1][:] = jnp.full(stg[1].shape, -1.0, jnp.float32)
 
     qv, oh = _gather_queries_masked(slot_ref, qrot_ref, n_probes, P)
     sub = qv - cf_ref[0, 0][None, :]                     # (G, rot_pad) f32
@@ -244,7 +254,8 @@ def _kernel_codes_fused(gl_ref, slot_ref, qrot_ref, cf_ref, codes_ref,
                              preferred_element_type=jnp.float32)
     d = sub_sq[:, None] + rsq_ref[0, 0][None, :] - 2.0 * ip
     d = jnp.maximum(d, 0.0)
-    _fused_accumulate(oh, d, ids_ref[0, 0], acc_v, acc_i, kt)
+    _fused_step(g, oh, d, ids_ref[0, 0], acc_v, acc_i, stg, kt=kt,
+                merge_window=merge_window, n_groups=n_groups)
 
     @pl.when(g == n_groups - 1)
     def _flush():
@@ -253,10 +264,12 @@ def _kernel_codes_fused(gl_ref, slot_ref, qrot_ref, cf_ref, codes_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("kt", "k", "n_probes",
-                                             "pq_bits", "interpret"))
+                                             "pq_bits", "interpret",
+                                             "merge_window"))
 def grouped_code_scan_fused(group_list, slot_pairs, qrot, centers_f32,
                             codes_lanes, codebooks, rsq, list_indices, kt,
-                            k, n_probes, pq_bits, interpret=False):
+                            k, n_probes, pq_bits, interpret=False,
+                            merge_window=1):
     """Fused compact-code scan with IN-KERNEL per-query top-k.
 
     Inputs as :func:`grouped_code_scan`; output contract as
@@ -294,13 +307,13 @@ def grouped_code_scan_fused(group_list, slot_pairs, qrot, centers_f32,
             pl.BlockSpec((k, nq_pad), lambda g, gl: (0, 0)),
             pl.BlockSpec((k, nq_pad), lambda g, gl: (0, 0)),
         ],
-        scratch_shapes=[pltpu.VMEM((k, nq_pad), jnp.float32),
-                        pltpu.VMEM((k, nq_pad), jnp.float32)],
+        scratch_shapes=vb.fused_scan_scratch(k, kt, merge_window, nq_pad),
     )
     vals, gids = pl.pallas_call(
         functools.partial(_kernel_codes_fused, kt=kt, k=k,
                           n_probes=n_probes, P=P, pq_dim=pq_dim,
-                          pq_bits=pq_bits, n_groups=n_groups),
+                          pq_bits=pq_bits, n_groups=n_groups,
+                          merge_window=merge_window),
         out_shape=[
             jax.ShapeDtypeStruct((k, nq_pad), jnp.float32),
             jax.ShapeDtypeStruct((k, nq_pad), jnp.int32),
@@ -480,22 +493,56 @@ def supported_codes(metric_is_l2: bool, per_subspace: bool, cap: int,
             and nq <= 6144 and vmem <= _VMEM_BUDGET)
 
 
+def fused_codes_merge_window(cap: int, rot: int, kt: int, k: int, nq: int,
+                             pq_dim: int, pq_bits: int,
+                             requested: int = 0) -> int:
+    """Host-static merge window for the fused codes scan (0 = no window
+    fits).  The streaming side (codes + codebook + decode transients)
+    is budgeted by :func:`supported_codes`; the merge side —
+    accumulator + staging ring + merge transients — gets its own
+    ``_FUSED_MERGE_BUDGET`` next to it, so ``base_bytes`` is 0 here."""
+    del cap, rot, pq_dim, pq_bits    # streaming side budgeted separately
+    nq_pad = _round_up(nq + 1, 128)
+    return vb.select_merge_window(
+        requested, kt=kt, k=k, nq_pad=nq_pad, group=GROUP, base_bytes=0,
+        budget=_FUSED_MERGE_BUDGET, w_min=1 if k <= _KT_UNROLL else 2)
+
+
 def supported_fused_codes(metric_is_l2: bool, per_subspace: bool, cap: int,
                           rot: int, kt: int, k: int, nq: int, pq_dim: int,
-                          pq_bits: int) -> bool:
+                          pq_bits: int, merge_window: int = 0) -> bool:
     """Shapes the FUSED code-scan kernel handles: the static
     :func:`supported_codes` preconditions (generic extraction — the
-    packed-key variant has no fused twin) plus the (k, nq_pad)
-    accumulator pair in the VMEM budget and k bounded to the unrolled
-    merge regime."""
+    packed-key variant has no fused twin) plus the merge side —
+    (k, nq_pad) accumulator pair, staging ring, merge transients —
+    within ``_FUSED_MERGE_BUDGET`` for some window W
+    (:func:`fused_codes_merge_window`); kt stays unrolled while k
+    extends to ``vmem_budget.FUSED_K_MAX`` through the windowed
+    merge."""
     if not supported_codes(metric_is_l2, per_subspace, cap, rot, kt, nq,
                            pq_dim, pq_bits, packed=False):
         return False
-    nq_pad = _round_up(nq + 1, 128)
-    acc = (2 * k * nq_pad * 4                 # accumulator rows
-           + 4 * (k + kt) * GROUP * 4)        # gather/merge temps
-    return (0 < kt <= _KT_UNROLL and 0 < k <= _KT_UNROLL
-            and acc <= (2 << 20))
+    return (0 < kt <= _KT_UNROLL and 0 < k <= vb.FUSED_K_MAX
+            and fused_codes_merge_window(cap, rot, kt, k, nq, pq_dim,
+                                         pq_bits, merge_window) > 0)
+
+
+def fused_codes_reject_reason(metric_is_l2: bool, per_subspace: bool,
+                              cap: int, rot: int, kt: int, k: int, nq: int,
+                              pq_dim: int, pq_bits: int,
+                              merge_window: int = 0) -> str:
+    """Reason code for a fused-codes gate miss ('' when supported):
+    'dtype' (metric / codebook layout / pq_bits), 'k-too-large' (k/kt
+    bounds), 'bucket-too-wide' (batch, alignment, or VMEM)."""
+    if not (metric_is_l2 and per_subspace and pq_bits in (4, 8)
+            and pq_dim and rot % pq_dim == 0):
+        return "dtype"
+    if not (0 < kt <= _KT_UNROLL and 0 < k <= vb.FUSED_K_MAX):
+        return "k-too-large"
+    if supported_fused_codes(metric_is_l2, per_subspace, cap, rot, kt, k,
+                             nq, pq_dim, pq_bits, merge_window):
+        return ""
+    return "bucket-too-wide"
 
 
 def supported_recon8(metric_is_l2: bool, cap: int, rot: int, kt: int,

@@ -41,6 +41,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from raft_tpu.neighbors.grouped import GROUP
+from raft_tpu.ops import vmem_budget as vb
 
 # extraction switches from unrolled static-lane passes to a fori_loop
 # with transposed scratch above this kt (see _extract_topk)
@@ -256,18 +257,106 @@ def _fused_accumulate(oh, d, ids_row, acc_v, acc_i, kt):
         preferred_element_type=jnp.float32)
 
 
+def _merge_cols(acc_v, acc_i, stg_v, stg_i, k):
+    """Windowed merge: fold the staged (kt*W, nq_pad) ring into the
+    sorted (k, nq_pad) accumulator at FULL column width — no one-hot
+    gather or write-back, every query column merges in place.  Same
+    selection rule as :func:`_merge_topk` (min, lowest-row tie-break,
+    masked-id reduce, winner re-masked to the finite sentinel), with
+    rows ordered [accumulator | ring in arrival order] so tie retention
+    matches the per-step merge bit-for-bit.  Columns whose staged rows
+    are all sentinels reproduce the accumulator exactly (it is sorted
+    and its rows precede the ring's), so partially-filled windows and
+    all-sentinel tails are free.
+
+    k past the unrolled regime runs as a ``fori_loop`` with dynamic
+    SUBLANE stores into the accumulator — the concatenated working set
+    is materialized before the loop, so the in-place row writes never
+    feed back into the selection carry."""
+    cat_v = jnp.concatenate([acc_v[:], stg_v[:]], axis=0)
+    cat_i = jnp.concatenate([acc_i[:], stg_i[:]], axis=0)
+    rows_n = cat_v.shape[0]
+    rows = jax.lax.broadcasted_iota(jnp.int32, cat_v.shape, 0)
+
+    def step(cat_v):
+        m = jnp.min(cat_v, axis=0)                     # (nq_pad,)
+        p = jnp.min(jnp.where(cat_v == m[None, :], rows, rows_n), axis=0)
+        p = jnp.minimum(p, rows_n - 1)
+        sel = rows == p[None, :]
+        gi = jnp.max(jnp.where(sel, cat_i, -jnp.inf), axis=0)
+        return m, sel, gi
+
+    if k <= _KT_UNROLL:
+        out_v, out_i = [], []
+        for _ in range(k):
+            m, sel, gi = step(cat_v)
+            out_v.append(m[None, :])
+            out_i.append(gi[None, :])
+            cat_v = jnp.where(sel, _ACC_WORST, cat_v)
+        acc_v[:] = jnp.concatenate(out_v, 0)
+        acc_i[:] = jnp.concatenate(out_i, 0)
+    else:
+        def body(j, cat_v):
+            m, sel, gi = step(cat_v)
+            acc_v[pl.ds(j, 1), :] = m[None, :]
+            acc_i[pl.ds(j, 1), :] = gi[None, :]
+            return jnp.where(sel, _ACC_WORST, cat_v)
+
+        jax.lax.fori_loop(0, k, body, cat_v, unroll=False)
+
+
+def _fused_step(g, oh, d, ids_row, acc_v, acc_i, stg, *, kt,
+                merge_window, n_groups):
+    """One grid step of the fused accumulator, windowed.
+
+    W <= 1 is the original per-step path (:func:`_fused_accumulate` —
+    gather + merge + write-back every step).  W > 1 stages instead:
+    the step's local top-kt lands in the ring slot ``g % W`` by ONE
+    one-hot scatter per operand — uncovered columns take the
+    ``_ACC_WORST`` / id -1 sentinel fill (``dot + _ACC_WORST*(1-cover)``
+    is exact: covered columns add 0, uncovered columns add to 0) — and
+    only every W-th step (and the flush step) pays
+    :func:`_merge_cols`.  The ring resets to sentinels after each
+    merge so stale slots of a partial final window merge as no-ops.
+    """
+    if merge_window <= 1:
+        _fused_accumulate(oh, d, ids_row, acc_v, acc_i, kt)
+        return
+    stg_v, stg_i = stg
+    new_v, new_i = _topk_rows(d, ids_row, kt)          # (kt, G), finite
+    cover = jnp.sum(oh, axis=0)                        # (nq_pad,) 0/1
+    fill = (1.0 - cover)[None, :]
+    row0 = (g % merge_window) * kt
+    stg_v[pl.ds(row0, kt), :] = jax.lax.dot_general(
+        new_v, oh, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) + _ACC_WORST * fill
+    stg_i[pl.ds(row0, kt), :] = jax.lax.dot_general(
+        new_i, oh, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) - fill
+
+    @pl.when(((g + 1) % merge_window == 0) | (g == n_groups - 1))
+    def _merge():
+        _merge_cols(acc_v, acc_i, stg_v, stg_i, acc_v.shape[0])
+        stg_v[:] = jnp.full(stg_v.shape, _ACC_WORST, jnp.float32)
+        stg_i[:] = jnp.full(stg_i.shape, -1.0, jnp.float32)
+
+
 def _kernel_fused(gl_ref, slot_ref, qrot_ref, cf_ref, data_ref, rsq_ref,
-                  ids_ref, vals_ref, ids_out_ref, acc_v, acc_i, *, kt, k,
-                  n_probes, P, n_groups):
+                  ids_ref, vals_ref, ids_out_ref, acc_v, acc_i, *stg,
+                  kt, k, n_probes, P, n_groups, merge_window):
     """Fused recon scan: the non-fused ``_kernel`` distance block plus
-    the in-kernel accumulator merge; outputs are the FINAL per-query
-    (k, nq_pad) answers, flushed once on the last grid step."""
+    the in-kernel accumulator merge (windowed through the staging ring
+    when merge_window > 1); outputs are the FINAL per-query (k, nq_pad)
+    answers, flushed once on the last grid step."""
     g = pl.program_id(0)
 
     @pl.when(g == 0)
     def _init():
         acc_v[:] = jnp.full(acc_v.shape, _ACC_WORST, jnp.float32)
         acc_i[:] = jnp.full(acc_i.shape, -1.0, jnp.float32)
+        if merge_window > 1:
+            stg[0][:] = jnp.full(stg[0].shape, _ACC_WORST, jnp.float32)
+            stg[1][:] = jnp.full(stg[1].shape, -1.0, jnp.float32)
 
     qv, oh = _gather_queries_masked(slot_ref, qrot_ref, n_probes, P)
     sub = qv - cf_ref[0, 0][None, :]                   # (G, rot) f32
@@ -278,7 +367,8 @@ def _kernel_fused(gl_ref, slot_ref, qrot_ref, cf_ref, data_ref, rsq_ref,
                              preferred_element_type=jnp.float32)
     d = sub_sq[:, None] + rsq_ref[0, 0][None, :] - 2.0 * ip
     d = jnp.maximum(d, 0.0)
-    _fused_accumulate(oh, d, ids_ref[0, 0], acc_v, acc_i, kt)
+    _fused_step(g, oh, d, ids_ref[0, 0], acc_v, acc_i, stg, kt=kt,
+                merge_window=merge_window, n_groups=n_groups)
 
     @pl.when(g == n_groups - 1)
     def _flush():
@@ -287,10 +377,10 @@ def _kernel_fused(gl_ref, slot_ref, qrot_ref, cf_ref, data_ref, rsq_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("kt", "k", "n_probes",
-                                             "interpret"))
+                                             "interpret", "merge_window"))
 def grouped_l2_scan_fused(group_list, slot_pairs, qrot, centers_f32,
                           list_recon, rec_sq, list_indices, kt, k, n_probes,
-                          interpret=False):
+                          interpret=False, merge_window=1):
     """Fused grouped recon scan with IN-KERNEL per-query top-k.
 
     Inputs as :func:`grouped_l2_scan`; instead of per-pair winners the
@@ -302,6 +392,12 @@ def grouped_l2_scan_fused(group_list, slot_pairs, qrot, centers_f32,
     probe) keep-set exactly like the non-fused path: each group
     contributes at most its local top-kt per pair before the merge, so
     results match the scatter+select reference at matched kt.
+
+    ``merge_window`` W amortizes the accumulator merge: steps stage
+    their top-kt in a (kt*W, nq_pad) VMEM ring and the merge runs every
+    W-th step — bit-identical to W=1 (the merge is order-insensitive
+    under the finite sentinel; ring order preserves tie retention).
+    Pick W with :func:`fused_merge_window`; k > 64 requires W >= 2.
     """
     n_groups = group_list.shape[0]
     nq, rot = qrot.shape
@@ -327,12 +423,12 @@ def grouped_l2_scan_fused(group_list, slot_pairs, qrot, centers_f32,
             pl.BlockSpec((k, nq_pad), lambda g, gl: (0, 0)),
             pl.BlockSpec((k, nq_pad), lambda g, gl: (0, 0)),
         ],
-        scratch_shapes=[pltpu.VMEM((k, nq_pad), jnp.float32),
-                        pltpu.VMEM((k, nq_pad), jnp.float32)],
+        scratch_shapes=vb.fused_scan_scratch(k, kt, merge_window, nq_pad),
     )
     vals, gids = pl.pallas_call(
         functools.partial(_kernel_fused, kt=kt, k=k, n_probes=n_probes,
-                          P=P, n_groups=n_groups),
+                          P=P, n_groups=n_groups,
+                          merge_window=merge_window),
         out_shape=[
             jax.ShapeDtypeStruct((k, nq_pad), jnp.float32),
             jax.ShapeDtypeStruct((k, nq_pad), jnp.int32),
@@ -345,22 +441,64 @@ def grouped_l2_scan_fused(group_list, slot_pairs, qrot, centers_f32,
     return vals, gids
 
 
-def supported_fused(metric_is_l2: bool, cap: int, rot: int, kt: int,
-                    k: int, nq: int, data_elem_bytes: int = 2) -> bool:
-    """Shapes the fused recon kernel handles.  Beyond :func:`supported`:
-    the (k, nq_pad) accumulator pair joins the VMEM budget, and both kt
-    and k are bounded to the unrolled-extraction regime (the merge and
-    local passes are Python-unrolled)."""
-    nq_pad = -(-(nq + 1) // 128) * 128
-    vmem = (2 * nq_pad * rot * 4              # query table + one-hot
+def _fused_base_bytes(cap: int, rot: int, nq_pad: int,
+                      data_elem_bytes: int) -> int:
+    return (2 * nq_pad * rot * 4              # query table + one-hot
             + cap * rot * data_elem_bytes     # per-list data block
-            + 2 * GROUP * cap * 4             # distances + local passes
-            + 2 * k * nq_pad * 4              # accumulator rows
-            + 4 * (k + kt) * GROUP * 4)       # gather/merge temps
+            + 2 * GROUP * cap * 4)            # distances + local passes
+
+
+def _fused_static_ok(metric_is_l2: bool, cap: int, rot: int, kt: int,
+                     k: int, nq: int) -> bool:
     return (metric_is_l2 and rot % 128 == 0 and cap % 16 == 0
             and GROUP % 16 == 0 and 0 < kt <= _KT_UNROLL
-            and 0 < k <= _KT_UNROLL
-            and nq <= 6144 and vmem <= (10 << 20))
+            and 0 < k <= vb.FUSED_K_MAX and nq <= 6144)
+
+
+def fused_merge_window(cap: int, rot: int, kt: int, k: int, nq: int,
+                       data_elem_bytes: int = 2, requested: int = 0) -> int:
+    """Host-static merge window for the fused recon scan at this shape
+    (0 = no window fits -> fused unsupported).  ``requested`` 0 is auto
+    (largest fitting W); k past the unrolled per-step merge needs the
+    windowed path, so W >= 2 is forced there."""
+    nq_pad = vb.nq_padded(nq)
+    return vb.select_merge_window(
+        requested, kt=kt, k=k, nq_pad=nq_pad, group=GROUP,
+        base_bytes=_fused_base_bytes(cap, rot, nq_pad, data_elem_bytes),
+        budget=10 << 20, w_min=1 if k <= _KT_UNROLL else 2)
+
+
+def supported_fused(metric_is_l2: bool, cap: int, rot: int, kt: int,
+                    k: int, nq: int, data_elem_bytes: int = 2,
+                    merge_window: int = 0) -> bool:
+    """Shapes the fused recon kernel handles.  Beyond :func:`supported`:
+    the (k, nq_pad) accumulator pair and the staging ring join the VMEM
+    budget (:mod:`raft_tpu.ops.vmem_budget`); kt stays in the unrolled
+    regime while k extends to ``FUSED_K_MAX`` through the windowed
+    merge (some W must fit — check :func:`fused_merge_window`)."""
+    return (_fused_static_ok(metric_is_l2, cap, rot, kt, k, nq)
+            and fused_merge_window(cap, rot, kt, k, nq, data_elem_bytes,
+                                   merge_window) > 0)
+
+
+def fused_reject_reason(metric_is_l2: bool, cap: int, rot: int, kt: int,
+                        k: int, nq: int, data_elem_bytes: int = 2,
+                        merge_window: int = 0) -> str:
+    """Reason code for a fused-recon gate miss ('' when supported):
+    'dtype' (metric), 'k-too-large' (k/kt bounds), 'bucket-too-wide'
+    (batch, layout, or VMEM — no merge window fits).  Drives the
+    ``fused_fallback`` counter attrs and flight events."""
+    if not metric_is_l2:
+        return "dtype"
+    if not (0 < kt <= _KT_UNROLL and 0 < k <= vb.FUSED_K_MAX):
+        return "k-too-large"
+    if not (rot % 128 == 0 and cap % 16 == 0 and GROUP % 16 == 0
+            and nq <= 6144):
+        return "bucket-too-wide"
+    if fused_merge_window(cap, rot, kt, k, nq, data_elem_bytes,
+                          merge_window) <= 0:
+        return "bucket-too-wide"
+    return ""
 
 
 def _kernel_flat(gl_ref, slot_ref, q_ref, data_ref, dsq_ref, ids_ref,

@@ -16,6 +16,16 @@ must be the finite ``3.0e38`` (``_ACC_WORST``) wherever they can meet
 a product.  An ``inf`` flowing into ``*`` / ``@`` / ``dot`` poisons
 whole accumulator rows.
 
+The third seam is the round-14 staging ring: the windowed fused merge
+parks per-step candidates in VMEM scratch (``stg_*`` / ``acc_*`` /
+``*ring*`` refs) whose uncovered slots MUST hold the finite sentinel —
+an ``inf`` (or any huge float that is not ``_ACC_WORST``) written into
+the ring re-enters the one-hot merge as a product operand on the next
+flush.  And because the merge-window selector (``ops/vmem_budget``)
+and the kernel must agree on the VMEM footprint, the fused kernels'
+``scratch_shapes`` must be sized by the shared budget helpers, never
+by inline shape lists.
+
 Rules:
 
 - ``mask-seam``: ``== -1`` / ``!= -1`` comparisons against id-ish
@@ -25,6 +35,13 @@ Rules:
 - ``mask-seam``: a multiplication / matmul / ``dot`` in
   ``raft_tpu/ops/*_pallas.py`` with an ``inf`` literal anywhere in its
   operands.
+- ``staging-ring``: a write to a staging-ring / accumulator scratch
+  ref in ``raft_tpu/ops/*_pallas.py`` whose value contains an ``inf``
+  literal or a non-sentinel huge-float fill.
+- ``scratch-budget``: a ``scratch_shapes=`` keyword in the fused scan
+  / hop kernel modules that does not route through
+  ``ops.vmem_budget`` (``fused_scan_scratch`` / ``hop_scratch``; the
+  legacy non-fused ``_scratch_shapes`` helper is also accepted).
 """
 
 from __future__ import annotations
@@ -42,6 +59,43 @@ from scripts.graftlint.core import (
 
 _ID_EXACT = {"outi", "alli", "best_i", "neighbors", "ti", "gi"}
 _DOT_CALLS = {"dot", "dot_general", "matmul", "einsum"}
+
+#: modules whose kernels feed the windowed one-hot merge: their scratch
+#: MUST be sized by the shared VMEM-budget helpers
+_FUSED_MODULES = {
+    "raft_tpu/ops/pq_group_scan_pallas.py",
+    "raft_tpu/ops/pq_code_scan_pallas.py",
+    "raft_tpu/ops/cagra_hop_pallas.py",
+}
+_SCRATCH_HELPERS = {"fused_scan_scratch", "hop_scratch",
+                    "_scratch_shapes"}
+_ACC_SENTINEL = 3.0e38
+
+
+def _ringish(name: str) -> bool:
+    n = name.lower()
+    return (n.startswith("stg") or n.startswith("acc")
+            or "ring" in n or "staging" in n)
+
+
+def _ring_target(node: ast.AST) -> bool:
+    """True for a subscripted staging-ring / accumulator scratch ref
+    (``stg_v[...]``, ``acc_i[:]``, ``stg[0][:]``)."""
+    if not isinstance(node, ast.Subscript):
+        return False
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    return isinstance(node, ast.Name) and _ringish(node.id)
+
+
+def _is_rogue_sentinel(node: ast.AST) -> bool:
+    """A huge float literal that is not the shared ``_ACC_WORST``."""
+    return (isinstance(node, ast.Constant)
+            and isinstance(node.value, float)
+            and node.value == node.value
+            and abs(node.value) != float("inf")
+            and abs(node.value) >= 1e30
+            and abs(node.value) != _ACC_SENTINEL)
 
 
 def _idish(name: str) -> bool:
@@ -92,6 +146,14 @@ class MaskSeamPass:
             "id arrays are masked with sign tests (tombstones are <= -2,"
             " not -1); Pallas one-hot merges need finite sentinels, "
             "never inf in a product",
+        "staging-ring":
+            "windowed-merge staging rings hold the finite _ACC_WORST "
+            "sentinel: no inf literals or rogue huge-float fills may "
+            "reach a ring/accumulator scratch write",
+        "scratch-budget":
+            "fused scan/hop kernels size VMEM scratch through "
+            "ops.vmem_budget helpers so the merge-window selector and "
+            "the kernel agree on the footprint",
     }
 
     def run(self, project: Project) -> List[Diagnostic]:
@@ -99,9 +161,14 @@ class MaskSeamPass:
         for mod in project.walk("raft_tpu/"):
             pallas = (mod.rel.startswith("raft_tpu/ops/")
                       and mod.rel.endswith("_pallas.py"))
+            fused_mod = mod.rel in _FUSED_MODULES
             for node in ast.walk(mod.tree):
                 if isinstance(node, ast.Compare):
                     self._check_compare(mod, node, out)
+                if pallas and isinstance(node, (ast.Assign, ast.AugAssign)):
+                    self._check_ring_write(mod, node, out)
+                if fused_mod and isinstance(node, ast.Call):
+                    self._check_scratch(mod, node, out)
                 if pallas:
                     if (isinstance(node, ast.BinOp)
                             and isinstance(node.op, (ast.Mult,
@@ -142,3 +209,41 @@ class MaskSeamPass:
                     f"— mask with a sign test (< 0 / >= 0) or clamp "
                     f"through grouped.finalize_topk first"))
                 return
+
+    def _check_ring_write(self, mod, node, out: List[Diagnostic]) -> None:
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        if not any(_ring_target(t) for t in targets):
+            return
+        if contains(node.value, _is_inf):
+            out.append(Diagnostic(
+                mod.rel, node.lineno, "staging-ring",
+                "inf written into a staging-ring/accumulator scratch — "
+                "the next windowed flush multiplies ring rows into the "
+                "one-hot merge (0*inf=NaN); fill with the finite "
+                "_ACC_WORST sentinel"))
+        elif contains(node.value, _is_rogue_sentinel):
+            out.append(Diagnostic(
+                mod.rel, node.lineno, "staging-ring",
+                "non-sentinel huge-float fill at a staging-ring write — "
+                "uncovered ring slots must hold exactly _ACC_WORST "
+                "(3.0e38) so merge liveness tests (< _ACC_WORST/2) and "
+                "the epilogue agree"))
+
+    def _check_scratch(self, mod, node: ast.Call,
+                       out: List[Diagnostic]) -> None:
+        for kw in node.keywords:
+            if kw.arg != "scratch_shapes":
+                continue
+            routed = contains(
+                kw.value,
+                lambda n: (isinstance(n, ast.Call)
+                           and terminal_name(n.func) in _SCRATCH_HELPERS))
+            if not routed:
+                out.append(Diagnostic(
+                    mod.rel, kw.value.lineno, "scratch-budget",
+                    "inline scratch_shapes in a fused kernel module — "
+                    "size scratch through ops.vmem_budget "
+                    "(fused_scan_scratch / hop_scratch) so the "
+                    "merge-window selector and the kernel lowering "
+                    "agree on the VMEM footprint"))

@@ -455,16 +455,17 @@ class TestFusedHop:
     score + dedupe + merge in one VMEM-resident pass, parity with the
     XLA _merge_candidates/_bitonic_merge pair."""
 
-    def _hop_inputs(self):
-        rng = np.random.default_rng(0)
-        nq, itopk, wd, pdim = 5, 16, 24, 16
+    def _hop_inputs(self, seed=0, nq=5, itopk=16, wd=24, pdim=16,
+                    id_hi=40):
+        rng = np.random.default_rng(seed)
         qp = rng.normal(size=(nq, pdim)).astype(np.float32)
         qsq = (rng.random(nq) * 3).astype(np.float32)
         nbp = rng.normal(size=(nq, wd, pdim)).astype(np.float32)
         nbsq = (rng.random((nq, wd)) * 3).astype(np.float32)
-        nbid = rng.integers(0, 40, size=(nq, wd)).astype(np.int32)
+        nbid = rng.integers(0, id_hi, size=(nq, wd)).astype(np.int32)
         nbid[0, :4] = -1                       # masked parent slots
-        nbid[1, 5] = nbid[1, 6]                # self-dup
+        if nq > 1 and wd > 6:
+            nbid[1, 5] = nbid[1, 6]            # self-dup
         # walk invariant: every copy of an id decodes the SAME table
         # row, so dup slots must carry identical (proj, sq) payloads
         for r in range(nq):
@@ -488,9 +489,9 @@ class TestFusedHop:
         bufd[:, itopk - 3:] = np.inf
         bufi = np.zeros((nq, itopk), np.int32)
         for r in range(nq):
-            bufi[r] = np.random.default_rng(r).permutation(100)[:itopk]
-            bufi[r] += 100
-            for slot, j in ((2, 1), (5, 7)):
+            bufi[r] = np.random.default_rng(r).permutation(
+                10 * itopk)[:itopk] + 10 * id_hi
+            for slot, j in ((2, 1), (5, min(7, wd - 1))):
                 if nbid[r, j] >= 0:
                     bufi[r, slot] = nbid[r, j]
                     bufd[r, slot] = d_c[r, j]
@@ -503,15 +504,14 @@ class TestFusedHop:
         vis[bufd == np.inf] = False
         return qp, qsq, nbp, nbsq, nbid, d_c, bufd, bufi, vis, itopk
 
-    def test_merge_parity_with_reference(self):
+    def _assert_hop_parity(self, data, merge_window=1):
         from raft_tpu.ops.cagra_hop_pallas import fused_hop
-        (qp, qsq, nbp, nbsq, nbid, d_c, bufd, bufi, vis,
-         itopk) = self._hop_inputs()
+        qp, qsq, nbp, nbsq, nbid, d_c, bufd, bufi, vis, itopk = data
         fd, fi, fv = fused_hop(
             jnp.asarray(qp), jnp.asarray(qsq), jnp.asarray(nbp),
             jnp.asarray(nbsq), jnp.asarray(nbid), jnp.asarray(bufd),
             jnp.asarray(bufi), jnp.asarray(vis), itopk=itopk,
-            ip_metric=False, interpret=True)
+            ip_metric=False, interpret=True, merge_window=merge_window)
         d_ref = jnp.where(jnp.asarray(nbid) >= 0, jnp.asarray(d_c),
                           jnp.inf)
         rd, ri, rv = cagra._merge_candidates(
@@ -527,6 +527,29 @@ class TestFusedHop:
             np.testing.assert_array_equal(fi[r][finite], ri[r][finite])
             np.testing.assert_array_equal(fv[r][finite], rv[r][finite])
             assert (fi[r][~finite] == -1).all()
+
+    def test_merge_parity_with_reference(self):
+        self._assert_hop_parity(self._hop_inputs())
+
+    @pytest.mark.parametrize("seed,nq,itopk,wd,pdim,mw", [
+        (0, 5, 16, 24, 16, 2),    # staged forced at a legacy shape
+        (1, 7, 64, 64, 32, 0),    # auto -> staged: the itopk-64 lift
+        (2, 16, 64, 96, 64, 2),   # wd > itopk (stage truncation)
+        (3, 3, 48, 32, 64, 2),    # wd < itopk, non-pow2 itopk
+        (4, 1, 64, 48, 16, 2),    # single query
+    ])
+    def test_staged_merge_parity(self, seed, nq, itopk, wd, pdim, mw):
+        """Round-14 staged hop merge (merge_window=2): buffer-membership
+        dedupe + staged extraction + in-kernel bitonic merge must match
+        _merge_candidates exactly, including at itopk 64 — the shape the
+        legacy kernel's VMEM budget rejects.  The planted buffer dups
+        (slots 2/5 carry a candidate's exact key) exercise
+        dedupe-across-window: the kill happens at score time, before
+        the staging buffer ever sees the candidate."""
+        self._assert_hop_parity(
+            self._hop_inputs(seed=seed, nq=nq, itopk=itopk, wd=wd,
+                             pdim=pdim, id_hi=200),
+            merge_window=mw)
 
     def test_fused_walk_matches_reference_walk(self, res, dataset, index):
         db, q = dataset
@@ -570,11 +593,17 @@ class TestFusedHop:
         assert (i >= 0).all() and len(set(i[0])) == 5
 
     def test_supported_hop_gate(self):
-        from raft_tpu.ops.cagra_hop_pallas import supported_hop
+        from raft_tpu.ops.cagra_hop_pallas import (hop_merge_window,
+                                                   supported_hop)
         # serving buckets of 1-64 at low itopk pass
         assert supported_hop(1, 16, 32, 32)
         assert supported_hop(64, 32, 64, 64)
-        # throughput shapes do not
+        # round-14: the staged merge lifts the itopk ceiling to 64 ...
+        assert supported_hop(64, 64, 64, 64)
+        assert hop_merge_window(64, 64, 64, 64) == 2
+        # ... but forcing the legacy per-hop merge keeps the old gate
+        assert not supported_hop(64, 64, 64, 64, merge_window=1)
+        # throughput shapes and itopk past the staged ceiling do not
         assert not supported_hop(5000, 32, 64, 64)
-        assert not supported_hop(64, 64, 64, 64)
+        assert not supported_hop(64, 128, 64, 64)
         assert not supported_hop(64, 16, 256, 64)

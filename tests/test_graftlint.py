@@ -194,6 +194,78 @@ class TestMaskSeam:
                "    return d + mask * jnp.inf\n")
         assert lint({"raft_tpu/ops/foo.py": src}) == []
 
+    def test_inf_at_staging_ring_write_flagged(self):
+        src = ("import jax.numpy as jnp\n"
+               "def kernel(stg_v):\n"
+               "    stg_v[:] = jnp.full(stg_v.shape, jnp.inf, "
+               "jnp.float32)\n")
+        diags = lint({"raft_tpu/ops/foo_pallas.py": src})
+        assert [d.rule for d in diags] == ["staging-ring"]
+        assert "_ACC_WORST" in diags[0].message
+
+    def test_rogue_sentinel_fill_flagged(self):
+        # a huge float that is not the shared 3.0e38 breaks the
+        # liveness test the merge and epilogue share
+        src = ("import jax.numpy as jnp\n"
+               "def kernel(acc_v):\n"
+               "    acc_v[:] = jnp.full(acc_v.shape, 1.0e38, "
+               "jnp.float32)\n")
+        diags = lint({"raft_tpu/ops/foo_pallas.py": src})
+        assert [d.rule for d in diags] == ["staging-ring"]
+        assert "3.0e38" in diags[0].message
+
+    def test_acc_worst_ring_fill_clean(self):
+        src = ("import jax.numpy as jnp\n"
+               "_ACC_WORST = 3.0e38\n"
+               "def kernel(stg_v, acc_i):\n"
+               "    stg_v[:] = jnp.full(stg_v.shape, _ACC_WORST, "
+               "jnp.float32)\n"
+               "    acc_i[:] = jnp.full(acc_i.shape, -1.0, "
+               "jnp.float32)\n")
+        assert lint({"raft_tpu/ops/foo_pallas.py": src}) == []
+
+    def test_ring_rule_scoped_to_pallas(self):
+        # plain ops modules stage with inf freely (no one-hot merge)
+        src = ("import jax.numpy as jnp\n"
+               "def f(stg_v):\n"
+               "    stg_v[:] = jnp.full(stg_v.shape, jnp.inf, "
+               "jnp.float32)\n")
+        assert lint({"raft_tpu/ops/foo.py": src}) == []
+
+    def test_inline_scratch_in_fused_module_flagged(self):
+        src = ("import jax.experimental.pallas as pl\n"
+               "def run(kern, tpu):\n"
+               "    return pl.pallas_call(\n"
+               "        kern,\n"
+               "        scratch_shapes=[tpu.VMEM((8, 128), 'float32')],\n"
+               "    )\n")
+        diags = lint(
+            {"raft_tpu/ops/pq_group_scan_pallas.py": src})
+        assert [d.rule for d in diags] == ["scratch-budget"]
+        assert "vmem_budget" in diags[0].message
+
+    def test_budgeted_scratch_clean(self):
+        src = ("import jax.experimental.pallas as pl\n"
+               "from raft_tpu.ops import vmem_budget as vb\n"
+               "def run(kern, k, kt, mw, nq_pad):\n"
+               "    return pl.pallas_call(\n"
+               "        kern,\n"
+               "        scratch_shapes=vb.fused_scan_scratch(k, kt, mw, "
+               "nq_pad),\n"
+               "    )\n")
+        assert lint(
+            {"raft_tpu/ops/pq_group_scan_pallas.py": src}) == []
+
+    def test_scratch_rule_scoped_to_fused_modules(self):
+        # other kernels (kmeans, top-k) size scratch however they like
+        src = ("import jax.experimental.pallas as pl\n"
+               "def run(kern, tpu):\n"
+               "    return pl.pallas_call(\n"
+               "        kern,\n"
+               "        scratch_shapes=[tpu.VMEM((8, 128), 'float32')],\n"
+               "    )\n")
+        assert lint({"raft_tpu/ops/kmeans_update_pallas.py": src}) == []
+
 
 # ---------------------------------------------------------------------------
 # boundary-guard
@@ -543,7 +615,8 @@ class TestLiveTree:
     def test_rule_catalogue_complete(self):
         assert {"recompile-hazard", "generation-discipline", "mask-seam",
                 "boundary-guard", "raw-perf-counter", "bare-sleep",
-                "registry-consistency"} <= set(rule_docs())
+                "registry-consistency", "staging-ring",
+                "scratch-budget"} <= set(rule_docs())
 
 
 # ---------------------------------------------------------------------------

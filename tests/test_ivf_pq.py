@@ -536,13 +536,20 @@ class TestFusedScan:
         index = ivf_pq.build(res, params, db)
         obs.enable()
         try:
-            c0 = obs.registry().counter("ivf_pq.search.fused_fallback").value
+            reg = obs.registry()
+            c0 = reg.counter("ivf_pq.search.fused_fallback").value
+            r0 = reg.counter(
+                "ivf_pq.search.fused_fallback.reason.backend").value
             sp = ivf_pq.SearchParams(n_probes=8, scan_mode="fused")
             ivf_pq.search(res, sp, index, q, 10)
-            c1 = obs.registry().counter("ivf_pq.search.fused_fallback").value
+            c1 = reg.counter("ivf_pq.search.fused_fallback").value
+            r1 = reg.counter(
+                "ivf_pq.search.fused_fallback.reason.backend").value
         finally:
             obs.disable()
         assert c1 == c0 + 1
+        # round-14 reason codes: off-TPU misses attribute to "backend"
+        assert r1 == r0 + 1
 
     def test_fused_supported_at_flagship_shape(self):
         """Static tripwire: the fused kernels must accept the flagship
@@ -557,6 +564,163 @@ class TestFusedScan:
         assert pcs.supported_fused_codes(True, True, cap, 128, 16, 10,
                                          5000, 64, 8)
         assert pqp.supported_fused(True, cap, 128, 16, 10, 5000)
+
+
+@pytest.fixture(scope="module")
+def ring_case():
+    """Tiny synthetic geometry for staging-ring semantics: 7 lists of
+    capacity 32 with the last 5 rows of every list tombstoned (id -1),
+    9 queries x 3 probes.  n_groups is deliberately coprime with every
+    tested W, so each sweep crosses a partial final window, and k=256
+    exceeds the live candidate pool, so the accumulator tail stays
+    all-sentinel through entire windows."""
+    from raft_tpu.neighbors import grouped
+
+    rng = np.random.default_rng(0)
+    n_lists, cap, rot, nq, n_probes = 7, 32, 128, 9, 3
+    probes = np.stack([rng.choice(n_lists, size=n_probes, replace=False)
+                       for _ in range(nq)]).astype(np.int32)
+    n_groups, _ = grouped.group_capacity(nq, n_probes, n_lists)
+    gl, sp = grouped.build_groups(jnp.asarray(probes), n_lists, n_groups)
+    qrot = rng.standard_normal((nq, rot)).astype(np.float32)
+    centers = rng.standard_normal((n_lists, rot)).astype(np.float32)
+    recon = jnp.asarray(
+        rng.standard_normal((n_lists, cap, rot)).astype(np.float32),
+        jnp.bfloat16)
+    rsq = jnp.sum(jnp.asarray(recon, jnp.float32) ** 2, axis=-1)
+    ids = rng.integers(0, 1 << 20, size=(n_lists, cap)).astype(np.int32)
+    ids[:, -5:] = -1
+    return dict(gl=gl, sp=sp, qrot=jnp.asarray(qrot),
+                centers=jnp.asarray(centers), recon=recon, rsq=rsq,
+                ids=jnp.asarray(ids), ids_np=ids, kt=8,
+                n_probes=n_probes, nq=nq, P=nq * n_probes)
+
+
+class TestWindowedMerge:
+    """Round-14 windowed fused-scan merge: a VMEM staging ring defers
+    the (k x k+kt) merge to every W-th grid step.  The contract is
+    bit-identity with the round-7 per-step merge (W=1): VALUES bit-equal
+    at every rank, IDS bit-equal at every live rank (exhausted ranks
+    all carry the sentinel value, so their relative id order is
+    unspecified; the epilogue maps every such rank to +inf / -1)."""
+
+    def _run(self, c, k, W):
+        from raft_tpu.ops import pq_group_scan_pallas as pqp
+
+        v, i = pqp.grouped_l2_scan_fused(
+            c["gl"], c["sp"], c["qrot"], c["centers"], c["recon"],
+            c["rsq"], c["ids"], c["kt"], k, c["n_probes"],
+            interpret=True, merge_window=W)
+        return np.asarray(v), np.asarray(i)
+
+    def test_bit_identity_across_windows(self, ring_case):
+        from raft_tpu.ops import pq_group_scan_pallas as pqp
+
+        base_v, base_i = self._run(ring_case, 10, 1)
+        live = base_v < pqp._ACC_WORST / 2
+        for W in (2, 3, 8):            # none divide n_groups
+            v, i = self._run(ring_case, 10, W)
+            np.testing.assert_array_equal(base_v, v)
+            np.testing.assert_array_equal(base_i[live], i[live])
+
+    @pytest.mark.parametrize("k", [128, 256])
+    def test_large_k_windowed_matches_reference(self, ring_case, k):
+        """k past the unrolled-merge ceiling takes the fori-loop merge.
+        Windowed runs must agree with each other bit-for-bit and with
+        the non-fused kernel + host-side sort at matched kt."""
+        from raft_tpu.neighbors import grouped
+        from raft_tpu.ops import pq_group_scan_pallas as pqp
+
+        c = ring_case
+        av, ai = self._run(c, k, 2)
+        bv, bi = self._run(c, k, 5)
+        live = av < pqp._ACC_WORST / 2
+        np.testing.assert_array_equal(av, bv)
+        np.testing.assert_array_equal(ai[live], bi[live])
+        nv, ni = pqp.grouped_l2_scan(
+            c["gl"], c["sp"], c["qrot"], c["centers"], c["recon"],
+            c["rsq"], c["ids"], c["kt"], c["n_probes"], interpret=True)
+        outd, outi = grouped.scatter_packed(nv, ni, c["sp"], c["P"],
+                                            True)
+        outd, outi = np.asarray(outd), np.asarray(outi)
+        npb = c["n_probes"]
+        for q in range(c["nq"]):
+            cd = outd[q * npb:(q + 1) * npb].reshape(-1)
+            ci = outi[q * npb:(q + 1) * npb].reshape(-1)
+            fin = np.isfinite(cd)
+            order = np.argsort(cd[fin], kind="stable")
+            ref_d, ref_i = cd[fin][order][:k], ci[fin][order][:k]
+            good = av[:k, q] < pqp._ACC_WORST / 2
+            np.testing.assert_array_equal(av[:k, q][good],
+                                          ref_d[:good.sum()])
+            np.testing.assert_array_equal(ai[:k, q][good],
+                                          ref_i[:good.sum()])
+            assert good.sum() == min(k, fin.sum())
+
+    def test_tombstones_never_surface_through_staging_ring(self,
+                                                           ring_case):
+        """The last 5 rows of every list carry id -1 (the tombstone /
+        integrity-mask contract): the ring's sentinel fill must never
+        resurrect them at any W, and exhausted ranks must come back as
+        sentinel-value / -1 pairs — never a live value with a stale
+        id left over from a previous window."""
+        from raft_tpu.ops import pq_group_scan_pallas as pqp
+
+        ids_np = ring_case["ids_np"]
+        alive = set(ids_np[ids_np >= 0].tolist())
+        for W in (1, 4):
+            v, i = self._run(ring_case, 64, W)
+            live = v < pqp._ACC_WORST / 2
+            # the raw kernel output predates the epilogue, so only live
+            # ranks carry a contract: a real (non-tombstoned) id, never
+            # the -1 ring fill
+            assert (i[live] >= 0).all()
+            assert all(int(x) in alive for x in i[live])
+
+    def test_fused_codes_windowed_large_k(self, scan_index):
+        """Codes-kernel staging ring at k=128: windowed merge is
+        bit-identical to the per-step merge and lands the same
+        candidates as the non-fused codes path at matched kt."""
+        q, built = scan_index
+        index, probes, ng, _, _ = built[8]
+        args = (index.centers, index.codebooks, index.list_code_lanes,
+                index.list_code_rsq, index.list_indices, index.rotation,
+                q, probes, 128, 4, index.metric, ng, index.pq_bits)
+        f1d, f1i = ivf_pq._search_impl_fused_codes_grouped(
+            *args, pallas_interpret=True, merge_window=1)
+        f4d, f4i = ivf_pq._search_impl_fused_codes_grouped(
+            *args, pallas_interpret=True, merge_window=4)
+        f1d, f1i = np.asarray(f1d), np.asarray(f1i)
+        f4d, f4i = np.asarray(f4d), np.asarray(f4i)
+        np.testing.assert_array_equal(f1d, f4d)
+        fin = np.isfinite(f1d)
+        np.testing.assert_array_equal(f1i[fin], f4i[fin])
+        rd, ri = ivf_pq._search_impl_codes_grouped(
+            *args, pallas_interpret=True)
+        rd, ri = np.asarray(rd), np.asarray(ri)
+        both = fin & np.isfinite(rd)
+        np.testing.assert_allclose(f4d[both], rd[both], rtol=1e-4,
+                                   atol=1e-4)
+        # k=128 exceeds the kt=4 candidate pool (8 probes x 4), so both
+        # paths keep EVERY candidate: the finite id sets match exactly
+        for r in range(f4i.shape[0]):
+            assert (set(f4i[r][f4i[r] >= 0].tolist())
+                    == set(ri[r][ri[r] >= 0].tolist()))
+
+    def test_xla_twin_windowed_scatter_matches(self, scan_index):
+        """grouped.scan_and_scatter's merge_window (the AOT export's
+        XLA twin of the staging ring) defers the scatter to one pass
+        per W blocks; the scatter is idempotent over disjoint slots, so
+        every W must reproduce the unwindowed result exactly."""
+        q, built = scan_index
+        index, probes, ng, rd, ri = built[8]
+        for W in (1, 3):
+            wd_, wi_ = ivf_pq._search_impl_recon_grouped(
+                index.centers, index.list_recon, index.list_recon_sq,
+                index.list_indices, index.rotation, q, probes, 10,
+                index.metric, ng, 64, merge_window=W)
+            np.testing.assert_array_equal(np.asarray(wd_), rd)
+            np.testing.assert_array_equal(np.asarray(wi_), ri)
 
 
 class TestListDataHelpers:
