@@ -1475,6 +1475,247 @@ def run_ingest(conf_path: str) -> int:
     return 1 if failures else 0
 
 
+def bench_quality(res, db, queries, *, build_param=None, search_param=None,
+                  k=SERVING_K, max_batch=SERVING_MAX_BATCH,
+                  max_wait_us=1000.0, clients=8, request_rows=32,
+                  duration_s=2.0, sample_rows_per_s=512.0,
+                  burst_rows=1024.0, shadow_max_batch=64,
+                  recall_floor=None, op_log_path=None) -> list:
+    """Shadow-replay quality monitoring over the closed serving loop.
+
+    Runs the bench_serving closed loop TWICE — shadow monitor attached
+    but disabled, then enabled (same server, same warmed executables, so
+    the A/B isolates the sampling + replay cost) — and emits the QPS
+    ratio as ``quality_shadow_overhead`` (CI fails the smoke above the
+    conf's ``max_shadow_overhead``).  The enabled arm must produce at
+    least one live recall estimate with a Wilson interval
+    (``quality_live_recall``), add zero steady-state recompiles (the
+    shadow executor pre-warms its own bucket set at the ground-truth
+    operating point during ``Server.start()``), and append operating
+    points that :func:`raft_tpu.observability.quality.
+    read_operating_points` parses back into the calibrator-table shape
+    (``quality_op_log``).
+    """
+    import tempfile
+    import threading
+
+    from raft_tpu import observability as obs
+    from raft_tpu import serving
+    from raft_tpu.neighbors import ivf_pq
+    from raft_tpu.observability import quality as _quality
+
+    bp = build_param or {"nlist": 256, "pq_dim": 32}
+    spc = search_param or {"nprobe": 8}
+    index = ivf_pq.build(
+        res, ivf_pq.IndexParams(n_lists=bp["nlist"], pq_dim=bp["pq_dim"],
+                                kmeans_n_iters=bp.get("kmeans_n_iters", 4)),
+        db)
+    sp = ivf_pq.SearchParams(n_probes=spc["nprobe"],
+                             scan_mode=spc.get("scan_mode", "auto"),
+                             per_probe_topk=spc.get("per_probe_topk", 0))
+    q = np.asarray(queries)
+    reps = int(np.ceil(max_batch / q.shape[0])) if q.shape[0] < max_batch \
+        else 1
+    if reps > 1:
+        q = np.concatenate([q] * reps)
+    if op_log_path is None:
+        op_log_path = os.path.join(tempfile.mkdtemp(prefix="raft-tpu-oplog-"),
+                                   "oplog.jsonl")
+
+    out = []
+    with obs.collecting():
+        ex = serving.Executor(res, "ivf_pq", index, ks=(k,),
+                              max_batch=max_batch, search_params=sp)
+        monitor = serving.ShadowMonitor(serving.ShadowConfig(
+            sample_rows_per_s=sample_rows_per_s, burst_rows=burst_rows,
+            max_batch=shadow_max_batch,
+            # flush manually at arm boundaries, not mid-measurement
+            window_s=3600.0,
+            recall_floor=recall_floor, op_log_path=op_log_path))
+        cfg = serving.ServerConfig(max_batch=max_batch,
+                                   max_wait_us=max_wait_us,
+                                   max_queue_rows=max_batch * 16)
+        srv = serving.Server(ex, cfg)
+        srv.attach_shadow(monitor)
+        srv.start()
+        compiles = obs.registry().counter("xla.compiles")
+        try:
+            # ramp: settle one-time compiles on the live path AND one
+            # shadow replay per bucket the sampler will see, then drain
+            # the backlog before fencing the compile count
+            for m in (1, request_rows, max_batch):
+                srv.search(q[:m], k)
+            stop_at = time.perf_counter() + 15.0
+            while (monitor.stats()["backlog"]
+                   and time.perf_counter() < stop_at):
+                time.sleep(0.02)
+            time.sleep(0.1)           # let an in-flight replay land
+            c0 = compiles.value
+
+            def closed_loop():
+                done = [0] * clients
+                stop_loop = time.perf_counter() + duration_s
+
+                def client(j):
+                    base = (j * 131) % max(1, q.shape[0] - request_rows)
+                    sub = q[base:base + request_rows]
+                    while time.perf_counter() < stop_loop:
+                        srv.search(sub, k)
+                        done[j] += sub.shape[0]
+
+                ts = [threading.Thread(target=client, args=(j,))
+                      for j in range(clients)]
+                t0 = time.perf_counter()
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                return sum(done) / (time.perf_counter() - t0)
+
+            # ---- arm A: shadow disabled (one flag check per batch) ---
+            monitor.disable()
+            qps_off = closed_loop()
+            # ---- arm B: shadow sampling + replaying ------------------
+            monitor.enable()
+            qps_on = closed_loop()
+            stop_at = time.perf_counter() + 15.0
+            while (monitor.stats()["backlog"]
+                   and time.perf_counter() < stop_at):
+                time.sleep(0.02)
+            time.sleep(0.1)
+            recompiles = int(compiles.value - c0)
+            overall = monitor.estimator.estimate()
+            records = monitor.flush()
+            snap = obs.snapshot()
+        finally:
+            srv.stop()
+        counters = snap.get("counters", {})
+        warmed = snap.get("gauges", {}).get(
+            "serving.shadow.warmed_executables")
+
+    points = _quality.read_operating_points(op_log_path)
+    table = _quality.calibrator_table(points)
+
+    frac = qps_on / max(qps_off, 1e-9)
+    out.append({
+        "metric": "quality_shadow_overhead",
+        "value": round(max(1.0 - frac, 0.0), 4),
+        "unit": "fraction",
+        "vs_baseline": round(frac, 3),
+        "detail": {
+            "qps_shadow_off": round(qps_off, 1),
+            "qps_shadow_on": round(qps_on, 1),
+            "fraction_of_unshadowed": round(frac, 3),
+            "recompiles_steady": recompiles,
+            "warmed_executables": warmed,
+            "sampled_rows": counters.get("serving.shadow.sampled", 0),
+            "replayed_rows": counters.get("serving.shadow.replayed", 0),
+            "skipped_budget_rows":
+                counters.get("serving.shadow.skipped.budget", 0),
+            "dropped_backlog":
+                counters.get("serving.shadow.dropped.backlog", 0),
+            "dropped_generation":
+                counters.get("serving.shadow.dropped.generation", 0),
+        },
+    })
+    est = overall.as_dict() if overall is not None else None
+    out.append({
+        "metric": "quality_live_recall",
+        "value": round(est["recall"], 4) if est else -1.0,
+        "unit": f"recall@{k}",
+        "vs_baseline": round(est["lo"], 4) if est else -1.0,
+        "detail": {
+            "estimate": est,
+            "windows": len(records),
+            "degraded_windows": sum(1 for r in records if r["degraded"]),
+            "floor": records[0]["floor"] if records else None,
+        },
+    })
+    out.append({
+        "metric": "quality_op_log",
+        "value": float(len(points)),
+        "unit": "points",
+        "vs_baseline": 1.0,
+        "detail": {
+            "path": op_log_path,
+            "calibrator_rows": len(table),
+            "knob_keys": sorted(points[0].knobs) if points else [],
+            "measured_keys": sorted(points[0].measured) if points else [],
+        },
+    })
+    return out
+
+
+def run_quality(conf_path: str) -> int:
+    """``--quality`` mode: the CI quality smoke.  Builds the conf's
+    dataset + index, runs :func:`bench_quality`, and FAILS (exit 1) on
+    shadow overhead above ``max_shadow_overhead``, any steady-state
+    recompile, a missing recall estimate / malformed Wilson interval,
+    or an operating-point log that doesn't parse back."""
+    from raft_tpu import DeviceResources
+    from raft_tpu.observability import flight as _flight
+
+    with open(conf_path) as f:
+        conf = json.load(f)
+    res = DeviceResources(seed=0)
+    db, queries = _make_dataset(conf["dataset"])
+    g = conf["quality"]
+    lines = bench_quality(
+        res, db, queries,
+        build_param=g.get("build_param"),
+        search_param=g.get("search_param"),
+        k=g.get("k", SERVING_K),
+        max_batch=g.get("max_batch", SERVING_MAX_BATCH),
+        max_wait_us=g.get("max_wait_us", 1000.0),
+        clients=g.get("clients", 8),
+        request_rows=g.get("request_rows", 32),
+        duration_s=g.get("duration_s", 2.0),
+        sample_rows_per_s=g.get("sample_rows_per_s", 512.0),
+        burst_rows=g.get("burst_rows", 1024.0),
+        shadow_max_batch=g.get("shadow_max_batch", 64),
+        recall_floor=g.get("recall_floor"),
+        op_log_path=g.get("op_log_path"))
+    for line in lines:
+        _emit(line)
+    by = {ln["metric"]: ln for ln in lines}
+    failures = []
+    ov = by["quality_shadow_overhead"]
+    max_overhead = g.get("max_shadow_overhead", 0.05)
+    if ov["detail"]["fraction_of_unshadowed"] < 1.0 - max_overhead:
+        failures.append(
+            f"shadow-enabled QPS is "
+            f"{ov['detail']['fraction_of_unshadowed']:.2f}x the disabled "
+            f"loop (bar: {1.0 - max_overhead:.2f}x)")
+    if ov["detail"]["recompiles_steady"] != 0:
+        failures.append(
+            f"{ov['detail']['recompiles_steady']} XLA recompiles in "
+            "steady state (the shadow executor must pre-warm its bucket "
+            "set at the ground-truth operating point)")
+    if not ov["detail"]["replayed_rows"]:
+        failures.append("shadow replayed zero rows — the sampler never "
+                        "fed the replay thread")
+    est = by["quality_live_recall"]["detail"]["estimate"]
+    if est is None or est["rows"] < 1:
+        failures.append("no live recall estimate produced")
+    elif not (0.0 <= est["lo"] <= est["recall"] <= est["hi"] <= 1.0):
+        failures.append(
+            f"malformed Wilson interval: lo={est['lo']} "
+            f"recall={est['recall']} hi={est['hi']}")
+    op = by["quality_op_log"]
+    if op["value"] < 1 or op["detail"]["calibrator_rows"] < 1:
+        failures.append(
+            "operating-point log did not round-trip: "
+            f"{int(op['value'])} points parsed, "
+            f"{op['detail']['calibrator_rows']} calibrator rows")
+    for msg in failures:
+        print(f"QUALITY SMOKE FAIL: {msg}", flush=True)
+    if failures:
+        dumped = _flight.maybe_auto_dump("quality_smoke_failure")
+        if dumped:
+            print(f"flight dump: {dumped}", flush=True)
+    return 1 if failures else 0
+
+
 MUTATION_CHURN = 0.01          # writer deletes AND extends 1% per cycle
 
 
@@ -2133,6 +2374,12 @@ if __name__ == "__main__":
                 os.path.join(os.path.dirname(__file__), "conf",
                              "overload-smoke.json")
             sys.exit(run_overload(conf))
+        elif len(sys.argv) >= 2 and sys.argv[1] == "--quality":
+            _setup_jax_cache()
+            conf = sys.argv[2] if len(sys.argv) >= 3 else \
+                os.path.join(os.path.dirname(__file__), "conf",
+                             "quality-smoke.json")
+            sys.exit(run_quality(conf))
         elif len(sys.argv) >= 2 and sys.argv[1] == "--ingest":
             _setup_jax_cache()
             conf = sys.argv[2] if len(sys.argv) >= 3 else \

@@ -682,6 +682,27 @@ def _dist_search_lut(index_leaves, queries, k, n_probes, metric,
     return run(index_leaves, queries)
 
 
+def ground_truth_params(index, params=None) -> ivf_pq.SearchParams:
+    """The ground-truth operating point for a sharded index — every
+    coarse list probed (per shard for the stacked placement, globally
+    for ``by_list``), exact coarse ranking, no per-probe candidate
+    truncation.  The shadow-replay quality monitor
+    (:mod:`raft_tpu.serving.shadow`) searches at this point through the
+    SAME placement map as live traffic to estimate live recall.
+
+    ``scan_mode`` is pinned to ``"lut"`` (not ``"auto"``): the fused
+    ladder's VMEM gates can refuse at full-probe shapes, and the
+    resulting ``ivf_pq.search.fused_fallback`` ticks would pollute the
+    drift detector's steady-state-fallback check with the monitor's own
+    traffic."""
+    routed = isinstance(index, RoutedIndex)
+    n_lists = int(index.n_lists if routed else index.centers.shape[1])
+    base = params if params is not None else ivf_pq.SearchParams()
+    return dataclasses.replace(base, n_probes=n_lists, scan_mode="lut",
+                               per_probe_topk=0, exact_coarse=True,
+                               use_reconstruction=None)
+
+
 def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
            failed_shards: Sequence[int] = (),
            return_status: bool = False,

@@ -172,6 +172,18 @@ def health_check(res, index, *, raise_on_fail: bool = True
     return report
 
 
+def floor_of(index) -> Optional[float]:
+    """The index's stored canary acceptance floor, or None for a
+    canary-less index.  The live quality monitor
+    (:mod:`raft_tpu.serving.shadow`) reuses it as the default degraded
+    threshold for shadow-replay recall — build-time and live quality
+    share ONE contract, declared once at build."""
+    cs = getattr(index, "canaries", None)
+    if cs is None:
+        return None
+    return float(cs.floor)
+
+
 def auto_check(res, index, *, site: str) -> None:
     """The post-``load()`` / ``extend()`` / ``resume`` hook: a no-op for
     canary-less indexes, an :class:`IntegrityError` for a failing one."""

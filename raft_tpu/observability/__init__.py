@@ -42,6 +42,19 @@ from raft_tpu.observability.stage import fence, stage
 from raft_tpu.observability.export import to_json, to_prometheus
 from raft_tpu.observability.report import BuildReport, build_report, build_scope
 from raft_tpu.observability import flight
+from raft_tpu.observability import quality
+from raft_tpu.observability.quality import (
+    DriftDetector,
+    DriftFinding,
+    DriftThresholds,
+    OperatingPointLog,
+    OpPoint,
+    RecallEstimate,
+    RecallEstimator,
+    calibrator_table,
+    read_operating_points,
+    wilson_interval,
+)
 from raft_tpu.observability import trace
 from raft_tpu.observability.trace import (
     Span,
@@ -63,10 +76,18 @@ __all__ = [
     "WINDOW_INTERVAL_S",
     "WINDOW_SLOTS",
     "BuildReport",
+    "DriftDetector",
+    "DriftFinding",
+    "DriftThresholds",
+    "OperatingPointLog",
+    "OpPoint",
+    "RecallEstimate",
+    "RecallEstimator",
     "Span",
     "SpanRecorder",
     "build_report",
     "build_scope",
+    "calibrator_table",
     "collecting",
     "disable",
     "disable_tracing",
@@ -75,6 +96,8 @@ __all__ = [
     "enabled",
     "fence",
     "flight",
+    "quality",
+    "read_operating_points",
     "registry",
     "reset",
     "snapshot",
@@ -85,4 +108,5 @@ __all__ = [
     "trace",
     "tracing",
     "tracing_scope",
+    "wilson_interval",
 ]

@@ -196,8 +196,17 @@ def _env_capacity() -> int:
 
 _RECORDER = FlightRecorder(_env_capacity())
 
-#: env var naming the auto-dump destination (CI sets it; see test.yml)
+#: env var naming the auto-dump destination (CI sets it; see test.yml).
+#: A FILE path is overwritten in place (the original contract); a
+#: DIRECTORY (existing, or a trailing separator) rotates
+#: ``flight-NNNNNN.json`` dumps, keeping the newest ``DUMP_KEEP_ENV``
+#: (default 5) — repeated failures no longer clobber the first, usually
+#: most interesting, dump.
 DUMP_ENV = "RAFT_TPU_FLIGHT_DUMP"
+
+#: env var bounding how many rotated dumps a directory destination keeps
+DUMP_KEEP_ENV = "RAFT_TPU_FLIGHT_DUMP_KEEP"
+DEFAULT_DUMP_KEEP = 5
 
 
 def recorder() -> FlightRecorder:
@@ -239,7 +248,41 @@ def maybe_auto_dump(reason: str) -> Optional[str]:
     if not path:
         return None
     try:
+        if os.path.isdir(path) or path.endswith(os.sep):
+            return _rotated_dump(path, reason)
         _RECORDER.dump(path, reason=reason)
         return path
     except OSError:
         return None
+
+
+def _dump_seq(name: str) -> Optional[int]:
+    if not (name.startswith("flight-") and name.endswith(".json")):
+        return None
+    seq = name[len("flight-"):-len(".json")]
+    return int(seq) if seq.isdigit() else None
+
+
+def _rotated_dump(d: str, reason: str) -> str:
+    """Directory-mode auto-dump: write ``flight-NNNNNN.json`` with the
+    next sequence number (no clock — deterministic, collision-free
+    within a process tree sharing the directory via the max scan) and
+    prune the oldest beyond the keep bound."""
+    os.makedirs(d, exist_ok=True)
+    seqs = sorted(s for s in (_dump_seq(n) for n in os.listdir(d))
+                  if s is not None)
+    path = os.path.join(d, f"flight-{(seqs[-1] + 1 if seqs else 0):06d}.json")
+    _RECORDER.dump(path, reason=reason)
+    try:
+        keep = max(1, int(os.environ.get(DUMP_KEEP_ENV,
+                                         DEFAULT_DUMP_KEEP)))
+    except ValueError:
+        keep = DEFAULT_DUMP_KEEP
+    stale = sorted(s for s in (_dump_seq(n) for n in os.listdir(d))
+                   if s is not None)[:-keep]
+    for s in stale:
+        try:
+            os.remove(os.path.join(d, f"flight-{s:06d}.json"))
+        except OSError:
+            pass          # a concurrent prune already took it
+    return path

@@ -89,6 +89,11 @@ from raft_tpu.serving.rebalancer import (  # noqa: F401
     rebalance_routed,
 )
 from raft_tpu.serving.server import Server, ServerConfig  # noqa: F401
+from raft_tpu.serving.shadow import (  # noqa: F401
+    ShadowConfig,
+    ShadowMonitor,
+    ground_truth_search_params,
+)
 
 __all__ = [
     "AdmissionQueue",
@@ -110,10 +115,13 @@ __all__ = [
     "Request",
     "Server",
     "ServerConfig",
+    "ShadowConfig",
+    "ShadowMonitor",
     "TokenBucket",
     "WriteAheadLog",
     "bucket_for",
     "bucket_sizes",
+    "ground_truth_search_params",
     "pad_rows",
     "valid_rows_mask",
 ]

@@ -59,6 +59,9 @@ class DynamicBatcher:
         self._on_batch = on_batch
         self._on_error = on_error
         self.brownout = brownout
+        # live quality monitor (serving.shadow) — set by Server.start();
+        # None costs one check per dispatched batch
+        self.shadow = None
         self._stop = False
         self._thread: Optional[threading.Thread] = None
 
@@ -185,6 +188,9 @@ class DynamicBatcher:
             buf[off:off + r.n] = np.asarray(r.queries)
             off += r.n
         t_exec0 = time.monotonic()
+        # the generation snapshot this batch serves from — pinned here so
+        # the shadow monitor can refuse to compare across a swap
+        idx_gen = self.executor.index
         try:
             # named fault site: latency plans here (faults.delay_at) are
             # how the chaos bench/CI slow the serving path down on
@@ -244,6 +250,11 @@ class DynamicBatcher:
                 rt.span("serving.result_slice", t_done, t_sliced)
                 _flight.record_trace(rt.close(t_sliced))
             r.future.set_result((rd, ri))
+        sh = self.shadow
+        if sh is not None:
+            # host-side arrays only — the sampler must add no device
+            # work to this thread (see ShadowMonitor.offer)
+            sh.offer(results, k, idx_gen, rung)
         if self._on_batch is not None:
             self._on_batch(n, bucket)
 

@@ -265,6 +265,13 @@ class BrownoutController:
                              from_level=old, to_level=new_level,
                              rung=rung.name, p99_s=p99,
                              queue_rows=queue_rows, window_sheds=sheds)
+        shadow = getattr(self.server, "shadow", None)
+        if shadow is not None:
+            # close the quality window at the rung boundary so one
+            # operating-point record never pools samples served at two
+            # different operating points (flag only — the flush itself
+            # runs on the shadow thread, never under this lock)
+            shadow.mark_transition()
 
     # ---- background loop -------------------------------------------------
 

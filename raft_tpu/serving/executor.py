@@ -338,6 +338,36 @@ class Executor:
     def pad(self, queries, bucket: int):
         return pad_rows(queries, bucket)
 
+    # ---- introspection --------------------------------------------------
+
+    def operating_knobs(self, rung: int = 0) -> Dict[str, object]:
+        """The closed-shape coordinates this executor serves ``rung``
+        at — the knob half of an operating-point record (see
+        :class:`raft_tpu.observability.quality.OpPoint`).  Keys absent
+        from the rung's SearchParams come back None (e.g. brute force
+        has no probes)."""
+        expects(0 <= rung < self.n_rungs,
+                f"serving: rung {rung} outside the declared ladder "
+                f"(n_rungs={self.n_rungs})")
+        params = self._rung_params[rung]
+        # a None rung inherits the previous rung's params (the shed-only
+        # ladder idiom) — walk back to the operative point
+        r = rung
+        while params is None and r > 0:
+            r -= 1
+            params = self._rung_params[r]
+        mw = getattr(params, "merge_window", None)
+        return {
+            "kind": self.kind,
+            "bucket": self.max_batch,
+            "rung": int(rung),
+            "n_probes": getattr(params, "n_probes", None),
+            "scan_mode": getattr(params, "scan_mode", None),
+            "kt": getattr(params, "per_probe_topk", None),
+            "merge_window": mw if isinstance(mw, (int, str,
+                                                  type(None))) else str(mw),
+        }
+
 
 class DistributedExecutor(Executor):
     """Executor over a :mod:`raft_tpu.distributed.ann` sharded index —

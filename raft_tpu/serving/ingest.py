@@ -99,6 +99,7 @@ from raft_tpu.neighbors import delta as _delta
 from raft_tpu.neighbors import ivf_flat, ivf_pq
 from raft_tpu.neighbors import mutate as _mutate
 from raft_tpu.observability import flight as _flight
+from raft_tpu.observability import trace as _trace
 from raft_tpu.resilience import faults
 from raft_tpu.resilience.checkpoint import CheckpointManager, atomic_write
 from raft_tpu.serving.admission import (
@@ -424,6 +425,14 @@ class IngestServer:
         expects(self._recovered,
                 "ingest: recover() must run before the first write")
         t0 = self._clock()
+        # per-write trace (PR 11 parity with the read path): adopt an
+        # ambient recorder when the caller already minted one, else mint
+        # a root here so ingest requests produce full Chrome-trace
+        # chains.  One flag check when tracing is off.
+        rt = _trace.current()
+        minted = rt is None and _trace.tracing()
+        if minted:
+            rt = _trace.start_request("serving.ingest.request")
         opcode = _OPS.get(op)
         expects(opcode is not None,
                 f"ingest: op must be 'upsert' or 'delete', got {op!r}")
@@ -440,9 +449,22 @@ class IngestServer:
         else:
             expects(vectors is None, "ingest: delete takes no vectors")
             vecs = None
-        self._admit(int(ids.size), tenant, opcode)
+        if rt is not None:
+            rt.annotate("tenant", tenant)
+            rt.annotate("op", op)
+            rt.annotate("rows", int(ids.size))
+        try:
+            self._admit(int(ids.size), tenant, opcode)
+        except Overloaded:
+            if minted:
+                # shed at the door: the trace still lands in the flight
+                # recorder, same contract as a shed read submit
+                rt.annotate("shed", True)
+                _flight.record_trace(rt.close())
+            raise
         with self._lock:
             lsn = self._lsn + 1
+            t_append = _trace.now() if rt is not None else 0.0
             self._wal.append(encode_record(lsn, opcode, ids, vecs))
             self._lsn = lsn
             _count("serving.ingest.appended")
@@ -452,15 +474,27 @@ class IngestServer:
             # visibility is decoupled from durability; the ack below
             # still waits for the fsync.
             faults.maybe_fail("ingest.apply")
+            if rt is not None:
+                t_apply = _trace.now()
+                rt.span("serving.ingest.append", t_append, t_apply,
+                        lsn=lsn, rows=int(ids.size))
             self.memtable.apply(_delta.Record(lsn=lsn, op=opcode, ids=ids,
                                               vectors=vecs))
+            if rt is not None:
+                rt.span("serving.ingest.apply", t_apply, _trace.now())
             if obs.enabled():
                 obs.registry().histogram(
                     "serving.ingest.visibility").observe(self._clock() - t0)
+        t_sync = _trace.now() if rt is not None else 0.0
         self._sync_upto(lsn)
         _count("serving.ingest.acked")
         _gauge("serving.ingest.wal_bytes", self._wal.size_bytes)
         _gauge("serving.ingest.memtable_rows", self.memtable.live_rows)
+        if rt is not None:
+            rt.span("serving.ingest.fsync", t_sync, _trace.now(), lsn=lsn)
+            rt.annotate("lsn", lsn)
+            if minted:
+                _flight.record_trace(rt.close())
         return lsn
 
     def _sync_upto(self, lsn: int) -> None:
@@ -565,7 +599,15 @@ class IngestServer:
                     "ingest: fold needs a bound server or a recovered "
                     "base index")
             faults.maybe_fail("ingest.fold")
-            with obs.stage("serving.ingest.fold"):
+            # fold trace: adopt the ambient recorder when one is active
+            # (a traced caller), else mint a root — the stage() below
+            # mirrors its timer onto whichever is current, so the
+            # Chrome-trace chain shows the fold span either way
+            frt = None
+            if _trace.current() is None and _trace.tracing():
+                frt = _trace.start_request("serving.ingest.request")
+                frt.annotate("op", "fold")
+            with _trace.activating(frt), obs.stage("serving.ingest.fold"):
                 fold_lsn = self._lsn
                 live_ids, live_rows, tomb_ids = mem.fold_payload()
                 mod = (ivf_flat if isinstance(base, ivf_flat.Index)
@@ -605,6 +647,11 @@ class IngestServer:
                                      tombstones=int(tomb_ids.size),
                                      fold_lsn=fold_lsn,
                                      generation=_mutate.generation(cand))
+            if frt is not None:
+                frt.annotate("rows", int(live_ids.size))
+                frt.annotate("tombstones", int(tomb_ids.size))
+                frt.annotate("generation", _mutate.generation(cand))
+                _flight.record_trace(frt.close())
             return cand
 
     def _save_fold(self, cand, mod, fold_lsn: int) -> None:

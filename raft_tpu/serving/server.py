@@ -91,6 +91,7 @@ class Server:
                                       on_error=self._on_batch_error)
         self._started = False
         self.ingest = None          # durable write path (attach_ingest)
+        self.shadow = None          # quality monitor (attach_shadow)
         # generation watchdog state: the last-known-good index retained
         # by swap_index, and the strike timestamps within the window
         self._last_good = None
@@ -106,12 +107,21 @@ class Server:
             st.fence()
         if obs.enabled():
             obs.registry().gauge("serving.warmed_executables").set(n)
+        if self.shadow is not None:
+            # the shadow executor warms its own (bucket, k) set at the
+            # ground-truth params — part of the same pre-start compile
+            # budget, so steady state stays recompile-free with the
+            # monitor on
+            self.shadow.start()
+            self.batcher.shadow = self.shadow
         self.batcher.start()
         self._started = True
         return self
 
     def stop(self, drain: bool = True) -> None:
         self.batcher.stop(drain=drain)
+        if self.shadow is not None:
+            self.shadow.stop()
         self._started = False
 
     def __enter__(self) -> "Server":
@@ -132,6 +142,22 @@ class Server:
                 "zero-recompile contract — attach before Server.start()")
         self.ingest = ingest
         ingest.bind(self)
+        return self
+
+    def attach_shadow(self, monitor) -> "Server":
+        """Attach a live quality monitor
+        (:class:`serving.ShadowMonitor`) BEFORE :meth:`start` — its
+        ground-truth executables join the warmed closed-shape set — and
+        AFTER :meth:`attach_ingest` when an ingest tier exists, so the
+        shadow replay merges the same memtable view the served answers
+        saw.  The batcher then offers every completed batch's host-side
+        results to the monitor's sampler (one flag check per batch when
+        sampling is off)."""
+        expects(not self._started,
+                "serving: attach_shadow after start would break the "
+                "zero-recompile contract — attach before Server.start()")
+        monitor.bind(self)
+        self.shadow = monitor
         return self
 
     def write(self, ids, vectors=None, *, op: str = "upsert",
@@ -162,6 +188,11 @@ class Server:
         with obs.stage("serving.generation_swap") as st:
             n = self.executor.swap_index(new_index)
             st.fence()
+        if self.shadow is not None:
+            # rebuild the shadow table against the new generation (still
+            # on the swap path); backlog samples from the old generation
+            # drop rather than replay cross-generation
+            self.shadow.on_swap(new_index)
         with self._watchdog_lock:
             self._last_good = old
             self._strikes.clear()
@@ -220,6 +251,8 @@ class Server:
         with obs.stage("serving.generation_swap") as st:
             self.executor.swap_index(target)
             st.fence()
+        if self.shadow is not None:
+            self.shadow.on_swap(target)
         if obs.enabled():
             obs.registry().counter("serving.auto_rollbacks").inc()
         # always-on flight event: THE post-mortem marker — which

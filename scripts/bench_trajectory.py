@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Aggregate the per-round bench artifacts into one trajectory table.
+
+The repo accumulates one ``BENCH_rNN.json`` per growth round, in two
+generations of schema:
+
+- rounds 1-5: ``{"n", "cmd", "rc", "tail", "parsed"}`` — ``parsed`` is
+  the flagship metric line (``{"metric", "value", "unit",
+  "vs_baseline", ...}``) and ``tail`` may hold further JSON lines;
+- rounds 6+: ``{"results": [...]}`` — a heterogeneous list mixing
+  flagship ``{"metric": ...}`` entries, ANN-bench-style rows
+  (``{"name", "search_param", "recall", "qps", ...}``), and
+  ``{"summary": "QPS at recall=0.95", ...}`` rollups.
+
+This script reduces each round to its headline numbers — the flagship
+metric(s) and the best QPS at/above a recall floor — so the perf
+history stops living only in PERFORMANCE.md prose.  Output: a markdown
+table on stdout, plus the full per-round extraction as JSON with
+``--json``.  CI runs it after the bench smoke and uploads the artifact.
+
+Usage::
+
+    python scripts/bench_trajectory.py [--dir .] [--glob 'BENCH_r*.json']
+                                       [--min-recall 0.95] [--json out]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def _round_of(path: str) -> Optional[int]:
+    m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def _json_lines(text: str) -> List[Dict[str, Any]]:
+    """Parse every JSON-object line out of a captured stdout tail."""
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(d, dict):
+            out.append(d)
+    return out
+
+
+def _entries(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Flatten one round file (either schema) into result entries."""
+    entries: List[Dict[str, Any]] = []
+    if isinstance(doc.get("results"), list):
+        entries.extend(e for e in doc["results"] if isinstance(e, dict))
+    if isinstance(doc.get("parsed"), dict):
+        entries.append(doc["parsed"])
+    if isinstance(doc.get("tail"), str):
+        for e in _json_lines(doc["tail"]):
+            if e not in entries:
+                entries.append(e)
+    return entries
+
+
+def extract_round(doc: Dict[str, Any], min_recall: float
+                  ) -> Dict[str, Any]:
+    """One round's headline numbers: flagship metrics + QPS@recall."""
+    flagships = []
+    qps_at: Optional[Dict[str, Any]] = None
+    families: Dict[str, int] = {}
+    for e in _entries(doc):
+        if "metric" in e and "value" in e:
+            flagships.append({k: e[k] for k in
+                              ("metric", "value", "unit", "vs_baseline")
+                              if k in e})
+            continue
+        if "summary" in e and "qps" in e:
+            # pre-rolled "QPS at recall=X" line: trust it when its
+            # floor matches ours
+            m = re.search(r"recall=([\d.]+)", str(e["summary"]))
+            if m and abs(float(m.group(1)) - min_recall) < 1e-9:
+                cand = {"qps": float(e["qps"]),
+                        "recall": float(e.get("recall", 0.0)),
+                        "name": e.get("name"), "source": "summary"}
+                if qps_at is None or cand["qps"] > qps_at["qps"]:
+                    qps_at = cand
+            continue
+        if "qps" in e and "recall" in e:
+            # ANN-bench row: candidate for best-QPS-at-floor
+            if float(e["recall"]) >= min_recall:
+                cand = {"qps": float(e["qps"]),
+                        "recall": float(e["recall"]),
+                        "name": e.get("name"),
+                        "search_param": e.get("search_param"),
+                        "source": "sweep"}
+                if qps_at is None or cand["qps"] > qps_at["qps"]:
+                    qps_at = cand
+            continue
+        # point families (overload_point, fused_windowed_point, ...):
+        # counted so the table shows what each round measured
+        for key in e:
+            if key.endswith("_point"):
+                families[key] = families.get(key, 0) + 1
+    return {"flagships": flagships, "qps_at_recall": qps_at,
+            "point_families": families}
+
+
+def build_trajectory(paths: List[str], min_recall: float
+                     ) -> List[Dict[str, Any]]:
+    rounds = []
+    for path in sorted(paths, key=lambda p: (_round_of(p) or 0, p)):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            rounds.append({"round": _round_of(path), "file": path,
+                           "error": str(e)})
+            continue
+        row = extract_round(doc, min_recall)
+        row["round"] = _round_of(path)
+        row["file"] = os.path.basename(path)
+        rounds.append(row)
+    return rounds
+
+
+def _fmt(v: Any) -> str:
+    if isinstance(v, float):
+        return f"{v:,.1f}" if abs(v) >= 100 else f"{v:.3g}"
+    return str(v)
+
+
+def render_table(rounds: List[Dict[str, Any]], min_recall: float) -> str:
+    lines = [
+        f"| round | flagship metric | value | vs_baseline "
+        f"| QPS@recall>={min_recall:g} | measured |",
+        "|---|---|---|---|---|---|",
+    ]
+    for r in rounds:
+        if "error" in r:
+            lines.append(f"| {r['round']} | (unreadable: {r['error']}) "
+                         f"| | | | |")
+            continue
+        flag = r["flagships"][0] if r["flagships"] else {}
+        extra = (f" (+{len(r['flagships']) - 1} more)"
+                 if len(r["flagships"]) > 1 else "")
+        qa = r["qps_at_recall"]
+        qa_s = (f"{qa['qps']:,.1f} (r={qa['recall']:.3f})" if qa else "—")
+        fams = ", ".join(f"{k}×{n}"
+                         for k, n in sorted(r["point_families"].items()))
+        lines.append(
+            f"| {r['round']} | {flag.get('metric', '—')}{extra} "
+            f"| {_fmt(flag.get('value', '—'))} {flag.get('unit', '')} "
+            f"| {_fmt(flag.get('vs_baseline', '—'))} "
+            f"| {qa_s} | {fams or '—'} |")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=".",
+                    help="directory holding the BENCH round files")
+    ap.add_argument("--glob", default="BENCH_r*.json",
+                    help="round-file glob within --dir")
+    ap.add_argument("--min-recall", type=float, default=0.95,
+                    help="recall floor for the QPS@recall column")
+    ap.add_argument("--json", default=None,
+                    help="also write the full extraction to this path")
+    args = ap.parse_args(argv)
+    paths = glob.glob(os.path.join(args.dir, args.glob))
+    if not paths:
+        print(f"no round files match {args.glob!r} under {args.dir!r}",
+              file=sys.stderr)
+        return 1
+    rounds = build_trajectory(paths, args.min_recall)
+    print(render_table(rounds, args.min_recall))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"min_recall": args.min_recall, "rounds": rounds},
+                      f, indent=2, sort_keys=True)
+        print(f"\nwrote {args.json}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

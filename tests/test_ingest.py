@@ -21,7 +21,7 @@ from raft_tpu.core.error import RaftError
 from raft_tpu.core.serialize import CorruptIndexError
 from raft_tpu.distance.types import DistanceType
 from raft_tpu.neighbors import delta, ivf_flat, mutate
-from raft_tpu.observability import flight
+from raft_tpu.observability import flight, trace
 from raft_tpu.resilience import FaultInjected, FaultPlan
 from raft_tpu.serving import ingest
 from raft_tpu.serving.brownout import BrownoutState
@@ -895,3 +895,68 @@ class TestServingIntegration:
         finally:
             srv.stop()
         ig.close()
+
+
+# ---------------------------------------------------------------------------
+# write-path tracing (PR 16): serving.ingest.* spans on the durable path
+
+
+class TestIngestTracing:
+    def test_write_mints_trace_with_spans(self, tmp_path):
+        rng = np.random.default_rng(31)
+        srv = _ingest(tmp_path)
+        srv.recover()
+        with obs.collecting(), trace.tracing_scope():
+            srv.write(np.arange(4, dtype=np.int64), _rows(rng, 4))
+        mine = [r for r in flight.traces()
+                if r.name == "serving.ingest.request"]
+        assert len(mine) == 1
+        rt = mine[0]
+        assert [s.name for s in rt.spans] == [
+            "serving.ingest.append", "serving.ingest.apply",
+            "serving.ingest.fsync"]
+        assert all(s.duration >= 0.0 for s in rt.spans)
+        assert rt.attrs["op"] == "upsert"
+        assert rt.attrs["rows"] == 4
+        assert rt.attrs["lsn"] == 1
+        srv.close()
+
+    def test_write_adopts_ambient_trace(self, tmp_path):
+        rng = np.random.default_rng(32)
+        srv = _ingest(tmp_path)
+        srv.recover()
+        rec = trace.SpanRecorder("serving.request")
+        with obs.collecting(), trace.tracing_scope(), trace.activating(rec):
+            srv.write(np.arange(4, dtype=np.int64), _rows(rng, 4))
+        # adopted the caller's recorder: nothing minted into the ring
+        assert flight.traces() == []
+        assert "serving.ingest.fsync" in [s.name for s in rec.spans]
+        assert rec.attrs["op"] == "upsert"
+        srv.close()
+
+    def test_write_without_tracing_records_nothing(self, tmp_path):
+        rng = np.random.default_rng(33)
+        srv = _ingest(tmp_path)
+        srv.recover()
+        with obs.collecting():
+            srv.write(np.arange(4, dtype=np.int64), _rows(rng, 4))
+        assert flight.traces() == []
+        srv.close()
+
+    def test_fold_trace_lands_with_stage_span(self, tmp_path, res,
+                                              flat_index):
+        rng = np.random.default_rng(34)
+        srv = _ingest(tmp_path, res=res)
+        srv.recover(base_index=flat_index)
+        srv.write(np.arange(2000, 2008, dtype=np.int64), _rows(rng, 8))
+        with obs.collecting(), trace.tracing_scope():
+            assert srv.fold() is not None
+        folds = [r for r in flight.traces()
+                 if r.attrs.get("op") == "fold"]
+        assert len(folds) == 1
+        frt = folds[0]
+        # the stage hook mirrors the fold timer onto the minted trace
+        assert "serving.ingest.fold" in [s.name for s in frt.spans]
+        assert frt.attrs["rows"] == 8
+        assert "generation" in frt.attrs
+        srv.close()

@@ -463,6 +463,10 @@ class TestFusedScan:
         fin = np.isfinite(rd) & np.isfinite(fd)
         np.testing.assert_allclose(fd[fin], rd[fin], rtol=1e-4, atol=1e-4)
 
+    # interpreter-mode Pallas at kt=cap+7 dominates this module's wall
+    # clock on CPU; the CI fused-tripwire step runs it by node id (no
+    # marker filter), keeping it out of the fast tier only.
+    @pytest.mark.slow
     def test_fused_kt_exceeds_list_length(self, scan_index):
         """kt past the list capacity clips to cap — every candidate of
         every probed list survives to the merge, so the fused result is

@@ -11,6 +11,7 @@ when collection is off).
 
 import importlib
 import json
+import os
 import threading
 
 import numpy as np
@@ -184,6 +185,30 @@ class TestFlightRecorder:
                            str(tmp_path / "no" / "such" / "dir" / "f.json"))
         assert flight.maybe_auto_dump("x") is None
 
+    def test_maybe_auto_dump_directory_rotates(self, tmp_path, monkeypatch):
+        d = tmp_path / "dumps"
+        # trailing separator selects directory mode before the dir exists
+        monkeypatch.setenv(flight.DUMP_ENV, str(d) + os.sep)
+        monkeypatch.setenv(flight.DUMP_KEEP_ENV, "3")
+        flight.record_event("serving.batch_error", error="boom")
+        paths = [flight.maybe_auto_dump(f"r{j}") for j in range(5)]
+        assert paths[0].endswith("flight-000000.json")
+        # only the newest RAFT_TPU_FLIGHT_DUMP_KEEP dumps survive
+        assert sorted(os.listdir(d)) == [
+            "flight-000002.json", "flight-000003.json", "flight-000004.json"]
+        doc = json.loads((d / "flight-000004.json").read_text())
+        assert doc["otherData"]["reason"] == "r4"
+        # an existing directory without the trailing separator also rotates
+        monkeypatch.setenv(flight.DUMP_ENV, str(d))
+        p = flight.maybe_auto_dump("r5")
+        assert p.endswith("flight-000005.json")
+        assert sorted(os.listdir(d)) == [
+            "flight-000003.json", "flight-000004.json", "flight-000005.json"]
+        # an unparseable keep bound falls back to the default, not a raise
+        monkeypatch.setenv(flight.DUMP_KEEP_ENV, "bananas")
+        assert flight.maybe_auto_dump("r6").endswith("flight-000006.json")
+        assert len(os.listdir(d)) == 4     # 4 <= DEFAULT_DUMP_KEEP: no prune
+
 
 # ---------------------------------------------------------------------------
 # windowed telemetry
@@ -250,6 +275,57 @@ class TestWindowedTelemetry:
         assert snap["window"]["span_s"] == 6.0
         assert snap["window"]["counters"] == {"w.c": 4}
         assert snap["window"]["histograms"]["w.h"]["count"] == 1
+
+    def test_counter_backwards_clock_drops_future_slots(self, clock):
+        # a clock that steps backwards (suspend/resume, test clocks) must
+        # never raise, and slots stamped with a now-future epoch are
+        # excluded from the sum rather than double-counted
+        reg = registry_mod.MetricsRegistry(window_interval_s=1.0,
+                                           window_slots=4)
+        c = reg.counter("w.c")
+        clock["now"] = 10.0
+        c.inc(3)
+        clock["now"] = 1.0
+        assert c.windowed() == 0        # the epoch-10 slot is in the future
+        c.inc(1)                        # lands in the earlier epoch cleanly
+        assert c.windowed() == 1
+        clock["now"] = 10.0             # forward again: future slot intact,
+        assert c.windowed() == 3        # the old epoch-1 slot aged out
+        assert c.value == 4             # lifetime total saw everything
+
+    def test_counter_jump_beyond_span_empties_window(self, clock):
+        reg = registry_mod.MetricsRegistry(window_interval_s=1.0,
+                                           window_slots=4)
+        c = reg.counter("w.c")
+        c.inc(5)
+        clock["now"] = 1e9              # jump far past the window span
+        assert c.windowed() == 0
+        assert c.value == 5
+
+    def test_histogram_clock_jumps(self, clock):
+        reg = registry_mod.MetricsRegistry(window_interval_s=1.0,
+                                           window_slots=4)
+        h = reg.histogram("w.h")
+        clock["now"] = 10.0
+        h.observe(0.01)
+        clock["now"] = 1.0
+        assert h.windowed_dict()["count"] == 0    # future slot excluded
+        h.observe(0.02)
+        w = h.windowed_dict()
+        assert w["count"] == 1
+        assert w["max"] == pytest.approx(0.02)
+        clock["now"] = 1e9
+        assert h.windowed_dict()["count"] == 0
+        assert h.count == 2             # lifetime view unaffected
+
+    def test_empty_window_shape(self, clock):
+        # windowed views on a never-observed metric: zeros, not NaN/None
+        reg = registry_mod.MetricsRegistry(window_interval_s=1.0,
+                                           window_slots=4)
+        assert reg.counter("w.c").windowed() == 0
+        w = reg.histogram("w.h").windowed_dict()
+        assert w == {"count": 0, "sum": 0.0, "max": 0.0,
+                     "p50": 0.0, "p95": 0.0, "p99": 0.0}
 
     def test_prometheus_exports_window_series(self):
         with obs.collecting() as reg:
