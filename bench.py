@@ -2040,6 +2040,51 @@ def bench_distributed(res) -> list:
         traffic = grouped.scan_traffic(
             rot_dim, pq_dim=params.pq_dim,
             pq_bits=int(getattr(routed, "pq_bits", 0)))
+        # round 17: replicated failover — what ONE dead shard costs in
+        # recall (vs the healthy routed answer at the same operating
+        # point) and QPS at r=1 (lists lost, degraded merge) vs r=2
+        # (replicas cover the loss; exact by the k-bounded argument)
+        from raft_tpu.resilience import FaultPlan
+        r2 = dist_ann.build(handle, params, db, placement="by_list",
+                            replication_factor=2)
+        failover = {}
+        for tag, idx in (("r1", routed), ("r2", r2)):
+            # each index's own healthy answer is the recall baseline —
+            # the failover contract is per index (r2 trains its own
+            # quantizer here, so cross-index ids don't compare)
+            base_i = np.asarray(dist_ann.search(handle, sp, idx,
+                                                queries, k)[1])
+            i_f = np.asarray(dist_ann.search(handle, sp, idx, queries, k,
+                                             failed_shards=[0])[1])
+            t0 = time.perf_counter()
+            for _ in range(RUNS):
+                i_r = dist_ann.search(handle, sp, idx, queries, k,
+                                      failed_shards=[0])[1]
+            np.asarray(i_r)
+            failover[tag] = {
+                "recall": _recall(i_f, base_i),
+                "qps": nq / ((time.perf_counter() - t0) / RUNS),
+            }
+        # hedged straggler reads: one shard scripted 10x slower than the
+        # healthy per-search latency; the hedge re-issues its probes to
+        # the replica and caps the wait at the per-shard deadline
+        lat = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            np.asarray(dist_ann.search(handle, sp, r2, queries, k)[1])
+            lat.append(time.perf_counter() - t0)
+        t_med = float(np.median(lat))
+        hedge_deadline = max(t_med, 1e-3)
+        hlat = []
+        plan = FaultPlan(seed=17).straggle_shard(1, delay=10.0 * t_med)
+        with plan.active():
+            for _ in range(20):
+                t0 = time.perf_counter()
+                np.asarray(dist_ann.search(
+                    handle, sp, r2, queries, k,
+                    shard_deadline_s=hedge_deadline)[1])
+                hlat.append(time.perf_counter() - t0)
+        p99_hedged_ms = float(np.percentile(hlat, 99)) * 1e3
     finally:
         session.destroy()
     # the candidate exchange: each shard contributes (nq, k) f32+i32
@@ -2083,6 +2128,35 @@ def bench_distributed(res) -> list:
         "vs_baseline": round(traffic["fused"] / traffic["recon"], 3),
         "detail": dict(traffic, rot_dim=rot_dim, pq_dim=params.pq_dim,
                        pq_bits=int(getattr(routed, "pq_bits", 0))),
+    })
+    # round 17: the replication decision record — recall retained with
+    # one shard dead (vs the healthy routed answer; r=2 MUST read 1.0,
+    # the bit-identical failover contract) and the QPS each mode holds
+    for tag in ("r1", "r2"):
+        out.append({
+            "metric": f"dist_recall_failed_shard_{tag}",
+            "value": round(failover[tag]["recall"], 4),
+            "unit": "recall@10",
+            "vs_baseline": round(
+                failover[tag]["qps"] / max(routed_qps, 1e-9), 3),
+            "detail": {"failed_shards": [0], "n_probes": DIST_N_PROBES,
+                       "k": k, "batch": nq, "shape": shape,
+                       "replication_factor": int(tag[1]),
+                       "qps_one_shard_failed":
+                           round(failover[tag]["qps"], 1)},
+        })
+    out.append({
+        "metric": "dist_p99_hedged_ms",
+        "value": round(p99_hedged_ms, 2), "unit": "ms",
+        # the tripwire ratio: hedged p99 vs what the scripted straggler
+        # would cost unhedged (healthy median + 10x delay)
+        "vs_baseline": round(
+            p99_hedged_ms / max((t_med + 10.0 * t_med) * 1e3, 1e-9), 3),
+        "detail": {"straggler_delay_ms": round(10.0 * t_med * 1e3, 2),
+                   "shard_deadline_ms": round(hedge_deadline * 1e3, 2),
+                   "healthy_p50_ms": round(t_med * 1e3, 2),
+                   "shape": shape, "replication_factor": 2,
+                   "samples": len(hlat)},
     })
     return out
 

@@ -365,7 +365,8 @@ def export_ivf_pq_routed_search(res, index, shard: int, n_probes: int,
                                 k: int, batch: int, *,
                                 scan_mode: str = "recon",
                                 group_capacity: int = 0,
-                                merge_window=0) -> io.BytesIO:
+                                merge_window=0,
+                                replica_rank: int = 0) -> io.BytesIO:
     """Export ONE shard's routed (``placement="by_list"``) search
     program at fixed (batch, k, n_probes): replicated coarse routing +
     ownership mask + the shard-local scan over the owned lists +
@@ -392,7 +393,15 @@ def export_ivf_pq_routed_search(res, index, shard: int, n_probes: int,
 
     ``merge_window`` windows the fused export's staged scatter exactly
     as in :func:`export_ivf_pq_search` (and keys the artifact the same
-    way)."""
+    way).
+
+    ``replica_rank`` (a replicated placement only) bakes replica rank
+    ``j``'s routing tables instead of the primaries': the exported
+    program answers for the lists this shard owns *at that rank* — the
+    artifact a deployment loads to serve a failed primary's share.  The
+    shard's local leaves already hold every rank's owned lists (the slot
+    layout is the union), so only the two routing arrays differ; the
+    rank is part of the executable-cache key."""
     from raft_tpu.neighbors import grouped, ivf_pq
     from raft_tpu.ops import vmem_budget as vb
 
@@ -406,6 +415,10 @@ def export_ivf_pq_routed_search(res, index, shard: int, n_probes: int,
     expects(scan_mode in ("recon", "fused"),
             f"aot: export_ivf_pq_routed_search supports scan_mode "
             f"'recon' or 'fused', got {scan_mode!r}")
+    expects(0 <= replica_rank < index.placement.replication_factor,
+            f"aot: replica_rank {replica_rank} out of range for "
+            f"replication_factor "
+            f"{index.placement.replication_factor}")
     metric = index.metric
     slots = int(index.local_centers.shape[1])
     dummy = slots - 1
@@ -446,9 +459,14 @@ def export_ivf_pq_routed_search(res, index, shard: int, n_probes: int,
                 queries, k=k, n_probes=n_probes, metric=metric,
                 probes=local_probes, list_recon_sq=list_recon_sq)
 
+    if replica_rank > 0:
+        rank_owner, rank_slot = index.placement.rank_tables()
+        route = (rank_owner[replica_rank], rank_slot[replica_rank])
+    else:
+        route = (index.owner, index.local_slot)
     arrays = tuple(jax.device_get(a) for a in (
-        index.coarse_centers, index.rotation, index.owner,
-        index.local_slot, index.local_centers[shard],
+        index.coarse_centers, index.rotation) + route + (
+        index.local_centers[shard],
         index.list_recon[shard], index.list_recon_sq[shard],
         index.list_indices[shard]))
     example_q = jax.ShapeDtypeStruct((batch, index.dim),

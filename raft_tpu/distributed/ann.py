@@ -82,10 +82,14 @@ P = jax.sharding.PartitionSpec
 # formulation and was lowered to the probe-order recon scan — since
 # round 10 the exception, not the rule (fused/grouped scans lower under
 # ``shard_map`` at the static group capacity; results are correct either
-# way, only the formulation differs).
+# way, only the formulation differs).  REPLICA_SERVED marks a shard that
+# did not answer (failed, or hedged around as a straggler) but whose
+# owned lists were scanned by healthy replicas — results are COMPLETE,
+# the code is routing telemetry, not a degradation signal.
 SHARD_FAILED = 0
 SHARD_OK = 1
 SHARD_OK_FALLBACK = 2
+SHARD_REPLICA_SERVED = 3
 
 
 def _entry(site, fn, retry_policy, deadline):
@@ -108,13 +112,17 @@ def _degraded_set(n_shards: int, failed_shards: Sequence[int]
 
 
 def _status_vector(n_shards: int, failed: Tuple[int, ...],
-                   lowered: bool) -> jax.Array:
+                   lowered: bool,
+                   replica_served: Tuple[int, ...] = ()) -> jax.Array:
     """(n_shards,) int8 per-shard status: failed shards report
-    :data:`SHARD_FAILED`; live shards report :data:`SHARD_OK_FALLBACK`
-    when the requested scan mode was lowered, else :data:`SHARD_OK`."""
+    :data:`SHARD_FAILED`; shards whose owned lists replicas covered
+    (failover or a hedged read) report :data:`SHARD_REPLICA_SERVED`;
+    live shards report :data:`SHARD_OK_FALLBACK` when the requested scan
+    mode was lowered, else :data:`SHARD_OK`."""
     status = np.full(n_shards,
                      SHARD_OK_FALLBACK if lowered else SHARD_OK, np.int8)
     status[list(failed)] = SHARD_FAILED
+    status[list(replica_served)] = SHARD_REPLICA_SERVED
     return jnp.asarray(status)
 
 
@@ -344,7 +352,7 @@ class DistributedIndex:
 
 
 def build(handle, params: ivf_pq.IndexParams, dataset, *,
-          placement: str = "by_row",
+          placement: str = "by_row", replication_factor: int = 1,
           retry_policy: Optional[_retry.RetryPolicy] = None,
           deadline: Optional[_retry.Deadline] = None):
     """Build a sharded IVF-PQ index over the handle's mesh.
@@ -364,6 +372,9 @@ def build(handle, params: ivf_pq.IndexParams, dataset, *,
     ``params.n_lists`` is GLOBAL) and its lists are partitioned across
     shards balanced by list size — returns a :class:`RoutedIndex` whose
     search routes probes to owning shards (see module docstring).
+    ``replication_factor=r > 1`` (by_list only) places ``r`` copies of
+    every list on distinct shards for recall-preserving shard failover
+    (see :func:`compute_placement`).
 
     Transient faults at entry (site ``distributed.ann.build``) are
     retried under ``retry_policy`` / ``deadline``.
@@ -371,9 +382,15 @@ def build(handle, params: ivf_pq.IndexParams, dataset, *,
     expects(placement in ("by_row", "by_list"),
             f"distributed.ann.build: placement must be 'by_row' or "
             f"'by_list', got {placement!r}")
+    expects(replication_factor == 1 or placement == "by_list",
+            "distributed.ann.build: replication_factor > 1 requires "
+            "placement='by_list' (by_row is already fully replicated "
+            "per shard's rows)")
     if placement == "by_list":
         return _entry("distributed.ann.build",
-                      lambda: _build_by_list(handle, params, dataset),
+                      lambda: _build_by_list(
+                          handle, params, dataset,
+                          replication_factor=replication_factor),
                       retry_policy, deadline)
     return _entry("distributed.ann.build",
                   lambda: _build_impl(handle, params, dataset),
@@ -708,7 +725,10 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
            return_status: bool = False,
            return_stats: bool = False,
            retry_policy: Optional[_retry.RetryPolicy] = None,
-           deadline: Optional[_retry.Deadline] = None):
+           deadline: Optional[_retry.Deadline] = None,
+           health=None,
+           shard_deadline_s: Optional[float] = None,
+           hedge: bool = True):
     """Sharded search + merge; returns replicated (distances, global ids)
     of shape (q, k).  Accepts both placements: a
     :class:`DistributedIndex` (data-parallel full-shard scan) or a
@@ -759,28 +779,137 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
     only a batch whose probe skew exceeds the calibrated bound pays the
     one host read plus an exact re-dispatch at the worst bound, counted
     by ``ivf_pq.search.group_overflow``.
+
+    Replication (the routed path only, ``replication_factor > 1``): a
+    down shard's lists fail over to their replicas *before* dispatch —
+    host-side, the effective routing tables swap each affected list to
+    its lowest-rank live owner, so the device program sees the same
+    shapes (replica choice is data, not shape: zero recompiles) and the
+    merge pulls the lost lists from shards that scan the identical rows,
+    keeping full-probe results **bit-identical** to the healthy run.
+    Fully-covered shards report :data:`SHARD_REPLICA_SERVED`; only
+    shards with uncovered lists stay :data:`SHARD_FAILED` (the
+    ``distributed.degraded_search`` event fires for those alone, and the
+    residual set is the only thing passed as a static jit arg — a fully
+    covered failover reuses the warmed healthy executable).
+
+    ``health`` (a :class:`raft_tpu.distributed.health.HealthTracker`)
+    contributes its FAILED shards to the down set and receives straggle
+    / deadline-overrun signals.  ``shard_deadline_s`` (satellite of the
+    straggler model: a float budget or a :class:`resilience.Deadline`)
+    bounds the wait on any one shard — an overrun emits a
+    ``distributed.shard_timeout`` flight event, notes a timeout with the
+    tracker, and (with replicas available and ``hedge=True``) converts
+    the unbounded wait into a **hedged read**: the straggler's probe
+    subset is re-issued to a replica and the first answer taken — exact,
+    because both scan identical lists.  A hedged shard's injected delay
+    is not paid beyond the deadline; with no covering replica the shard
+    is un-hedged and waited for in full (slow beats dropped).
     """
     with named_range("distributed::ivf_pq_search"):
         expects(handle.comms_initialized(),
                 "distributed.ann.search: handle has no comms")
         comms = handle.get_comms()
         queries = ensure_array(queries, "queries")
+        # lifecycle-boundary kill site: a shard killed here is seen by
+        # THIS search's failed-set computation (killed during routing)
+        faults.maybe_fail("distributed.route")
         failed = _degraded_set(index.n_shards, failed_shards)
-        # per-shard straggler injection (host-side, before dispatch):
-        # the SPMD merge completes when the slowest shard answers, so
-        # the scripted pause models a slow shard without touching the
-        # compiled program — every shard's candidates still merge,
-        # results stay exact.  No plan active → one None check.
-        stragglers = faults.straggler_pause(index.n_shards)
-        if stragglers:
-            _flight.record_event("distributed.straggler",
-                                 trace_id=_rtrace.current().trace_id
-                                 if _rtrace.current() else None,
-                                 delays_s=list(stragglers),
-                                 n_shards=index.n_shards)
+        if health is not None:
+            failed = tuple(sorted(
+                set(failed) | set(health.failed_shards())))
         nq = int(queries.shape[0])
         k = int(k)
         routed = isinstance(index, RoutedIndex)
+        rec = _rtrace.current()
+        rf = (index.placement.replication_factor
+              if routed and index.placement is not None else 1)
+        if isinstance(shard_deadline_s, _retry.Deadline):
+            shard_deadline_s = shard_deadline_s.remaining()
+        expects(shard_deadline_s is None or shard_deadline_s > 0,
+                "distributed.ann.search: shard_deadline_s must be > 0")
+        # per-shard straggler injection (host-side, before dispatch):
+        # the SPMD merge completes when the slowest shard answers.
+        # Probe the scripted schedule WITHOUT sleeping first — the
+        # straggler detector — so hedging can collapse a flagged
+        # shard's wait before it is paid.
+        delays = faults.straggler_delays(index.n_shards)
+        flagged = tuple(s for s, dly in enumerate(delays) if dly > 0.0)
+        if delays:
+            _flight.record_event("distributed.straggler",
+                                 trace_id=rec.trace_id if rec else None,
+                                 delays_s=list(delays),
+                                 n_shards=index.n_shards)
+        timeouts = ()
+        if flagged and shard_deadline_s is not None:
+            timeouts = tuple(s for s in flagged
+                             if delays[s] > shard_deadline_s)
+            for s in timeouts:
+                _flight.record_event("distributed.shard_timeout",
+                                     trace_id=rec.trace_id if rec else None,
+                                     shard=s, delay_s=delays[s],
+                                     deadline_s=shard_deadline_s)
+                if health is not None:
+                    health.note_timeout(s)
+        if health is not None:
+            for s in flagged:
+                health.note_straggle(s)
+        # -- replica failover + hedging (host-side, data not shape) ----
+        hedge_cand = set()
+        if hedge and routed and rf > 1:
+            hedge_cand = set(flagged) - set(failed)
+            if health is not None:
+                hedge_cand |= set(health.suspect_shards()) - set(failed)
+        hedged: Tuple[int, ...] = ()
+        residual = failed
+        replica_served: Tuple[int, ...] = ()
+        eff = None  # (eff_owner, eff_slot) host numpy, or None
+        if routed and rf > 1 and (failed or hedge_cand):
+            down = set(failed) | hedge_cand
+            eo, es = index.placement.healthy_routing(tuple(sorted(down)))
+            still = down & set(np.unique(eo).tolist())
+            # a hedge candidate whose lists have no live replica is
+            # UN-hedged: the shard is alive, just slow — wait for it
+            # rather than drop its lists
+            unhedged = hedge_cand & still
+            hedged = tuple(sorted(hedge_cand - unhedged))
+            down = set(failed) | set(hedged)
+            if unhedged and down:
+                eo, es = index.placement.healthy_routing(
+                    tuple(sorted(down)))
+            if down:
+                still = down & set(np.unique(eo).tolist())
+                residual = tuple(sorted(set(failed) & still))
+                replica_served = tuple(sorted(down - still))
+                eff = (eo, es)
+            if failed and set(failed) - set(residual):
+                _flight.record_event(
+                    "distributed.replica_failover",
+                    trace_id=rec.trace_id if rec else None,
+                    failed=list(failed), residual=list(residual),
+                    covered=sorted(set(failed) - set(residual)))
+            for s in hedged:
+                _flight.record_event("distributed.hedged_read",
+                                     trace_id=rec.trace_id if rec else None,
+                                     shard=s, delay_s=delays[s]
+                                     if s < len(delays) else 0.0)
+            if hedged:
+                from raft_tpu import observability as obs
+                if obs.enabled():
+                    obs.registry().counter(
+                        "distributed.hedged_reads").inc(len(hedged))
+        # pay the straggler wait: a hedged shard's wait collapses to the
+        # deadline (the replica answered instead); everyone else is
+        # waited for in full.  The sleep stays in the resilience layer.
+        wait = 0.0
+        hedged_set = set(hedged)
+        for s, dly in enumerate(delays):
+            if dly <= 0.0:
+                continue
+            if s in hedged_set:
+                dly = min(dly, shard_deadline_s or 0.0)
+            wait = max(wait, dly)
+        faults.pause(wait)
         n_probes = min(params.n_probes,
                        index.n_lists if routed else index.centers.shape[1])
         r = _resolve_scan_mode(params, index, nq, n_probes, k)
@@ -790,7 +919,6 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
         # already on the host — NO new device->host syncs; the scanned-
         # rows counter below rides along as a lazy device array that only
         # flight.dump() materializes.
-        rec = _rtrace.current()
         if rec is not None:
             rec.annotate("distributed.scan_mode",
                          {"probe_recon": "recon"}.get(r.form, r.form))
@@ -800,29 +928,49 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
             status = np.full(index.n_shards,
                              SHARD_OK_FALLBACK if r.lowered else SHARD_OK,
                              np.int8)
-            status[list(failed)] = SHARD_FAILED
+            status[list(residual)] = SHARD_FAILED
+            status[list(replica_served)] = SHARD_REPLICA_SERVED
             rec.annotate("distributed.shard_status", status.tolist())
-        if failed:
+        if residual:
+            # only shards with genuinely UNCOVERED lists degrade the
+            # answer; a fully covered failover is telemetry, not
+            # degradation
             _flight.record_event("distributed.degraded_search",
                                  trace_id=rec.trace_id if rec else None,
-                                 failed=list(failed),
+                                 failed=list(residual),
                                  n_shards=index.n_shards)
         scanned = None
+        # lifecycle-boundary kill site: a shard killed here (mid-scan)
+        # keeps this search's pre-kill routing — its in-flight answer
+        # completes — and the NEXT search routes around it
+        faults.maybe_fail("distributed.scan")
         if routed:
             if r.form == "probe_recon":
                 sharded = (index.local_centers, index.list_recon,
                            index.list_recon_sq, index.list_indices)
                 replicated = (index.coarse_centers, index.rotation,
                               index.owner, index.local_slot)
+                if eff is not None:
+                    # effective routing tables: same shape as the
+                    # healthy tables (replica choice is data, not
+                    # shape — no recompile), swapped in host-side
+                    replicated = replicated[:2] + (
+                        _replicate(jnp.asarray(eff[0]), handle.mesh),
+                        _replicate(jnp.asarray(eff[1]), handle.mesh))
                 d, i, scanned = _entry(
                     "distributed.ann.search",
                     lambda: _dist_search_routed(
                         sharded, replicated, queries, k, n_probes,
                         index.metric, comms.axis_name, handle.mesh,
-                        failed=failed),
+                        failed=residual),
                     retry_policy, deadline)
             else:
                 sharded, replicated = _routed_leaves(index, r.form)
+                if eff is not None:
+                    replicated = replicated[:2] + (
+                        _replicate(jnp.asarray(eff[0]), handle.mesh),
+                        _replicate(jnp.asarray(eff[1]), handle.mesh),
+                    ) + replicated[4:]
 
                 def dispatch(ng):
                     return _dist_search_routed_grouped(
@@ -830,7 +978,7 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
                         index.metric, comms.axis_name, handle.mesh, ng,
                         r.form, pq_bits=int(index.pq_bits),
                         use_pallas=r.use_pallas,
-                        merge_window=r.merge_window, failed=failed)
+                        merge_window=r.merge_window, failed=residual)
 
                 d, i, scanned, needed = _entry(
                     "distributed.ann.search",
@@ -860,7 +1008,7 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
                 "distributed.ann.search",
                 lambda: _dist_search(leaves, queries, k, n_probes,
                                      index.metric, comms.axis_name,
-                                     handle.mesh, failed=failed),
+                                     handle.mesh, failed=residual),
                 retry_policy, deadline)
         elif r.form == "lut":
             leaves = (index.centers, index.codebooks, index.list_codes,
@@ -873,7 +1021,7 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
                     leaves, queries, k, n_probes, index.metric,
                     index.codebook_kind, lut_dtype,
                     int(index.pq_bits), comms.axis_name, handle.mesh,
-                    failed=failed),
+                    failed=residual),
                 retry_policy, deadline)
         else:
             leaves = (index.centers, index.list_recon,
@@ -885,8 +1033,12 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
                     leaves, queries, k, r.kt, n_probes, index.metric,
                     comms.axis_name, handle.mesh, r.n_groups, r.form,
                     use_pallas=r.use_pallas,
-                    merge_window=r.merge_window, failed=failed),
+                    merge_window=r.merge_window, failed=residual),
                 retry_policy, deadline)
+        # lifecycle-boundary kill site: post-dispatch, pre-merge-return
+        # — a kill here lands after the candidate gather, next search
+        # sees the shard down
+        faults.maybe_fail("distributed.gather")
         if rec is not None and scanned is not None:
             # lazy attachment: `scanned` is a device array; annotate()
             # stores the reference without fetching it (no host sync on
@@ -894,7 +1046,8 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
             rec.annotate("distributed.scanned_rows", scanned)
         out = [d, i]
         if return_status:
-            out.append(_status_vector(index.n_shards, failed, r.lowered))
+            out.append(_status_vector(index.n_shards, residual,
+                                      r.lowered, replica_served))
         if return_stats:
             if scanned is None:
                 # data-parallel: every live shard scans its whole local
@@ -902,7 +1055,7 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
                 cap = index.list_recon.shape[2]
                 per = np.full(index.n_shards, nq * n_probes * cap,
                               np.int64)
-                per[list(failed)] = 0
+                per[list(residual)] = 0
             else:
                 # graftlint: disable=host-sync -- opt-in stats readback (return_stats=True), not the serving dispatch
                 per = np.asarray(scanned, np.int64)
@@ -956,66 +1109,148 @@ def _delete_impl(index, ids):
 # candidate gather
 # ---------------------------------------------------------------------------
 
-_PLACEMENT_VERSION = 1
+# v2 (round 17): trailing replication block — ``replication_factor``
+# plus, when > 1, the per-rank (r, n_lists) owner/slot tables.  v1
+# streams read fine and land unreplicated (r=1).
+_PLACEMENT_VERSION = 2
+_PLACEMENT_MIN_READ_VERSION = 1
 
 
 @dataclasses.dataclass(frozen=True)
 class Placement:
     """List → shard ownership map for ``placement="by_list"`` indexes.
 
-    ``owner[g]`` is the shard owning global IVF list ``g``;
-    ``local_slot[g]`` is that list's slot in the owner's stacked local
-    leaves.  ``n_local`` is the per-shard slot count *excluding* the
-    dummy slot (every shard's slot ``n_local`` is an always-empty list
-    that unowned probes lower to).  ``generation`` counts placement
-    recomputations — it keys the serving tier's executable cache
-    alongside the index mutation generation."""
+    ``owner[g]`` is the shard owning global IVF list ``g`` (the
+    *primary* — replica rank 0); ``local_slot[g]`` is that list's slot
+    in the owner's stacked local leaves.  ``n_local`` is the per-shard
+    slot count *excluding* the dummy slot (every shard's slot
+    ``n_local`` is an always-empty list that unowned probes lower to).
+    ``generation`` counts placement recomputations — it keys the
+    serving tier's executable cache alongside the index mutation
+    generation.
 
-    owner: np.ndarray       # (n_lists,) int32
-    local_slot: np.ndarray  # (n_lists,) int32
+    Replication (round 17): with ``replication_factor=r > 1`` every
+    list is owned by ``r`` DISTINCT shards — the primary at rank 0 plus
+    ``r-1`` replicas, each rank independently LPT-balanced.  ``owners``
+    / ``slots`` are the full ``(r, n_lists)`` rank tables (row 0 equals
+    ``owner`` / ``local_slot``); a shard's local leaves hold the union
+    of the lists it owns at ANY rank, so failover to a replica is a
+    pure routing-table change — replica choice is data, not shape."""
+
+    owner: np.ndarray       # (n_lists,) int32 — rank-0 owners
+    local_slot: np.ndarray  # (n_lists,) int32 — rank-0 slots
     n_shards: int
     n_local: int
     generation: int = 0
+    replication_factor: int = 1
+    owners: Optional[np.ndarray] = None  # (r, n_lists) int32, r > 1 only
+    slots: Optional[np.ndarray] = None   # (r, n_lists) int32, r > 1 only
 
     @property
     def n_lists(self) -> int:
         return int(self.owner.shape[0])
 
-    def shard_lists(self, s: int) -> np.ndarray:
-        """Global list ids owned by shard ``s``, in local-slot order."""
-        owned = np.nonzero(self.owner == s)[0]
-        return owned[np.argsort(self.local_slot[owned], kind="stable")]
+    def rank_tables(self) -> Tuple[np.ndarray, np.ndarray]:
+        """The ``(r, n_lists)`` per-rank (owners, slots) tables —
+        ``(1, n_lists)`` views of the primary arrays when r=1."""
+        if self.owners is None:
+            return self.owner[None, :], self.local_slot[None, :]
+        return self.owners, self.slots
+
+    def shard_lists(self, s: int,
+                    rank: Optional[int] = None) -> np.ndarray:
+        """Global list ids materialized on shard ``s``, in local-slot
+        order.  Default: the union over every replica rank (the lists
+        whose copies live in ``s``'s local leaves — what
+        ``_place_lists`` stacks); ``rank=j`` restricts to the lists
+        ``s`` owns at that rank (``rank=0`` is the primary set)."""
+        owners, slots = self.rank_tables()
+        if rank is not None:
+            owned = np.nonzero(owners[rank] == s)[0]
+            return owned[np.argsort(slots[rank][owned], kind="stable")]
+        ranks, lists = np.nonzero(owners == s)
+        order = np.argsort(slots[ranks, lists], kind="stable")
+        return lists[order]
+
+    def healthy_routing(self, down: Sequence[int]
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+        """Effective (owner, slot) routing tables with every list served
+        by its LOWEST-rank owner not in ``down`` — the failover /
+        hedging tables.  A list all of whose owners are down keeps its
+        rank-0 primary (the degraded-masking path handles it); both
+        arrays are host-side numpy, shaped exactly like ``owner`` /
+        ``local_slot``, so swapping them into the routed dispatch is a
+        data change only (zero recompiles)."""
+        owners, slots = self.rank_tables()
+        eff_owner = self.owner.copy()
+        eff_slot = self.local_slot.copy()
+        downset = {int(s) for s in down}
+        if not downset or owners.shape[0] == 1:
+            return eff_owner, eff_slot
+        hit = np.nonzero(np.isin(self.owner, list(downset)))[0]
+        for g in hit:
+            for r in range(owners.shape[0]):
+                if int(owners[r, g]) not in downset:
+                    eff_owner[g] = owners[r, g]
+                    eff_slot[g] = slots[r, g]
+                    break
+        return eff_owner, eff_slot
 
 
-def compute_placement(list_sizes, n_shards: int, *,
-                      generation: int = 0) -> Placement:
+def compute_placement(list_sizes, n_shards: int, *, generation: int = 0,
+                      replication_factor: int = 1) -> Placement:
     """Balanced list partition: LPT greedy — lists sorted by (live) size
     descending, each assigned to the least-loaded shard (ties broken by
     fewest lists, then lowest shard id, so the result is deterministic
     and slot counts stay even under uniform sizes).  LPT is a 4/3
     approximation to the optimal makespan, which bounds the worst
     shard's scan work — the property the placement-balance tripwire
-    (``(probed_rows / n_shards) * 1.5``) rides on."""
+    (``(probed_rows / n_shards) * 1.5``) rides on.
+
+    ``replication_factor=r > 1`` runs the SAME greedy once per replica
+    rank with an anti-co-location constraint: rank ``j`` skips the
+    shards already owning the list at ranks ``< j``, so a list's ``r``
+    copies always land on distinct shards and any ``r-1`` shard
+    failures leave every list with a healthy owner.  Each rank is
+    LPT-balanced against its own load vector; local slots draw from one
+    shared per-shard counter, so a shard's leaves hold the union of its
+    per-rank owned sets at consecutive slots (memory cost ~``r``×)."""
     sizes = np.asarray(list_sizes, np.int64).reshape(-1)
     n_lists = sizes.shape[0]
+    r = int(replication_factor)
     expects(n_shards >= 1, "compute_placement: n_shards must be >= 1")
     expects(n_lists >= n_shards,
             f"compute_placement: need n_lists ({n_lists}) >= n_shards "
             f"({n_shards}) to give every shard at least one list")
-    owner = np.zeros(n_lists, np.int32)
-    local_slot = np.zeros(n_lists, np.int32)
-    load = np.zeros(n_shards, np.int64)
-    count = np.zeros(n_shards, np.int64)
+    expects(1 <= r <= n_shards,
+            f"compute_placement: replication_factor ({r}) must be in "
+            f"[1, n_shards={n_shards}] — replicas of a list are never "
+            f"co-located, so each list needs {r} distinct shards")
+    owners = np.zeros((r, n_lists), np.int32)
+    slots = np.zeros((r, n_lists), np.int32)
+    load = np.zeros((r, n_shards), np.int64)
+    per_rank_count = np.zeros((r, n_shards), np.int64)
+    count = np.zeros(n_shards, np.int64)  # shared slot counter
     # stable argsort on -sizes: equal-size lists keep ascending id order
-    for g in np.argsort(-sizes, kind="stable"):
-        s = int(np.lexsort((count, load))[0])
-        owner[g] = s
-        local_slot[g] = count[s]
-        load[s] += int(sizes[g])
-        count[s] += 1
-    return Placement(owner=owner, local_slot=local_slot,
+    order = np.argsort(-sizes, kind="stable")
+    for rank in range(r):
+        for g in order:
+            taken = owners[:rank, g]
+            for s in np.lexsort((per_rank_count[rank], load[rank])):
+                if s not in taken:
+                    break
+            s = int(s)
+            owners[rank, g] = s
+            slots[rank, g] = count[s]
+            load[rank, s] += int(sizes[g])
+            per_rank_count[rank, s] += 1
+            count[s] += 1
+    return Placement(owner=owners[0], local_slot=slots[0],
                      n_shards=int(n_shards), n_local=int(count.max()),
-                     generation=int(generation))
+                     generation=int(generation),
+                     replication_factor=r,
+                     owners=owners if r > 1 else None,
+                     slots=slots if r > 1 else None)
 
 
 def placement_to_stream(res, stream, placement: Placement) -> None:
@@ -1028,23 +1263,43 @@ def placement_to_stream(res, stream, placement: Placement) -> None:
         ser.serialize_scalar(res, body, np.int64(placement.generation))
         ser.serialize_mdspan(res, body, placement.owner)
         ser.serialize_mdspan(res, body, placement.local_slot)
+        # v2 replication block: factor always, rank tables only when
+        # replicated (r=1 round-trips to the v1-equivalent shape)
+        ser.serialize_scalar(
+            res, body, np.int32(placement.replication_factor))
+        if placement.replication_factor > 1:
+            ser.serialize_mdspan(res, body, placement.owners)
+            ser.serialize_mdspan(res, body, placement.slots)
 
 
 def placement_from_stream(res, stream) -> Placement:
     body = ser.open_envelope(stream)
     version = int(ser.deserialize_scalar(res, body))
-    if version != _PLACEMENT_VERSION:
+    if not (_PLACEMENT_MIN_READ_VERSION <= version
+            <= _PLACEMENT_VERSION):
         raise ValueError(
             f"placement serialization version mismatch: got {version}, "
-            f"expected {_PLACEMENT_VERSION}")
+            f"expected {_PLACEMENT_MIN_READ_VERSION}.."
+            f"{_PLACEMENT_VERSION}")
     n_shards = int(ser.deserialize_scalar(res, body))
     n_local = int(ser.deserialize_scalar(res, body))
     generation = int(ser.deserialize_scalar(res, body))
     owner = np.asarray(ser.deserialize_mdspan(res, body), np.int32)
     local_slot = np.asarray(ser.deserialize_mdspan(res, body), np.int32)
+    replication_factor = 1
+    owners = slots = None
+    if version >= 2:
+        replication_factor = int(ser.deserialize_scalar(res, body))
+        if replication_factor > 1:
+            owners = np.asarray(
+                ser.deserialize_mdspan(res, body), np.int32)
+            slots = np.asarray(
+                ser.deserialize_mdspan(res, body), np.int32)
     return Placement(owner=owner, local_slot=local_slot,
                      n_shards=n_shards, n_local=n_local,
-                     generation=generation)
+                     generation=generation,
+                     replication_factor=replication_factor,
+                     owners=owners, slots=slots)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -1183,14 +1438,23 @@ def _place_lists(handle, global_leaves, rotation, placement: Placement,
 
 
 def shard_by_list(handle, index, *,
-                  placement: Optional[Placement] = None) -> RoutedIndex:
+                  placement: Optional[Placement] = None,
+                  replication_factor: int = 1) -> RoutedIndex:
     """Partition a single-chip IVF-PQ index's lists across the mesh.
 
     The index must carry the reconstruction cache (the shard-local scan
     is the recon formulation).  ``placement`` defaults to an LPT balance
     over *live* list sizes (tombstones excluded — dead rows cost scan
     work but a rebalance pass compacts them away, so balancing on live
-    rows keeps the placement stable across compactions)."""
+    rows keeps the placement stable across compactions).
+
+    ``replication_factor=r > 1`` materializes ``r`` copies of every
+    list on distinct shards (see :func:`compute_placement`): each shard's
+    stacked leaves hold the union of its per-rank owned sets, healthy
+    routing serves every list from its primary, and a failed shard's
+    lists fail over to replicas with results bit-identical to the
+    healthy run (ignored when an explicit ``placement`` is passed — the
+    placement carries its own factor)."""
     with named_range("distributed::shard_by_list"):
         expects(handle.comms_initialized(),
                 "distributed.ann.shard_by_list: handle has no comms")
@@ -1201,7 +1465,9 @@ def shard_by_list(handle, index, *,
         comms, mesh, axis, n_dev, devs = _mesh_layout(handle)
         if placement is None:
             live = _mutate.live_sizes(index.list_indices)
-            placement = compute_placement(np.asarray(live), n_dev)
+            placement = compute_placement(
+                np.asarray(live), n_dev,
+                replication_factor=replication_factor)
         rsq = index.list_recon_sq
         if rsq is None:
             rsq = ivf_pq._recon_sq(index.list_recon)
@@ -1232,8 +1498,8 @@ def shard_by_list(handle, index, *,
         return out
 
 
-def _build_by_list(handle, params: ivf_pq.IndexParams,
-                   dataset) -> RoutedIndex:
+def _build_by_list(handle, params: ivf_pq.IndexParams, dataset,
+                   replication_factor: int = 1) -> RoutedIndex:
     with named_range("distributed::ivf_pq_build_by_list"):
         expects(handle.comms_initialized(),
                 "distributed.ann.build: handle has no comms (use "
@@ -1250,7 +1516,8 @@ def _build_by_list(handle, params: ivf_pq.IndexParams,
         # ONE global quantizer/codebook train — the coarse structure is
         # tiny and replicated; only the lists are partitioned
         base = ivf_pq.build(handle, params, dataset)
-        return shard_by_list(handle, base)
+        return shard_by_list(handle, base,
+                             replication_factor=replication_factor)
 
 
 def _gather_global(index: RoutedIndex):
@@ -1461,7 +1728,8 @@ def rebalance_placement(handle, index: RoutedIndex, *,
             live = jnp.sum(li >= 0, axis=1).astype(jnp.int32)
             placement = compute_placement(
                 np.asarray(live), index.n_shards,
-                generation=index.placement.generation + 1)
+                generation=index.placement.generation + 1,
+                replication_factor=index.placement.replication_factor)
         out = _place_lists(handle, (centers, recon, rsq, li, sizes),
                            index.rotation, placement, index.metric,
                            index.size, code_leaves=code_leaves,
@@ -1474,8 +1742,13 @@ def rebalance_placement(handle, index: RoutedIndex, *,
 
 # v2 (round 10): trailing (has_codes, pq_bits, group_est) block and,
 # when has_codes, the lane-major compact-code cache (codebooks, lanes,
-# row norms) — v1 streams read fine and land uncalibrated/recon-only
-_ROUTED_SERIALIZATION_VERSION = 2
+# row norms) — v1 streams read fine and land uncalibrated/recon-only.
+# v3 (round 17): the embedded placement envelope may be placement-v2
+# (replicated rank tables); the routed body layout is unchanged, the
+# bump marks the back-compat read window.  v1/v2 streams still read
+# (and land r=1); v2 READERS cannot open a replicated v3 stream — the
+# version check fails loudly instead of mis-parsing the rank tables.
+_ROUTED_SERIALIZATION_VERSION = 3
 _ROUTED_MIN_READ_VERSION = 1
 
 
@@ -1952,7 +2225,8 @@ def _local_index(index, s):
     return out
 
 
-def health_check(handle, index, *, raise_on_fail: bool = True):
+def health_check(handle, index, *, raise_on_fail: bool = True,
+                 health=None):
     """Re-search every shard's stored recall canaries and compare against
     the stored floor (see :func:`raft_tpu.integrity.health_check`).
 
@@ -1960,9 +2234,28 @@ def health_check(handle, index, *, raise_on_fail: bool = True):
     (or ``None``) per shard, or ``None`` when the index carries no
     canaries.  With ``raise_on_fail`` (default) the first failing shard
     raises :class:`~raft_tpu.integrity.IntegrityError` — the error names
-    the shard in its message."""
+    the shard in its message.
+
+    ``health`` (a :class:`raft_tpu.distributed.health.HealthTracker`)
+    consumes the verdicts: a failing shard's canary notes a canary
+    failure (ticking ``integrity.canary_failure`` with the shard id),
+    a passing shard notes OK — repeated failures drive the shard
+    through SUSPECT into FAILED, repeated passes clear SUSPECT back to
+    HEALTHY.  On the routed path the global canary set cannot localize
+    the failure; its verdict is attributed to every shard not already
+    HEALTHY (the suspects are the plausible culprits), or to all shards
+    when none is suspect."""
     from raft_tpu.integrity import IntegrityError
     from raft_tpu.integrity import canary as _canary
+
+    def _note(shard, passed):
+        if health is None:
+            return
+        if passed:
+            health.note_ok(shard)
+        else:
+            health.note_canary_failure(shard)
+
     if isinstance(index, RoutedIndex):
         # routed indexes carry ONE global canary set (the quantizer is
         # global); the routed search is globally exact, so the standard
@@ -1970,8 +2263,19 @@ def health_check(handle, index, *, raise_on_fail: bool = True):
         # through this module (canary._search_canaries)
         if index.canaries is None:
             return None
-        return [_canary.health_check(handle, index,
-                                     raise_on_fail=raise_on_fail)]
+        try:
+            report = _canary.health_check(handle, index,
+                                          raise_on_fail=raise_on_fail)
+        except IntegrityError:
+            for s in _blame_shards(index.n_shards, health):
+                _note(s, False)
+            raise
+        passed = report is None or report.ok
+        targets = (range(index.n_shards) if passed
+                   else _blame_shards(index.n_shards, health))
+        for s in targets:
+            _note(s, passed)
+        return [report]
     cans = getattr(index, "shard_canaries", None)
     if cans is None:
         return None
@@ -1983,10 +2287,25 @@ def health_check(handle, index, *, raise_on_fail: bool = True):
         local = _local_index(index, s)
         local.canaries = cs
         try:
-            reports.append(_canary.health_check(
-                handle, local, raise_on_fail=raise_on_fail))
+            report = _canary.health_check(
+                handle, local, raise_on_fail=raise_on_fail)
         except IntegrityError as e:
+            _note(s, False)
             raise IntegrityError(f"shard {s}: {e}",
                                  invariant=e.invariant,
                                  coord=(s,) + tuple(e.coord or ())) from e
+        _note(s, report is None or report.ok)
+        reports.append(report)
     return reports
+
+
+def _blame_shards(n_shards: int, health) -> Tuple[int, ...]:
+    """Shards a non-localizable (global-canary) failure is attributed
+    to: the tracker's non-HEALTHY shards when any exist — the plausible
+    culprits — else every shard."""
+    if health is not None:
+        suspects = tuple(s for s in range(n_shards)
+                         if health.state(s) != "HEALTHY")
+        if suspects:
+            return suspects
+    return tuple(range(n_shards))

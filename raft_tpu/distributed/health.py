@@ -1,0 +1,348 @@
+"""Shard health lifecycle for the routed distributed index.
+
+The routed path (PR 8) answers a dead shard by masking its owned lists
+out of the merge, and PR 17's replicated placement answers it by
+failing the lists over to replicas — but nothing *decided* a shard was
+dead: ``failed_shards`` was purely caller-scripted.  This module is the
+decision loop — a per-shard state machine::
+
+    HEALTHY --strikes--> SUSPECT --strikes--> FAILED
+       ^                    |                    |
+       |<---consecutive OKs-+     begin_catch_up v
+       |                                    CATCHING_UP
+       +<----- readmit (canary-gated swap) ------+
+
+driven by three evidence streams the search path already produces:
+per-shard deadline overruns (``distributed.shard_timeout``), straggler
+flags from the fault plan's injected schedule, and failed
+``health_check`` canaries.  Flapping is pinned the same two ways as the
+PR 12 brownout controller: **hysteresis** (strikes escalate one state at
+a time; clearing SUSPECT takes ``ok_to_clear`` *consecutive* passes) and
+**dwell time** (``dwell_s`` must elapse in a state before the next
+transition in either direction).  Every transition lands a
+``distributed.health.*`` flight event (always-on recorder) plus the
+same-named counter — the paired-signal contract graftlint's
+``health-transition`` rule enforces.
+
+Readmission is anti-entropy catch-up: :func:`catch_up` rebuilds the
+recovering shard's leaves from the live replicas (a generation-delta
+replay — the stacked pytree's healthy copies ARE the authoritative
+state, the same way the WAL fold is) and publishes under **one**
+placement-generation bump; :func:`readmit` canary-gates the caught-up
+index and installs it through ``server.swap_index`` — the warmed atomic
+barrier — so routing resumes with zero steady-state recompiles (the
+routing tables are host-side numpy; replica choice is data, not shape).
+
+The tracker is deliberately NOT in the device path: all state is plain
+Python under one lock, clocks are injected (tests drive dwell
+synthetically), and the search path reads it with two tuple calls.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from raft_tpu.core.error import expects
+from raft_tpu.neighbors import mutate as _mutate
+from raft_tpu.observability import flight as _flight
+from raft_tpu.resilience import faults
+
+#: shard lifecycle states (strings, not an enum: they appear verbatim in
+#: flight-event attrs, stats dicts and test assertions)
+HEALTHY = "HEALTHY"
+SUSPECT = "SUSPECT"
+FAILED = "FAILED"
+CATCHING_UP = "CATCHING_UP"
+
+
+def _emit(event: str, **attrs) -> None:
+    """The paired transition signal: one always-on flight event plus the
+    same-named counter (gated, like every counter).  Transition sites
+    call this with LITERAL event names so the observability registry
+    self-registers ``distributed.health.*``."""
+    _flight.record_event(event, **attrs)
+    from raft_tpu import observability as obs
+    if obs.enabled():
+        obs.registry().counter(event).inc()
+
+
+@dataclasses.dataclass
+class HealthConfig:
+    """State-machine knobs.  Hysteresis is structural (strikes escalate
+    one state per threshold; clearing takes consecutive passes) and
+    ``dwell_s`` pins residency — together they absorb a flapping shard
+    (see ``FaultPlan.flap_shard``) instead of thrashing the placement.
+    """
+
+    #: evidence strikes at/above which a HEALTHY shard turns SUSPECT
+    #: (one deadline overrun or canary failure counts this many — hard
+    #: evidence suspects immediately; a straggle flag counts one)
+    suspect_after: int = 2
+    #: further strikes (counted from SUSPECT entry) at/above which a
+    #: SUSPECT shard is declared FAILED and leaves the routing
+    fail_after: int = 3
+    #: consecutive OK verdicts clearing SUSPECT back to HEALTHY
+    ok_to_clear: int = 2
+    #: minimum residency in a state before the next transition in
+    #: either direction (0 = transitions are immediate)
+    dwell_s: float = 0.0
+
+    def validate(self) -> "HealthConfig":
+        expects(self.suspect_after >= 1,
+                "health: suspect_after must be >= 1")
+        expects(self.fail_after >= 1, "health: fail_after must be >= 1")
+        expects(self.ok_to_clear >= 1, "health: ok_to_clear must be >= 1")
+        expects(self.dwell_s >= 0.0, "health: dwell_s must be >= 0")
+        return self
+
+
+class HealthTracker:
+    """Per-shard lifecycle state machine.  Thread-safe (evidence arrives
+    from the search path, canary loops and ops threads); the clock is
+    injected so tests drive dwell deterministically."""
+
+    def __init__(self, n_shards: int,
+                 config: Optional[HealthConfig] = None, *,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        expects(n_shards >= 1, "health: n_shards must be >= 1")
+        self.config = (config or HealthConfig()).validate()
+        self._clock = clock
+        self._lock = threading.Lock()
+        now = clock()
+        self._state: List[str] = [HEALTHY] * n_shards
+        self._strikes: List[int] = [0] * n_shards
+        self._oks: List[int] = [0] * n_shards
+        self._since: List[float] = [now] * n_shards
+        self._transitions: Dict[str, int] = {}
+
+    # -- evidence ----------------------------------------------------------
+    def note_straggle(self, shard: int) -> None:
+        """Soft evidence: the straggler detector flagged ``shard`` this
+        window (one strike)."""
+        self._strike(shard, "straggle", weight=1)
+
+    def note_timeout(self, shard: int) -> None:
+        """Hard evidence: ``shard`` overran its per-shard search
+        deadline — enough strikes to suspect a healthy shard at once."""
+        self._strike(shard, "timeout", weight=self.config.suspect_after)
+
+    def note_canary_failure(self, shard: int) -> None:
+        """Hard evidence: a recall canary attributed to ``shard``
+        failed.  Ticks ``integrity.canary_failure`` with the shard id
+        (the satellite the bare per-shard verdicts never had a consumer
+        for) and strikes like a timeout."""
+        _flight.record_event("integrity.canary_failure", shard=int(shard))
+        from raft_tpu import observability as obs
+        if obs.enabled():
+            obs.registry().counter("integrity.canary_failure").inc()
+        self._strike(shard, "canary", weight=self.config.suspect_after)
+
+    def note_ok(self, shard: int) -> None:
+        """A passing verdict (canary OK / answered in budget): resets
+        the strike run; ``ok_to_clear`` consecutive OKs clear SUSPECT
+        back to HEALTHY (after dwell)."""
+        s = int(shard)
+        recovered = False
+        with self._lock:
+            if self._state[s] == SUSPECT:
+                self._oks[s] += 1
+                now = self._clock()
+                if (self._oks[s] >= self.config.ok_to_clear
+                        and now - self._since[s] >= self.config.dwell_s):
+                    self._state[s] = HEALTHY
+                    self._since[s] = now
+                    self._strikes[s] = 0
+                    self._oks[s] = 0
+                    self._transitions["distributed.health.recovered"] = \
+                        self._transitions.get(
+                            "distributed.health.recovered", 0) + 1
+                    recovered = True
+            elif self._state[s] == HEALTHY:
+                self._strikes[s] = 0
+        if recovered:
+            _emit("distributed.health.recovered", shard=s)
+
+    def _strike(self, shard: int, cause: str, *, weight: int) -> None:
+        s = int(shard)
+        event = None
+        strikes = 0
+        with self._lock:
+            if self._state[s] in (FAILED, CATCHING_UP):
+                return  # already out of the routing; nothing to escalate
+            self._oks[s] = 0
+            self._strikes[s] += weight
+            strikes = self._strikes[s]
+            now = self._clock()
+            if now - self._since[s] < self.config.dwell_s:
+                return  # dwell pins the state; strikes keep accruing
+            if (self._state[s] == HEALTHY
+                    and strikes >= self.config.suspect_after):
+                self._state[s] = SUSPECT
+                self._since[s] = now
+                self._strikes[s] = 0
+                event = "distributed.health.suspect"
+            elif (self._state[s] == SUSPECT
+                    and strikes >= self.config.fail_after):
+                self._state[s] = FAILED
+                self._since[s] = now
+                self._strikes[s] = 0
+                event = "distributed.health.failed"
+            if event:
+                self._transitions[event] = \
+                    self._transitions.get(event, 0) + 1
+        if event == "distributed.health.suspect":
+            _emit("distributed.health.suspect", shard=s, cause=cause,
+                  strikes=strikes)
+        elif event == "distributed.health.failed":
+            _emit("distributed.health.failed", shard=s, cause=cause,
+                  strikes=strikes)
+
+    # -- readmission lifecycle ---------------------------------------------
+    def begin_catch_up(self, shard: int, **attrs) -> None:
+        """FAILED -> CATCHING_UP: the shard starts replaying what it
+        missed.  It stays OUT of the routing (``failed_shards`` keeps
+        reporting it) until :meth:`readmit`."""
+        s = int(shard)
+        with self._lock:
+            expects(self._state[s] == FAILED,
+                    f"health: shard {s} is {self._state[s]}, only a "
+                    f"FAILED shard can begin catch-up")
+            self._state[s] = CATCHING_UP
+            self._since[s] = self._clock()
+            self._transitions["distributed.health.catch_up"] = \
+                self._transitions.get("distributed.health.catch_up", 0) + 1
+        _emit("distributed.health.catch_up", shard=s, **attrs)
+
+    def readmit(self, shard: int) -> None:
+        """CATCHING_UP -> HEALTHY: the canary gate passed and the new
+        placement generation is published; routing resumes."""
+        s = int(shard)
+        with self._lock:
+            expects(self._state[s] == CATCHING_UP,
+                    f"health: shard {s} is {self._state[s]}, only a "
+                    f"CATCHING_UP shard can be readmitted")
+            self._state[s] = HEALTHY
+            self._since[s] = self._clock()
+            self._strikes[s] = 0
+            self._oks[s] = 0
+            self._transitions["distributed.health.readmitted"] = \
+                self._transitions.get(
+                    "distributed.health.readmitted", 0) + 1
+        _emit("distributed.health.readmitted", shard=s)
+
+    def block_readmit(self, shard: int, reason: str = "canary") -> None:
+        """CATCHING_UP -> FAILED: the readmission canary gate failed;
+        the shard stays out of the routing."""
+        s = int(shard)
+        with self._lock:
+            expects(self._state[s] == CATCHING_UP,
+                    f"health: shard {s} is {self._state[s]}, only a "
+                    f"CATCHING_UP readmission can be blocked")
+            self._state[s] = FAILED
+            self._since[s] = self._clock()
+            self._transitions["distributed.health.readmit_blocked"] = \
+                self._transitions.get(
+                    "distributed.health.readmit_blocked", 0) + 1
+        _emit("distributed.health.readmit_blocked", shard=s,
+              reason=reason)
+
+    # -- views -------------------------------------------------------------
+    def state(self, shard: int) -> str:
+        with self._lock:
+            return self._state[int(shard)]
+
+    def states(self) -> Tuple[str, ...]:
+        with self._lock:
+            return tuple(self._state)
+
+    def failed_shards(self) -> Tuple[int, ...]:
+        """Shards the routing must treat as down: FAILED plus
+        CATCHING_UP (a catching-up shard holds a stale generation — it
+        must not serve until readmitted)."""
+        with self._lock:
+            return tuple(s for s, st in enumerate(self._state)
+                         if st in (FAILED, CATCHING_UP))
+
+    def suspect_shards(self) -> Tuple[int, ...]:
+        """Shards under suspicion: still routed, but hedged when
+        replicas exist."""
+        with self._lock:
+            return tuple(s for s, st in enumerate(self._state)
+                         if st == SUSPECT)
+
+    def stats(self) -> Dict[str, object]:
+        """Snapshot for ops/bench: per-shard state + strike run and the
+        cumulative transition counts."""
+        with self._lock:
+            return {"states": tuple(self._state),
+                    "strikes": tuple(self._strikes),
+                    "transitions": dict(self._transitions)}
+
+
+# ---------------------------------------------------------------------------
+# anti-entropy readmission
+
+
+def catch_up(handle, index, shard: int, *,
+             tracker: Optional[HealthTracker] = None,
+             stale=None):
+    """Anti-entropy catch-up for recovering ``shard``: rebuild its
+    leaves from the live index (whose replicas hold every list the
+    shard owned — the generation-delta replay source, the same
+    authoritative-copy argument the WAL fold makes) and assemble the
+    result under **one** placement-generation bump.  Returns the
+    caught-up :class:`~raft_tpu.distributed.ann.RoutedIndex` — NOT yet
+    published: route it through :func:`readmit` so the canary gate and
+    the ``swap_index`` barrier stay in front of live traffic.
+
+    ``stale`` (the index snapshot the shard went down holding, when the
+    caller retained one) only feeds the ``generation_delta`` attribute
+    on the ``distributed.health.catch_up`` event — how far behind the
+    shard was."""
+    from raft_tpu.distributed import ann
+    expects(index.placement is not None,
+            "health.catch_up: index carries no placement map")
+    faults.maybe_fail("distributed.catch_up")
+    delta = _mutate.generation(index) - (
+        _mutate.generation(stale) if stale is not None else
+        _mutate.generation(index))
+    if tracker is not None:
+        tracker.begin_catch_up(shard, generation_delta=delta)
+    placement = dataclasses.replace(
+        index.placement, generation=index.placement.generation + 1)
+    # one generation bump: rebalance_placement gathers the live global
+    # arrays (replicas are authoritative for the dead shard's lists),
+    # re-places under the bumped placement and stamps the next index
+    # generation — the identical publish discipline every mutation uses
+    return ann.rebalance_placement(handle, index, placement=placement)
+
+
+def readmit(handle, server, index, shard: int, *,
+            tracker: Optional[HealthTracker] = None) -> bool:
+    """Canary-gated readmission: health-check the caught-up ``index``;
+    on pass, publish it through ``server.swap_index`` (the warmed atomic
+    barrier — zero steady-state recompiles) and move the tracker
+    CATCHING_UP -> HEALTHY.  On canary failure the shard goes back to
+    FAILED (``distributed.health.readmit_blocked``) and nothing is
+    published.  Returns True when routing resumed."""
+    from raft_tpu.distributed import ann
+    faults.maybe_fail("distributed.swap")
+    reports = ann.health_check(handle, index, raise_on_fail=False,
+                               health=None)
+    ok = all(r is None or r.ok for r in (reports or []))
+    if not ok:
+        if tracker is not None:
+            tracker.block_readmit(shard, reason="canary")
+        else:
+            _emit("distributed.health.readmit_blocked", shard=int(shard),
+                  reason="canary")
+        return False
+    server.swap_index(index)
+    if tracker is not None:
+        tracker.readmit(shard)
+    else:
+        _emit("distributed.health.readmitted", shard=int(shard))
+    return True

@@ -103,7 +103,13 @@ class FaultSpec:
     A spec with ``delay > 0`` (or ``jitter > 0``) is a *latency* spec:
     instead of raising it sleeps ``delay + jitter * rng()`` seconds when
     it fires (``exc`` is ignored).  Jitter draws come from the plan's
-    seeded RNG, so the schedule is deterministic under a pinned seed."""
+    seeded RNG, so the schedule is deterministic under a pinned seed.
+
+    A spec with ``_kill_shard`` set (via :meth:`FaultPlan.kill_shard_at`)
+    is a *shard-kill* spec: firing adds the shard to the plan's
+    failed-shard set instead of raising — the kill lands at a precise
+    lifecycle boundary and takes effect at the next
+    :func:`failed_shards` poll."""
 
     site: str
     times: Optional[int] = 1
@@ -112,6 +118,7 @@ class FaultSpec:
     p: float = 1.0
     delay: float = 0.0
     jitter: float = 0.0
+    _kill_shard: Optional[int] = None
     _seen: int = 0
     _fired: int = 0
 
@@ -142,6 +149,8 @@ class FaultPlan:
         self._specs: List[FaultSpec] = []
         self._failed_shards: set = set()
         self._stragglers: Dict[int, Tuple[float, float]] = {}
+        self._flapping: Dict[int, int] = {}   # shard -> poll period
+        self._flap_polls = 0
         self._lock = threading.Lock()
 
     # -- scripting ---------------------------------------------------------
@@ -181,8 +190,32 @@ class FaultPlan:
     def fail_shards(self, *shards: int) -> "FaultPlan":
         """Flag distributed-index shards as failed: degraded search
         (``distributed.ann``) drops them and reports them in the status
-        vector instead of crashing the query."""
+        vector instead of crashing the query (with a replicated
+        placement the shard's lists fail over to replicas first)."""
         self._failed_shards.update(int(s) for s in shards)
+        return self
+
+    def kill_shard_at(self, site: str, shard: int, *,
+                      after: int = 0) -> "FaultPlan":
+        """Kill ``shard`` when execution next passes ``site`` — the
+        lifecycle-boundary shard kill (route / scan / gather / swap /
+        catch-up).  Unlike :meth:`fail_shards` the shard is healthy
+        until the site fires; a search already past its failed-set
+        computation finishes on the pre-kill routing (the in-flight
+        race a real failure also exposes) and the NEXT search sees the
+        shard down."""
+        self._specs.append(FaultSpec(site=site, times=1, after=after,
+                                     _kill_shard=int(shard)))
+        return self
+
+    def flap_shard(self, shard: int, *, period: int = 1) -> "FaultPlan":
+        """Make ``shard`` flap: it alternates failed / healthy every
+        ``period`` :func:`failed_shards` polls (starting failed) — the
+        pathological readmission churn the health machine's hysteresis
+        + dwell exists to absorb."""
+        if period < 1:
+            raise ValueError("period must be >= 1")
+        self._flapping[int(shard)] = int(period)
         return self
 
     @property
@@ -205,6 +238,14 @@ class FaultPlan:
                 if spec.p < 1.0 and self._rng.random() >= spec.p:
                     continue
                 spec._fired += 1
+                if spec._kill_shard is not None:
+                    # shard-kill spec: the "failure" is a membership
+                    # change, not an exception — the current call keeps
+                    # its pre-kill routing, the next failed_shards()
+                    # poll sees the shard down
+                    self._failed_shards.add(spec._kill_shard)
+                    _count(site)
+                    continue
                 if spec.is_delay:
                     # draw jitter under the lock (deterministic order),
                     # sleep after releasing it — a straggling site must
@@ -314,28 +355,57 @@ def maybe_fail(site: str) -> None:
 
 def failed_shards(n_shards: int) -> Tuple[int, ...]:
     """Shards the active plan flags failed, clipped to ``range(n_shards)``
-    (empty when no plan is active)."""
+    (empty when no plan is active).  Flapping shards
+    (:meth:`FaultPlan.flap_shard`) alternate membership per poll —
+    starting failed — so each call may return a different set."""
     plan = _ACTIVE
     if plan is None:
         return ()
-    return tuple(sorted(s for s in plan._failed_shards
-                        if 0 <= s < n_shards))
+    with plan._lock:
+        down = set(plan._failed_shards)
+        if plan._flapping:
+            poll = plan._flap_polls
+            plan._flap_polls = poll + 1
+            for s, period in plan._flapping.items():
+                if (poll // period) % 2 == 0:
+                    down.add(s)
+    return tuple(sorted(s for s in down if 0 <= s < n_shards))
+
+
+def straggler_delays(n_shards: int) -> Tuple[float, ...]:
+    """Probe the active plan's per-shard straggler schedule WITHOUT
+    sleeping: the per-shard delay vector for one routed search (empty
+    when no plan scripts stragglers).  **No plan active → a single None
+    check.**  ``distributed.ann`` uses this to decide which shards to
+    hedge *before* paying the wait — a hedged shard's wait collapses to
+    its deadline (or zero) because the replica answers instead; the
+    residual wait goes through :func:`pause`."""
+    plan = _ACTIVE
+    if plan is None:
+        return ()
+    return plan._straggler_delays(n_shards)
+
+
+def pause(seconds: float) -> None:
+    """Host-side pause for an injected straggler wait.  The sleep lives
+    here, not in ``distributed.ann``, because the timing-discipline lint
+    confines ``time.sleep`` to the resilience layer.  Ticks
+    ``resilience.fault.delayed.distributed.straggler`` whenever a
+    positive wait is paid (the same counter :func:`straggler_pause`
+    always ticked)."""
+    if seconds > 0.0:
+        _count_delayed("distributed.straggler")
+        _sleep(seconds)
 
 
 def straggler_pause(n_shards: int) -> Tuple[float, ...]:
-    """The distributed-search straggler hook: host-side pause for the
-    slowest scripted shard, returning the per-shard delay vector (empty
-    when no plan scripts stragglers).  **No plan active → a single None
-    check.**  The sleep lives here, not in ``distributed.ann``, because
-    the timing-discipline lint confines ``time.sleep`` to the resilience
-    layer; the SPMD dispatch semantics ("the merge completes when the
-    last shard answers") make one max-delay pause per search the honest
-    host-side model — every shard's results still merge, exactly."""
-    plan = _ACTIVE
-    if plan is None:
-        return ()
-    delays = plan._straggler_delays(n_shards)
+    """The legacy one-shot straggler hook: probe + pause for the slowest
+    scripted shard, returning the per-shard delay vector.  The SPMD
+    dispatch semantics ("the merge completes when the last shard
+    answers") make one max-delay pause per search the honest host-side
+    model — every shard's results still merge, exactly.  Hedging-aware
+    callers use :func:`straggler_delays` / :func:`pause` separately."""
+    delays = straggler_delays(n_shards)
     if delays and max(delays) > 0.0:
-        _count_delayed("distributed.straggler")
-        _sleep(max(delays))
+        pause(max(delays))
     return delays

@@ -433,8 +433,12 @@ class DistributedExecutor(Executor):
         each artifact bakes the grouped scan at the STATIC group
         capacity for its bucket shape; that capacity rides in the cache
         key via the export kwargs, so re-warming after a bucket change
-        never aliases a stale group count.  Returns the number of cached
-        shard executables."""
+        never aliases a stale group count.  A replicated placement
+        (``replication_factor > 1``) warms one executable per REPLICA
+        RANK as well — a failover that promotes a shard's rank-j tables
+        must hit a warmed program, not a first-request compile (the
+        rank joins the cache key via the export kwargs).  Returns the
+        number of cached shard executables."""
         index = self.index
         if getattr(index, "local_centers", None) is None:
             return 0
@@ -443,18 +447,23 @@ class DistributedExecutor(Executor):
         cache = _aot_executables()
         n_probes = min(self.params.n_probes, index.n_lists)
         slots = int(index.local_centers.shape[1])
+        rf = (index.placement.replication_factor
+              if getattr(index, "placement", None) is not None else 1)
         n = 0
         for b in self.buckets:
             cap = grouped.group_capacity(b, n_probes, slots)[0]
             for k in self.ks:
                 for s in range(index.n_shards):
-                    kwargs = {"shard": s}
-                    if scan_mode == "fused":
-                        kwargs["group_capacity"] = cap
-                    cache.get("ivf_pq_routed", self.handle, index,
-                              batch=b, k=k, n_probes=n_probes,
-                              scan_mode=scan_mode, **kwargs)
-                    n += 1
+                    for rank in range(rf):
+                        kwargs = {"shard": s}
+                        if scan_mode == "fused":
+                            kwargs["group_capacity"] = cap
+                        if rank > 0:
+                            kwargs["replica_rank"] = rank
+                        cache.get("ivf_pq_routed", self.handle, index,
+                                  batch=b, k=k, n_probes=n_probes,
+                                  scan_mode=scan_mode, **kwargs)
+                        n += 1
         return n
 
     def _live_fn(self, index, k: int, params) -> Callable:

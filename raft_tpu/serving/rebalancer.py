@@ -490,7 +490,8 @@ def rebalance_routed(handle, index, *,
 
     placement = _dann.compute_placement(
         np.asarray(jnp.sum(gli >= 0, axis=1)), index.n_shards,
-        generation=index.placement.generation + 1)
+        generation=index.placement.generation + 1,
+        replication_factor=index.placement.replication_factor)
     cand = _dann._place_lists(handle, (centers, recon, rsq, gli, sizes),
                               index.rotation, placement, index.metric,
                               index.size, code_leaves=code_leaves,

@@ -3,6 +3,7 @@
 from scripts.graftlint.passes import (  # noqa: F401
     boundary_guard,
     generation_discipline,
+    health_transition,
     host_sync,
     mask_seam,
     recompile_hazard,

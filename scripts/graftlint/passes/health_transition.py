@@ -1,0 +1,146 @@
+"""health-transition: shard health moves leave a trail, placements bump.
+
+The PR 17 shard lifecycle (``raft_tpu/distributed/health.py``) promises
+two things the type system can't hold:
+
+- **Paired signals.**  Every health-state transition lands a
+  ``distributed.health.*`` flight event plus the same-named counter —
+  the chaos job's flight-trail gate and the failover bench both read
+  them.  A code path that flips a shard's state silently (no
+  ``record_event`` / ``_emit``) produces an index that routes around a
+  shard nobody can see went down.
+- **Generation-bumped publishes.**  A placement recompute that feeds a
+  swap must advance the placement generation (the executable-cache key
+  and the serving barrier both hang on it); recomputing from an
+  existing placement's ``.generation`` without threading ``generation=``
+  publishes a routing change old warmed executables still answer for.
+
+Two rules, both ``health-transition``:
+
+- a function under ``raft_tpu/distributed/`` that assigns to a
+  ``*state*``-named store (attribute or subscript — the tracker's
+  per-shard table) must, in the same function, call ``record_event`` or
+  an ``*emit*``-named helper;
+- a function under ``raft_tpu/distributed/`` or ``raft_tpu/serving/``
+  that calls ``compute_placement`` *and* reads ``.generation`` off an
+  existing placement must pass a ``generation=`` keyword — it is
+  re-deriving a successor placement and owes the bump.  (Fresh
+  placements — ``shard_by_list`` — read no generation and stay exempt.)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from scripts.graftlint.core import (
+    Diagnostic,
+    Project,
+    register,
+    terminal_name,
+    walk_functions,
+)
+
+_STATE_SCOPE = ("raft_tpu/distributed/",)
+_PLACEMENT_SCOPE = ("raft_tpu/distributed/", "raft_tpu/serving/")
+
+
+def _state_store(node: ast.AST):
+    """The attribute/subscript target of an assignment into a
+    ``*state*``-named store, or None."""
+    targets = []
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, ast.AugAssign):
+        targets = [node.target]
+    for t in targets:
+        base = t.value if isinstance(t, ast.Subscript) else t
+        if isinstance(base, ast.Attribute) and "state" in base.attr.lower():
+            return t
+        if isinstance(base, ast.Name) and "state" in base.id.lower():
+            return t
+    return None
+
+
+def _emits(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            callee = terminal_name(node.func)
+            if callee is None:
+                continue
+            if callee == "record_event" or "emit" in callee.lower():
+                return True
+    return False
+
+
+def _reads_placement_generation(fn: ast.AST) -> bool:
+    """``<something>.generation`` read anywhere in the function where
+    the base mentions a placement (``placement.generation``,
+    ``index.placement.generation``, ...)."""
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Attribute)
+                and node.attr == "generation"):
+            continue
+        base = node.value
+        if (isinstance(base, ast.Attribute)
+                and "placement" in base.attr.lower()):
+            return True
+        if isinstance(base, ast.Name) and "placement" in base.id.lower():
+            return True
+    return False
+
+
+@register
+class HealthTransitionPass:
+    name = "health-transition"
+    docs = {
+        "health-transition":
+            "shard health-state mutations must emit the paired flight "
+            "event + counter; placement recomputes derived from an "
+            "existing placement must thread the generation bump",
+    }
+
+    def run(self, project: Project) -> List[Diagnostic]:
+        out: List[Diagnostic] = []
+        for mod in project.walk(*_STATE_SCOPE):
+            for fn, _stack in walk_functions(mod.tree):
+                store = None
+                for node in ast.walk(fn):
+                    store = _state_store(node)
+                    if store is not None:
+                        lineno = node.lineno
+                        break
+                if store is None:
+                    continue
+                if _emits(fn):
+                    continue
+                out.append(Diagnostic(
+                    mod.rel, lineno, "health-transition",
+                    f"'{fn.name}' mutates shard health state without a "
+                    f"paired signal — every transition must land a "
+                    f"distributed.health.* flight event + counter "
+                    f"(call record_event or the module's _emit helper) "
+                    f"or the chaos flight-trail gate goes blind"))
+        for mod in project.walk(*_PLACEMENT_SCOPE):
+            for fn, _stack in walk_functions(mod.tree):
+                call = None
+                for node in ast.walk(fn):
+                    if (isinstance(node, ast.Call)
+                            and terminal_name(node.func)
+                            == "compute_placement"):
+                        call = node
+                        break
+                if call is None or fn.name == "compute_placement":
+                    continue
+                if not _reads_placement_generation(fn):
+                    continue  # fresh placement — no predecessor to bump
+                if any(kw.arg == "generation" for kw in call.keywords):
+                    continue
+                out.append(Diagnostic(
+                    mod.rel, call.lineno, "health-transition",
+                    f"'{fn.name}' recomputes a placement derived from "
+                    f"an existing one without passing generation= — a "
+                    f"published routing change outside a generation "
+                    f"bump lets stale warmed executables answer for "
+                    f"the old placement"))
+        return out

@@ -700,3 +700,481 @@ class TestRoutedAnn:
                 "ivf_pq.search.group_overflow").value - over0
         assert c1 == c0, f"{c1 - c0} recompiles in steady state"
         assert n_over == 0, "steady-state dispatch re-dispatched"
+
+
+class TestReplicatedRouted:
+    """PR 17 tentpole: replicated routed placement — recall-preserving
+    shard failover, hedged straggler reads, health-tracked lifecycle.
+
+    Contracts under test: with ``replication_factor=2`` and ANY single
+    shard failed, full-probe routed search is BIT-IDENTICAL to the
+    healthy run (the hierarchical-top-k exactness argument extends to a
+    replica serving a superset of lists); each pair kill at r=3 stays
+    exact; a kill at every lifecycle boundary (route / scan / gather /
+    swap / catch-up) either fails over exactly or degrades gracefully
+    with the documented status + flight trail; failover and readmission
+    trigger ZERO steady-state recompiles (replica choice is data, not
+    shape); hedged reads collapse a straggler's wait to the per-shard
+    deadline without changing one bit of the answer.
+    """
+
+    N, DIM, NL, NQ, K = 2048, 32, 32, 16, 10
+
+    @pytest.fixture(scope="class")
+    def rhandle(self):
+        devs = jax.devices()
+        if len(devs) < 8:
+            devs = jax.devices("cpu")
+        if len(devs) < 8:
+            pytest.skip("needs 8 devices")
+        from raft_tpu.comms import CommsSession
+        mesh = jax.sharding.Mesh(np.asarray(devs[:8]), ("data",))
+        s = CommsSession(mesh=mesh, axis_name="data").init()
+        yield s.worker_handle(seed=0)
+        s.destroy()
+
+    @pytest.fixture(scope="class")
+    def data(self):
+        rng = np.random.default_rng(7)
+        db = rng.normal(size=(self.N, self.DIM)).astype(np.float32)
+        q = rng.normal(size=(self.NQ, self.DIM)).astype(np.float32)
+        return db, q
+
+    @pytest.fixture(scope="class")
+    def built(self, rhandle, data):
+        from raft_tpu.distributed import ann
+        db, _ = data
+        params = ivf_pq.IndexParams(n_lists=self.NL, pq_dim=8,
+                                    kmeans_n_iters=3,
+                                    cache_reconstructions=True)
+        base = ivf_pq.build(rhandle, params, db)
+        return (base, ann.shard_by_list(rhandle, base),
+                ann.shard_by_list(rhandle, base, replication_factor=2))
+
+    # ---- placement invariants -------------------------------------------
+
+    def test_replicated_placement_invariants(self):
+        from raft_tpu.distributed import ann
+        sizes = np.random.default_rng(11).integers(5, 200, self.NL)
+        p1 = ann.compute_placement(sizes, 8)
+        for r in (2, 3):
+            p = ann.compute_placement(sizes, 8, replication_factor=r)
+            assert p.owners.shape == (r, self.NL)
+            # rank 0 IS the r=1 placement: a replicated index's healthy
+            # routing is bit-identical to the unreplicated one
+            np.testing.assert_array_equal(p.owner, p1.owner)
+            np.testing.assert_array_equal(p.local_slot, p1.local_slot)
+            # replicas of a list are never co-located
+            for g in range(self.NL):
+                assert len(set(p.owners[:, g].tolist())) == r
+            # every rank's slots land inside the shard's slot range
+            for s in range(8):
+                ls = p.shard_lists(s)
+                assert len(ls) == len(set(ls.tolist()))
+                for rank in range(r):
+                    mine = np.nonzero(p.owners[rank] == s)[0]
+                    assert set(mine.tolist()) <= set(ls.tolist())
+
+    def test_healthy_routing_covers_and_reports_residual(self):
+        from raft_tpu.distributed import ann
+        sizes = np.random.default_rng(12).integers(5, 200, self.NL)
+        p = ann.compute_placement(sizes, 8, replication_factor=2)
+        eo, es = p.healthy_routing((2,))
+        assert 2 not in set(eo.tolist())   # fully covered at r=2
+        # the replacement owner really owns the list at some rank, at
+        # the slot the tables say
+        for g in np.nonzero(p.owner == 2)[0]:
+            rank = np.nonzero(p.owners[:, g] == eo[g])[0]
+            assert rank.size == 1
+            assert es[g] == p.slots[rank[0], g]
+        # untouched lists keep the primary routing
+        keep = p.owner != 2
+        np.testing.assert_array_equal(eo[keep], p.owner[keep])
+        np.testing.assert_array_equal(es[keep], p.local_slot[keep])
+
+    def test_replication_needs_by_list_and_fits_mesh(self, rhandle, data):
+        from raft_tpu.core.error import RaftError
+        from raft_tpu.distributed import ann
+        db, _ = data
+        params = ivf_pq.IndexParams(n_lists=self.NL, pq_dim=8,
+                                    kmeans_n_iters=3,
+                                    cache_reconstructions=True)
+        with pytest.raises(RaftError):
+            ann.build(rhandle, params, db, replication_factor=2)  # by_row
+        with pytest.raises(RaftError):
+            ann.compute_placement(np.ones(self.NL, np.int64), 8,
+                                  replication_factor=9)
+
+    # ---- tentpole: failover exactness -----------------------------------
+
+    def test_single_shard_failover_bit_identical(self, rhandle, data,
+                                                 built):
+        """Acceptance criterion: r=2, ANY single shard failed, full
+        probe — bit-identical to the healthy run, the failed shard
+        reported as replica-served (telemetry, not degradation)."""
+        from raft_tpu.distributed import ann
+        from raft_tpu.observability import flight
+        _, q = data
+        _, _, r2 = built
+        sp = ivf_pq.SearchParams(n_probes=self.NL)
+        d0, i0 = ann.search(rhandle, sp, r2, q, self.K)
+        for dead in range(8):
+            flight.clear()
+            d1, i1, st = ann.search(rhandle, sp, r2, q, self.K,
+                                    failed_shards=[dead],
+                                    return_status=True)
+            np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+            np.testing.assert_array_equal(np.asarray(d1), np.asarray(d0))
+            st = np.asarray(st)
+            assert st[dead] == ann.SHARD_REPLICA_SERVED
+            ok = np.delete(st, dead)
+            np.testing.assert_array_equal(
+                ok, np.full(7, ann.SHARD_OK, np.int8))
+            evs = flight.events("distributed.replica_failover")
+            assert evs and evs[0]["attrs"]["covered"] == [dead]
+            # a fully covered failover is NOT a degraded search
+            assert not flight.events("distributed.degraded_search")
+
+    def test_replicated_healthy_run_matches_single_index(self, rhandle,
+                                                         data, built):
+        from raft_tpu.core.outputs import raw
+        from raft_tpu.distributed import ann
+        _, q = data
+        base, r1, r2 = built
+        sp = ivf_pq.SearchParams(n_probes=self.NL, scan_mode="recon")
+        bd, bi = raw(ivf_pq.search)(rhandle, sp, base, q, self.K)
+        rd, ri = ann.search(rhandle, sp, r2, q, self.K)
+        np.testing.assert_array_equal(np.asarray(ri), np.asarray(bi))
+        # rank 0 == the r=1 placement: same routing, same answer
+        d1, i1 = ann.search(rhandle, sp, r1, q, self.K)
+        np.testing.assert_array_equal(np.asarray(ri), np.asarray(i1))
+
+    def test_pair_kills_at_r3_bit_identical(self, rhandle, data):
+        """Satellite: every shard PAIR killed at r=3 stays exact — two
+        replicas lost still leaves one live owner per list."""
+        import itertools
+        from raft_tpu.distributed import ann
+        db, q = data
+        params = ivf_pq.IndexParams(n_lists=self.NL, pq_dim=8,
+                                    kmeans_n_iters=3,
+                                    cache_reconstructions=True)
+        base = ivf_pq.build(rhandle, params, db)
+        r3 = ann.shard_by_list(rhandle, base, replication_factor=3)
+        sp = ivf_pq.SearchParams(n_probes=self.NL)
+        d0, i0 = ann.search(rhandle, sp, r3, q, self.K)
+        for a, b in itertools.combinations(range(8), 2):
+            d1, i1, st = ann.search(rhandle, sp, r3, q, self.K,
+                                    failed_shards=[a, b],
+                                    return_status=True)
+            np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+            st = np.asarray(st)
+            assert (st[a] == ann.SHARD_REPLICA_SERVED
+                    and st[b] == ann.SHARD_REPLICA_SERVED), (a, b, st)
+
+    def test_fused_path_failover_bit_identical(self, rhandle, data,
+                                               built):
+        from raft_tpu.distributed import ann
+        _, q = data
+        _, _, r2 = built
+        sp = ivf_pq.SearchParams(n_probes=self.NL, scan_mode="fused")
+        d0, i0 = ann.search(rhandle, sp, r2, q, self.K)
+        d1, i1 = ann.search(rhandle, sp, r2, q, self.K,
+                            failed_shards=[5])
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d0))
+
+    def test_uncovered_failure_degrades_gracefully(self, rhandle, data,
+                                                   built):
+        """When replicas do NOT cover the loss (a pair kill at r=2 can
+        strand lists), the residual shards report SHARD_FAILED with the
+        degraded-search flight event — the PR 8 contract, unchanged."""
+        import itertools
+        from raft_tpu.distributed import ann
+        from raft_tpu.observability import flight
+        _, q = data
+        base, _, r2 = built
+        sp = ivf_pq.SearchParams(n_probes=self.NL)
+        p = r2.placement
+        # find a pair that strands at least one list (both owners dead)
+        stranded_pair = None
+        for a, b in itertools.combinations(range(8), 2):
+            eo, _ = p.healthy_routing((a, b))
+            if set(eo.tolist()) & {a, b}:
+                stranded_pair = (a, b)
+                break
+        assert stranded_pair is not None, "r=2 pair kill always covered?"
+        a, b = stranded_pair
+        flight.clear()
+        d, i, st = ann.search(rhandle, sp, r2, q, self.K,
+                              failed_shards=[a, b], return_status=True)
+        st = np.asarray(st)
+        eo, _ = p.healthy_routing((a, b))
+        residual = sorted(set(eo.tolist()) & {a, b})
+        for s in (a, b):
+            want = (ann.SHARD_FAILED if s in residual
+                    else ann.SHARD_REPLICA_SERVED)
+            assert st[s] == want, (s, st)
+        evs = flight.events("distributed.degraded_search")
+        assert evs and evs[0]["attrs"]["failed"] == residual
+        # stranded lists' ids are gone; everything else still answers
+        li = np.asarray(base.list_indices)
+        stranded = [g for g in range(self.NL)
+                    if eo[g] in (a, b)]
+        lost = set(li[stranded][li[stranded] >= 0].ravel().tolist())
+        found = set(np.asarray(i).ravel().tolist()) - {-1}
+        assert not (found & lost)
+
+    # ---- kill matrix: lifecycle boundaries ------------------------------
+
+    def test_kill_at_route_boundary_fails_over_this_search(
+            self, rhandle, data, built):
+        """A kill landing at the ROUTE boundary is seen by the same
+        search's failed-set computation — it fails over immediately."""
+        from raft_tpu.distributed import ann
+        from raft_tpu.resilience import FaultPlan
+        _, q = data
+        _, _, r2 = built
+        sp = ivf_pq.SearchParams(n_probes=self.NL)
+        d0, i0 = ann.search(rhandle, sp, r2, q, self.K)
+        plan = FaultPlan(seed=5).kill_shard_at("distributed.route", 3)
+        with plan.active():
+            d1, i1, st = ann.search(rhandle, sp, r2, q, self.K,
+                                    return_status=True)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+        assert np.asarray(st)[3] == ann.SHARD_REPLICA_SERVED
+
+    @pytest.mark.parametrize("site", ["distributed.scan",
+                                      "distributed.gather"])
+    def test_kill_at_scan_and_gather_boundaries(self, rhandle, data,
+                                                built, site):
+        """A kill landing mid-SCAN or at the GATHER keeps the in-flight
+        search on its pre-kill routing (the shard's answer completes —
+        the race a real failure also exposes); the NEXT search routes
+        around the dead shard, bit-identically."""
+        from raft_tpu.distributed import ann
+        from raft_tpu.resilience import FaultPlan
+        _, q = data
+        _, _, r2 = built
+        sp = ivf_pq.SearchParams(n_probes=self.NL)
+        d0, i0 = ann.search(rhandle, sp, r2, q, self.K)
+        plan = FaultPlan(seed=5).kill_shard_at(site, 6)
+        with plan.active():
+            d1, i1, st1 = ann.search(rhandle, sp, r2, q, self.K,
+                                     return_status=True)
+            d2, i2, st2 = ann.search(rhandle, sp, r2, q, self.K,
+                                     return_status=True)
+        # in-flight search: pre-kill routing, all shards OK
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+        np.testing.assert_array_equal(
+            np.asarray(st1), np.full(8, ann.SHARD_OK, np.int8))
+        # next search: failover, still bit-identical
+        np.testing.assert_array_equal(np.asarray(i2), np.asarray(i0))
+        assert np.asarray(st2)[6] == ann.SHARD_REPLICA_SERVED
+
+    def test_kill_at_swap_and_catch_up_boundaries(self, rhandle, data,
+                                                  built):
+        """Kills landing during READMISSION itself: one shard dies while
+        another's catch-up is gathering (catch-up boundary), another
+        dies inside the swap barrier — every subsequent search stays
+        bit-identical while replicas cover the loss."""
+        from raft_tpu.distributed import ann, health
+        from raft_tpu.resilience import FaultPlan
+        _, q = data
+        _, _, r2 = built
+        sp = ivf_pq.SearchParams(n_probes=self.NL)
+        d0, i0 = ann.search(rhandle, sp, r2, q, self.K)
+
+        class _Server:
+            def __init__(self):
+                self.swapped = []
+
+            def swap_index(self, idx):
+                self.swapped.append(idx)
+
+        srv = _Server()
+        tr = health.HealthTracker(8, health.HealthConfig(
+            suspect_after=1, fail_after=1, ok_to_clear=1, dwell_s=0.0))
+        tr.note_timeout(2)
+        tr.note_timeout(2)
+        assert tr.state(2) == health.FAILED
+        plan = (FaultPlan(seed=5)
+                .kill_shard_at("distributed.catch_up", 4)
+                .kill_shard_at("distributed.swap", 7))
+        with plan.active():
+            caught = health.catch_up(rhandle, r2, 2, tracker=tr)
+            assert tr.state(2) == health.CATCHING_UP
+            assert health.readmit(rhandle, srv, caught, 2, tracker=tr)
+            assert tr.state(2) == health.HEALTHY
+            assert srv.swapped and srv.swapped[0] is caught
+            # shards 4 and 7 died at the catch-up / swap boundaries;
+            # the published index still answers bit-identically
+            live = srv.swapped[0]
+            d1, i1, st = ann.search(rhandle, sp, live, q, self.K,
+                                    return_status=True)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+        st = np.asarray(st)
+        assert st[4] == ann.SHARD_REPLICA_SERVED
+        assert st[7] == ann.SHARD_REPLICA_SERVED
+
+    # ---- zero recompiles -------------------------------------------------
+
+    def test_failover_and_readmission_zero_recompiles(self, rhandle,
+                                                      data, built):
+        """Replica choice is data, not shape: a fully covered failover
+        reuses the warmed healthy executable (the static ``failed`` key
+        stays ``()``), and a readmitted generation's search does too."""
+        from raft_tpu.distributed import ann, health
+        _, q = data
+        _, _, r2 = built
+        sp = ivf_pq.SearchParams(n_probes=self.NL)
+        ann.search(rhandle, sp, r2, q, self.K)          # warm
+        tr = health.HealthTracker(8, health.HealthConfig(
+            suspect_after=1, fail_after=1, ok_to_clear=1, dwell_s=0.0))
+        with obs.collecting():
+            c0 = obs.registry().counter("xla.compiles").value
+            _, i1 = ann.search(rhandle, sp, r2, q, self.K,
+                               failed_shards=[1])
+            c1 = obs.registry().counter("xla.compiles").value
+            assert c1 == c0, f"{c1 - c0} recompiles on covered failover"
+        # fail -> catch up -> readmit, then the steady-state search
+        tr.note_timeout(1)
+        tr.note_timeout(1)
+        caught = health.catch_up(rhandle, r2, 1, tracker=tr)
+
+        class _Server:
+            def swap_index(self, idx):
+                pass
+
+        assert health.readmit(rhandle, _Server(), caught, 1, tracker=tr)
+        ann.search(rhandle, sp, caught, q, self.K)      # first post-swap
+        with obs.collecting():
+            c0 = obs.registry().counter("xla.compiles").value
+            _, i2 = ann.search(rhandle, sp, caught, q, self.K, health=tr)
+            c1 = obs.registry().counter("xla.compiles").value
+        assert c1 == c0, f"{c1 - c0} recompiles after readmission"
+        np.testing.assert_array_equal(np.asarray(i2), np.asarray(i1))
+
+    # ---- hedged reads ----------------------------------------------------
+
+    def test_hedged_read_exact_wait_collapses_to_deadline(
+            self, rhandle, data, built, monkeypatch):
+        """Satellite: a 10x straggler behind a replica is hedged — the
+        wait collapses from the scripted delay to the per-shard
+        deadline, the answer stays bit-identical, and the shard reports
+        replica-served with the hedged_read + shard_timeout trail."""
+        from raft_tpu.distributed import ann
+        from raft_tpu.observability import flight
+        from raft_tpu.resilience import FaultPlan, faults
+        slept = []
+        monkeypatch.setattr(faults, "_sleep", slept.append)
+        _, q = data
+        _, _, r2 = built
+        sp = ivf_pq.SearchParams(n_probes=self.NL)
+        d0, i0 = ann.search(rhandle, sp, r2, q, self.K)
+        flight.clear()
+        plan = FaultPlan(seed=3).straggle_shard(2, delay=0.5)
+        with plan.active():
+            d1, i1, st = ann.search(rhandle, sp, r2, q, self.K,
+                                    shard_deadline_s=0.05,
+                                    return_status=True)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d0))
+        assert slept == [0.05], slept
+        assert np.asarray(st)[2] == ann.SHARD_REPLICA_SERVED
+        hedges = flight.events("distributed.hedged_read")
+        assert hedges and hedges[0]["attrs"]["shard"] == 2
+        touts = flight.events("distributed.shard_timeout")
+        assert touts and touts[0]["attrs"]["shard"] == 2
+
+    def test_straggler_without_replica_waits_in_full(self, rhandle, data,
+                                                     built, monkeypatch):
+        """No covering replica -> the shard is UN-hedged: slow beats
+        dropped, the full scripted delay is paid, results exact (the
+        PR 12 contract survives the hedging rewrite)."""
+        from raft_tpu.distributed import ann
+        from raft_tpu.resilience import FaultPlan, faults
+        slept = []
+        monkeypatch.setattr(faults, "_sleep", slept.append)
+        _, q = data
+        _, r1, _ = built
+        sp = ivf_pq.SearchParams(n_probes=self.NL)
+        d0, i0 = ann.search(rhandle, sp, r1, q, self.K)
+        plan = FaultPlan(seed=3).straggle_shard(1, delay=0.04)
+        with plan.active():
+            d1, i1 = ann.search(rhandle, sp, r1, q, self.K,
+                                shard_deadline_s=0.01)
+        assert slept == [0.04], slept
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+
+    # ---- serialization ---------------------------------------------------
+
+    def test_replicated_placement_serialization_roundtrip(self, rhandle,
+                                                          built):
+        import io
+        from raft_tpu.distributed import ann
+        _, _, r2 = built
+        p = r2.placement
+        buf = io.BytesIO()
+        ann.placement_to_stream(rhandle, buf, p)
+        buf.seek(0)
+        back = ann.placement_from_stream(rhandle, buf)
+        assert back.replication_factor == 2
+        np.testing.assert_array_equal(back.owners, p.owners)
+        np.testing.assert_array_equal(back.slots, p.slots)
+        np.testing.assert_array_equal(back.owner, p.owner)
+        np.testing.assert_array_equal(back.local_slot, p.local_slot)
+
+    def test_replicated_routed_serialization_failover_survives(
+            self, rhandle, data, built):
+        """A deserialized replicated index re-places from the placement
+        envelope alone — failover still bit-identical after reload."""
+        import io
+        from raft_tpu.distributed import ann
+        _, q = data
+        _, _, r2 = built
+        buf = io.BytesIO()
+        ann.serialize_routed(rhandle, buf, r2)
+        buf.seek(0)
+        back = ann.deserialize_routed(rhandle, buf)
+        assert back.placement.replication_factor == 2
+        sp = ivf_pq.SearchParams(n_probes=self.NL)
+        d0, i0 = ann.search(rhandle, sp, r2, q, self.K)
+        d1, i1, st = ann.search(rhandle, sp, back, q, self.K,
+                                failed_shards=[0], return_status=True)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+        assert np.asarray(st)[0] == ann.SHARD_REPLICA_SERVED
+
+    # ---- prewarm / AOT per replica rank ---------------------------------
+
+    def test_aot_export_replica_rank_serves_failed_primary(
+            self, rhandle, data, built):
+        """Per-rank exports: merging every shard's rank-appropriate
+        program reproduces the failover answer — the artifact set a
+        deployment needs to survive a dead primary."""
+        from raft_tpu.core import aot
+        from raft_tpu.distributed import ann
+        _, q = data
+        _, _, r2 = built
+        buf0 = aot.export_ivf_pq_routed_search(
+            rhandle, r2, 0, 8, self.K, self.NQ)
+        buf1 = aot.export_ivf_pq_routed_search(
+            rhandle, r2, 0, 8, self.K, self.NQ, replica_rank=1)
+        d0, i0 = aot.load_search_fn(buf0)(jnp.asarray(q))
+        d1, i1 = aot.load_search_fn(buf1)(jnp.asarray(q))
+        # rank tables differ -> the same shard answers for different
+        # list subsets under the two programs
+        assert not np.array_equal(np.asarray(i0), np.asarray(i1))
+        with pytest.raises(Exception):
+            aot.export_ivf_pq_routed_search(
+                rhandle, r2, 0, 8, self.K, self.NQ, replica_rank=2)
+
+    def test_executor_prewarms_per_replica_rank(self, rhandle, data,
+                                                built):
+        from raft_tpu.serving.executor import DistributedExecutor
+        _, _, r2 = built
+        ex = DistributedExecutor(
+            rhandle, r2, ks=(self.K,), max_batch=16,
+            search_params=ivf_pq.SearchParams(n_probes=8))
+        n = ex.prewarm_shard_artifacts(scan_mode="recon")
+        # buckets x ks x shards x ranks
+        assert n == len(ex.buckets) * 1 * 8 * 2

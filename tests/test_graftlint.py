@@ -448,6 +448,85 @@ class TestRegistryConsistency:
 
 
 # ---------------------------------------------------------------------------
+# health-transition
+
+class TestHealthTransition:
+    def test_silent_state_mutation_flagged(self):
+        src = ("def fail(self, s):\n"
+               "    self._state[s] = 'FAILED'\n")
+        diags = lint({"raft_tpu/distributed/x.py": src},
+                     rules=["health-transition"])
+        assert [d.rule for d in diags] == ["health-transition"]
+        assert diags[0].line == 2
+        assert "paired signal" in diags[0].message
+
+    def test_state_mutation_with_record_event_clean(self):
+        src = ("from raft_tpu.observability.flight import record_event\n"
+               "def fail(self, s):\n"
+               "    self._state[s] = 'FAILED'\n"
+               "    record_event('distributed.health.failed', shard=s)\n")
+        assert lint({"raft_tpu/distributed/x.py": src},
+                    rules=["health-transition"]) == []
+
+    def test_emit_helper_counts_as_signal(self):
+        # the tracker's one-level indirection: transitions go through
+        # the module _emit helper, not a literal record_event call
+        src = ("def fail(self, s):\n"
+               "    self._state[s] = 'FAILED'\n"
+               "    _emit('distributed.health.failed', shard=s)\n")
+        assert lint({"raft_tpu/distributed/x.py": src},
+                    rules=["health-transition"]) == []
+
+    def test_state_rule_scoped_to_distributed(self):
+        src = ("def fail(self, s):\n"
+               "    self._state[s] = 'FAILED'\n")
+        assert lint({"raft_tpu/serving/x.py": src},
+                    rules=["health-transition"]) == []
+        assert lint({"raft_tpu/neighbors/x.py": src},
+                    rules=["health-transition"]) == []
+
+    def test_non_state_assignment_clean(self):
+        src = ("def note(self, s):\n"
+               "    self._strikes[s] = 0\n")
+        assert lint({"raft_tpu/distributed/x.py": src},
+                    rules=["health-transition"]) == []
+
+    def test_unbumped_successor_placement_flagged(self):
+        # reading .generation off an existing placement = deriving a
+        # successor; recomputing without generation= skips the bump
+        src = ("def recover(index, sizes):\n"
+               "    g = index.placement.generation\n"
+               "    return compute_placement(sizes, 8)\n")
+        diags = lint({"raft_tpu/distributed/x.py": src},
+                     rules=["health-transition"])
+        assert [d.rule for d in diags] == ["health-transition"]
+        assert "generation" in diags[0].message
+
+    def test_bumped_successor_placement_clean(self):
+        src = ("def recover(index, sizes):\n"
+               "    g = index.placement.generation\n"
+               "    return compute_placement(sizes, 8, generation=g + 1)\n")
+        assert lint({"raft_tpu/distributed/x.py": src},
+                    rules=["health-transition"]) == []
+
+    def test_fresh_placement_exempt(self):
+        # no predecessor generation read -> a fresh placement (the
+        # shard_by_list path), no bump owed
+        src = ("def place(sizes):\n"
+               "    return compute_placement(sizes, 8)\n")
+        assert lint({"raft_tpu/distributed/x.py": src},
+                    rules=["health-transition"]) == []
+
+    def test_placement_rule_covers_serving(self):
+        src = ("def rebalance(index, sizes):\n"
+               "    g = index.placement.generation\n"
+               "    return compute_placement(sizes, 8)\n")
+        diags = lint({"raft_tpu/serving/x.py": src},
+                     rules=["health-transition"])
+        assert [d.rule for d in diags] == ["health-transition"]
+
+
+# ---------------------------------------------------------------------------
 # host-sync
 
 class TestHostSync:
@@ -580,6 +659,18 @@ class TestLiveTree:
         # fault site defined through the _entry(site, ...) wrapper
         assert "distributed.ann.search" in d["fault_sites"]
         assert "rebalance.swap" in d["fault_sites"]
+        # health lifecycle: the tracker's literal-named _emit sites and
+        # the readmission fault sites (PR 17)
+        for name in ("distributed.health.suspect",
+                     "distributed.health.failed",
+                     "distributed.health.catch_up",
+                     "distributed.health.readmitted",
+                     "distributed.health.readmit_blocked",
+                     "distributed.health.recovered",
+                     "distributed.hedged_reads"):
+            assert reg.resolves_metric(name), name
+        assert "distributed.catch_up" in d["fault_sites"]
+        assert "distributed.swap" in d["fault_sites"]
         # f-string dynamic names register as prefixes
         assert "comms." in d["prefixes"]["counter"]
         assert reg.resolves_metric("comms.allreduce.calls")

@@ -1,0 +1,348 @@
+"""Shard health lifecycle state machine (PR 17) — pure-Python unit
+tests: strike weights, hysteresis, dwell pinning, flap absorption, the
+readmission guards and the paired flight-event + counter signal on every
+transition.  The integration half (tracker-driven failover, catch-up,
+canary-gated readmit on a live mesh) lives in
+``tests/test_distributed.py::TestReplicatedRouted``.
+"""
+
+import pytest
+
+from raft_tpu import observability as obs
+from raft_tpu.core.error import RaftError
+from raft_tpu.distributed import health
+from raft_tpu.distributed.health import (
+    CATCHING_UP,
+    FAILED,
+    HEALTHY,
+    SUSPECT,
+    HealthConfig,
+    HealthTracker,
+)
+from raft_tpu.observability import flight
+from raft_tpu.resilience import FaultPlan, faults
+
+
+class _Clock:
+    """Injected monotonic clock — tests drive dwell synthetically."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _tracker(n=4, **kw):
+    clock = _Clock()
+    return HealthTracker(n, HealthConfig(**kw), clock=clock), clock
+
+
+class TestConfig:
+    def test_defaults_validate(self):
+        cfg = HealthConfig()
+        assert cfg.validate() is cfg
+
+    @pytest.mark.parametrize("kw", [dict(suspect_after=0),
+                                    dict(fail_after=0),
+                                    dict(ok_to_clear=0),
+                                    dict(dwell_s=-1.0)])
+    def test_rejects_bad_knobs(self, kw):
+        with pytest.raises(RaftError):
+            HealthConfig(**kw).validate()
+
+    def test_tracker_rejects_empty(self):
+        with pytest.raises(RaftError):
+            HealthTracker(0)
+
+
+class TestStrikes:
+    def test_initial_state_all_healthy(self):
+        tr, _ = _tracker()
+        assert tr.states() == (HEALTHY,) * 4
+        assert tr.failed_shards() == ()
+        assert tr.suspect_shards() == ()
+
+    def test_straggles_are_soft_evidence(self):
+        """One straggle strike is not enough at suspect_after=2; the
+        second suspects.  The strike run resets on SUSPECT entry, so
+        escalation to FAILED counts fresh strikes."""
+        tr, _ = _tracker(suspect_after=2, fail_after=3)
+        tr.note_straggle(1)
+        assert tr.state(1) == HEALTHY
+        tr.note_straggle(1)
+        assert tr.state(1) == SUSPECT
+        assert tr.suspect_shards() == (1,)
+        tr.note_straggle(1)
+        tr.note_straggle(1)
+        assert tr.state(1) == SUSPECT  # 2 < fail_after=3
+        tr.note_straggle(1)
+        assert tr.state(1) == FAILED
+        assert tr.failed_shards() == (1,)
+
+    def test_timeout_is_hard_evidence(self):
+        """A deadline overrun carries suspect_after weight — a healthy
+        shard is SUSPECT after ONE timeout regardless of the knob."""
+        tr, _ = _tracker(suspect_after=3, fail_after=3)
+        tr.note_timeout(2)
+        assert tr.state(2) == SUSPECT
+        tr.note_timeout(2)
+        assert tr.state(2) == FAILED
+
+    def test_ok_resets_a_partial_strike_run(self):
+        tr, _ = _tracker(suspect_after=2)
+        tr.note_straggle(0)
+        tr.note_ok(0)
+        tr.note_straggle(0)
+        assert tr.state(0) == HEALTHY  # run was reset, 1 < 2
+
+    def test_flapping_evidence_is_absorbed(self):
+        """The hysteresis story: alternating straggle/OK forever never
+        escalates — each OK clears the run before it reaches the
+        threshold.  Zero transitions recorded."""
+        tr, _ = _tracker(suspect_after=2, fail_after=3)
+        for _ in range(20):
+            tr.note_straggle(3)
+            tr.note_ok(3)
+        assert tr.state(3) == HEALTHY
+        assert tr.stats()["transitions"] == {}
+
+    def test_failed_shard_absorbs_further_strikes(self):
+        tr, _ = _tracker(suspect_after=1, fail_after=1)
+        tr.note_timeout(0)
+        tr.note_timeout(0)
+        assert tr.state(0) == FAILED
+        flight.clear()
+        tr.note_timeout(0)
+        tr.note_straggle(0)
+        assert tr.state(0) == FAILED
+        assert not flight.events("distributed.health.failed")
+
+
+class TestClearing:
+    def test_consecutive_oks_clear_suspect(self):
+        tr, _ = _tracker(suspect_after=1, ok_to_clear=2)
+        tr.note_timeout(1)
+        assert tr.state(1) == SUSPECT
+        tr.note_ok(1)
+        assert tr.state(1) == SUSPECT  # 1 < ok_to_clear
+        tr.note_ok(1)
+        assert tr.state(1) == HEALTHY
+        assert tr.stats()["transitions"] == {
+            "distributed.health.suspect": 1,
+            "distributed.health.recovered": 1,
+        }
+
+    def test_a_strike_resets_the_ok_run(self):
+        """OKs must be CONSECUTIVE: a straggle in the middle restarts
+        the count — the other half of the hysteresis."""
+        tr, _ = _tracker(suspect_after=1, fail_after=5, ok_to_clear=2)
+        tr.note_timeout(1)
+        tr.note_ok(1)
+        tr.note_straggle(1)  # resets the OK run
+        tr.note_ok(1)
+        assert tr.state(1) == SUSPECT
+        tr.note_ok(1)
+        assert tr.state(1) == HEALTHY
+
+
+class TestDwell:
+    def test_dwell_pins_escalation(self):
+        """Strikes accrue during dwell but the transition waits for
+        residency — a burst right after suspecting cannot fail the
+        shard until dwell_s elapses."""
+        tr, clock = _tracker(suspect_after=1, fail_after=2, dwell_s=10.0)
+        tr.note_timeout(0)
+        assert tr.state(0) == HEALTHY  # dwell pins HEALTHY at t=0
+        clock.t = 11.0
+        tr.note_timeout(0)
+        assert tr.state(0) == SUSPECT  # dwell elapsed, strikes >= 1
+        tr.note_timeout(0)
+        tr.note_timeout(0)
+        assert tr.state(0) == SUSPECT  # dwell re-pins after transition
+        clock.t = 22.0
+        tr.note_timeout(0)
+        assert tr.state(0) == FAILED
+
+    def test_dwell_pins_clearing(self):
+        tr, clock = _tracker(suspect_after=1, ok_to_clear=1, dwell_s=5.0)
+        clock.t = 10.0
+        tr.note_timeout(2)
+        assert tr.state(2) == SUSPECT
+        clock.t = 12.0
+        tr.note_ok(2)
+        assert tr.state(2) == SUSPECT  # 2s residency < 5s dwell
+        clock.t = 16.0
+        tr.note_ok(2)
+        assert tr.state(2) == HEALTHY
+
+    def test_flap_shard_churn_is_absorbed_by_dwell(self):
+        """The fault plan's flap schedule (failed / healthy every poll)
+        feeding the tracker as timeout / OK evidence cannot drag a
+        SUSPECT shard through fail->readmit churn: dwell pins SUSPECT
+        across the whole flap window."""
+        plan = FaultPlan(seed=9).flap_shard(1, period=1)
+        tr, clock = _tracker(n=4, suspect_after=1, fail_after=1,
+                             ok_to_clear=1, dwell_s=60.0)
+        clock.t = 100.0
+        tr.note_timeout(1)
+        assert tr.state(1) == SUSPECT
+        with plan.active():
+            for step in range(10):
+                clock.t = 100.0 + step  # well inside dwell
+                if 1 in faults.failed_shards(4):
+                    tr.note_timeout(1)
+                else:
+                    tr.note_ok(1)
+        assert tr.state(1) == SUSPECT
+        assert tr.stats()["transitions"] == {
+            "distributed.health.suspect": 1}
+
+
+class TestReadmissionGuards:
+    def _failed(self):
+        tr, clock = _tracker(suspect_after=2, fail_after=1)
+        tr.note_timeout(0)  # weight = suspect_after -> SUSPECT at once
+        tr.note_timeout(0)
+        assert tr.state(0) == FAILED
+        return tr, clock
+
+    def test_catch_up_only_from_failed(self):
+        tr, _ = self._failed()
+        with pytest.raises(RaftError):
+            tr.begin_catch_up(1)  # shard 1 is HEALTHY
+        tr.begin_catch_up(0, generation_delta=3)
+        assert tr.state(0) == CATCHING_UP
+        # a catching-up shard stays OUT of the routing
+        assert tr.failed_shards() == (0,)
+        with pytest.raises(RaftError):
+            tr.begin_catch_up(0)  # already catching up
+
+    def test_readmit_only_from_catching_up(self):
+        tr, _ = self._failed()
+        with pytest.raises(RaftError):
+            tr.readmit(0)  # FAILED, not CATCHING_UP
+        tr.begin_catch_up(0)
+        tr.readmit(0)
+        assert tr.state(0) == HEALTHY
+        assert tr.failed_shards() == ()
+        # strike slate is clean after readmission
+        tr.note_straggle(0)
+        assert tr.state(0) == HEALTHY
+
+    def test_block_readmit_returns_to_failed(self):
+        tr, _ = self._failed()
+        tr.begin_catch_up(0)
+        tr.block_readmit(0, reason="canary")
+        assert tr.state(0) == FAILED
+        with pytest.raises(RaftError):
+            tr.block_readmit(0)  # no longer CATCHING_UP
+        # the shard can retry catch-up
+        tr.begin_catch_up(0)
+        tr.readmit(0)
+        assert tr.state(0) == HEALTHY
+
+
+class TestPairedSignals:
+    """Every transition = one flight event + the same-named counter —
+    the contract graftlint's health-transition rule enforces statically
+    and the chaos job's flight-trail gate reads at runtime."""
+
+    def test_full_lifecycle_flight_trail(self):
+        flight.clear()
+        with obs.collecting():
+            tr, _ = _tracker(suspect_after=1, fail_after=1, ok_to_clear=1)
+            tr.note_timeout(2)
+            tr.note_timeout(2)
+            tr.begin_catch_up(2, generation_delta=1)
+            tr.block_readmit(2, reason="canary")
+            tr.begin_catch_up(2)
+            tr.readmit(2)
+            for name in ("distributed.health.suspect",
+                         "distributed.health.failed",
+                         "distributed.health.catch_up",
+                         "distributed.health.readmit_blocked",
+                         "distributed.health.readmitted"):
+                evs = flight.events(name)
+                assert len(evs) >= 1, name
+                assert evs[0]["attrs"]["shard"] == 2
+                assert obs.registry().counter(name).value >= 1, name
+        # the second catch_up appears twice
+        assert len(flight.events("distributed.health.catch_up")) == 2
+        assert tr.stats()["transitions"]["distributed.health.catch_up"] == 2
+
+    def test_suspect_event_carries_cause_and_strikes(self):
+        flight.clear()
+        tr, _ = _tracker(suspect_after=2)
+        tr.note_straggle(1)
+        tr.note_straggle(1)
+        evs = flight.events("distributed.health.suspect")
+        assert evs[0]["attrs"] == {"shard": 1, "cause": "straggle",
+                                   "strikes": 2}
+
+    def test_canary_failure_ticks_integrity_counter_with_shard(self):
+        """The satellite: per-shard canary verdicts finally tick
+        ``integrity.canary_failure`` with the shard id attached."""
+        flight.clear()
+        with obs.collecting():
+            tr, _ = _tracker(suspect_after=1)
+            tr.note_canary_failure(3)
+            evs = flight.events("integrity.canary_failure")
+            assert evs and evs[0]["attrs"]["shard"] == 3
+            assert obs.registry().counter(
+                "integrity.canary_failure").value == 1
+        assert tr.state(3) == SUSPECT  # hard evidence
+
+    def test_recovered_event_on_ok_clear(self):
+        flight.clear()
+        tr, _ = _tracker(suspect_after=1, ok_to_clear=1)
+        tr.note_timeout(0)
+        tr.note_ok(0)
+        evs = flight.events("distributed.health.recovered")
+        assert evs and evs[0]["attrs"]["shard"] == 0
+
+
+class TestFaultPlanShardKills:
+    """The fault-plan half of the kill matrix: lifecycle-boundary kills
+    and flapping membership, without a mesh."""
+
+    def test_kill_shard_at_fires_once_at_site(self):
+        plan = FaultPlan(seed=1).kill_shard_at("distributed.scan", 5)
+        with plan.active():
+            assert faults.failed_shards(8) == ()
+            faults.maybe_fail("distributed.route")  # wrong site: no-op
+            assert faults.failed_shards(8) == ()
+            faults.maybe_fail("distributed.scan")
+            assert faults.failed_shards(8) == (5,)
+            faults.maybe_fail("distributed.scan")  # times=1: no re-fire
+            assert faults.failed_shards(8) == (5,)
+
+    def test_kill_shard_at_after_skips_passes(self):
+        plan = FaultPlan(seed=1).kill_shard_at("distributed.gather", 2,
+                                               after=2)
+        with plan.active():
+            faults.maybe_fail("distributed.gather")
+            faults.maybe_fail("distributed.gather")
+            assert faults.failed_shards(8) == ()
+            faults.maybe_fail("distributed.gather")
+            assert faults.failed_shards(8) == (2,)
+
+    def test_kill_does_not_raise(self):
+        """A shard kill is a membership change, not an exception — the
+        site keeps executing (the search finishes on pre-kill routing)."""
+        plan = FaultPlan(seed=1).kill_shard_at("distributed.swap", 1)
+        with plan.active():
+            faults.maybe_fail("distributed.swap")  # must not raise
+            assert faults.failed_shards(4) == (1,)
+
+    def test_flap_shard_alternates_membership(self):
+        plan = FaultPlan(seed=1).flap_shard(2, period=2)
+        with plan.active():
+            seen = [2 in faults.failed_shards(8) for _ in range(8)]
+        # period=2: two polls down, two up, ... starting down
+        assert seen == [True, True, False, False,
+                        True, True, False, False]
+
+    def test_flap_rejects_bad_period(self):
+        with pytest.raises(ValueError):
+            FaultPlan(seed=1).flap_shard(0, period=0)
