@@ -2162,6 +2162,251 @@ def bench_distributed(res) -> list:
 
 
 # ---------------------------------------------------------------------------
+# skewed-load replica routing (PR 18): the load-aware policy vs
+# primary-only under a Zipf probe distribution
+# ---------------------------------------------------------------------------
+
+#: default workload seed when RAFT_TPU_FAULT_SEED is unset (the CI
+#: chaos job pins the env var; local runs replay the same schedule)
+SKEW_DEFAULT_SEED = 20260805
+
+
+def _skew_workload(*, n_lists, dim, rows_mu, size_sigma, zipf_a,
+                   n_queries, seed):
+    """Clustered dataset with log-normal list sizes and Zipf(``zipf_a``)
+    query heat over a permuted cluster order — heat independent of
+    size, so the hot lists are NOT simply the big ones and size-only
+    LPT cannot see them."""
+    rng = np.random.default_rng(seed)
+    centers = (rng.normal(size=(n_lists, dim)) * 6.0).astype(np.float32)
+    sizes = np.maximum(rng.lognormal(np.log(rows_mu), size_sigma,
+                                     n_lists).astype(np.int64), 16)
+    db = np.concatenate([
+        centers[g] + rng.normal(size=(sizes[g], dim)).astype(np.float32)
+        for g in range(n_lists)])
+    zipf = 1.0 / np.arange(1, n_lists + 1, dtype=np.float64) ** zipf_a
+    zipf /= zipf.sum()
+    heat = np.empty(n_lists)
+    heat[rng.permutation(n_lists)] = zipf
+    qc = rng.choice(n_lists, size=n_queries, p=heat)
+    queries = (centers[qc]
+               + 0.3 * rng.normal(size=(n_queries, dim))).astype(
+                   np.float32)
+    return db, queries
+
+
+def bench_skew(*, n_lists=64, dim=32, rows_mu=160.0, size_sigma=1.0,
+               zipf_a=1.0, n_queries=4096, batch_rows=512, n_probes=2,
+               calib_batches=8, k=10, rebalance_overfull=1.15,
+               seed=SKEW_DEFAULT_SEED) -> list:
+    """PR 18: load-aware replica routing under skewed probe load.
+
+    Workload: Zipf(``zipf_a``) query heat over ``n_lists`` clusters
+    with log-normal sizes — a few lists absorb most probes, so the
+    shard owning them is the SPMD bottleneck (the merge completes when
+    the slowest shard answers).  Two arms over the same ``r=2`` routed
+    index:
+
+    - **primary-only**: every list served by its rank-0 owner (the
+      pre-PR-18 healthy path);
+    - **routed**: calibration traffic accumulates the policy's probe
+      histograms (lazy, sync-free), one maintenance pass folds them and
+      runs the probe-frequency-aware ``rebalance_routed``, then
+      measured traffic routes per batch through
+      :meth:`RoutingPolicy.plan` (greedy least-loaded over both ranks)
+      with the tables updating every batch.
+
+    QPS is **modeled from measured per-shard scanned rows**: on the
+    virtual CPU mesh every device executes the same program serially,
+    so wall-clock cannot show the SPMD win; ``t_batch ∝ max_s
+    scanned_rows[s]`` (the slowest-shard model PERFORMANCE.md's
+    per-chip work analysis rides on), normalized by the primary arm's
+    measured scan rate.  Gates asserted by :func:`run_skew`: the
+    modeled QPS ratio, full-probe bit-identity while the policy is
+    active, and ZERO xla.compiles on warmed traffic while the tables
+    update every batch (replica choice is data, not shape)."""
+    import jax
+
+    from raft_tpu import observability as obs
+    from raft_tpu.comms.session import CommsSession
+    from raft_tpu.distributed import ann as dist_ann
+    from raft_tpu.distributed.health import HealthTracker
+    from raft_tpu.distributed.routing import RoutingPolicy
+    from raft_tpu.neighbors import ivf_pq
+    from raft_tpu.serving import rebalancer
+
+    db, queries = _skew_workload(
+        n_lists=n_lists, dim=dim, rows_mu=rows_mu,
+        size_sigma=size_sigma, zipf_a=zipf_a, n_queries=n_queries,
+        seed=seed)
+    import jax.numpy as jnp
+    batches = [jnp.asarray(queries[i:i + batch_rows])
+               for i in range(0, n_queries - batch_rows + 1, batch_rows)]
+    out = []
+    session = CommsSession().init()
+    try:
+        handle = session.worker_handle()
+        n_dev = len(jax.devices())
+        params = ivf_pq.IndexParams(n_lists=n_lists, pq_dim=dim // 4,
+                                    kmeans_n_iters=4,
+                                    cache_reconstructions=True)
+        r2 = dist_ann.build(handle, params, db, placement="by_list",
+                            replication_factor=2)
+        sp = ivf_pq.SearchParams(n_probes=n_probes)
+
+        def shard_rows(index, batch, routing=None):
+            _, _, st = dist_ann.search(handle, sp, index, batch, k,
+                                       return_stats=True,
+                                       routing=routing)
+            return np.asarray(st["scanned_rows"], np.int64)
+
+        # -- arm 1: primary-only (rank-0 owners, the spare-replica
+        #    status quo) -------------------------------------------------
+        shard_rows(r2, batches[0])                      # warm
+        t0 = time.perf_counter()
+        prim = [shard_rows(r2, b) for b in batches]
+        t_prim = time.perf_counter() - t0
+        prim_max = float(np.mean([p.max() for p in prim]))
+
+        # -- arm 2: calibrate -> heat-aware rebalance -> policy-routed --
+        tracker = HealthTracker(n_dev)
+        pol = RoutingPolicy(n_dev, tracker=tracker)
+        # per-probe scan cost is the padded slab capacity — uniform
+        # across lists — which is exactly the policy's default when no
+        # rows are fed, so no note_list_rows seeding here (the serving
+        # executor and rebalance_routed feed the same uniform cost).
+        for b in batches[:calib_batches]:
+            dist_ann.search(handle, sp, r2, b, k, routing=pol)
+        cand = rebalancer.rebalance_routed(
+            handle, r2, routing=pol,
+            config=rebalancer.RebalanceConfig(
+                overfull_factor=rebalance_overfull))
+        heat_rebalanced = cand is not r2
+        shard_rows(cand, batches[0], routing=pol)       # warm
+        with obs.collecting():
+            c0 = obs.registry().counter("xla.compiles").value
+            t0 = time.perf_counter()
+            routed = [shard_rows(cand, b, routing=pol) for b in batches]
+            t_routed = time.perf_counter() - t0
+            recompiles = (obs.registry().counter("xla.compiles").value
+                          - c0)
+        routed_max = float(np.mean([r.max() for r in routed]))
+
+        # -- full-probe bit-identity while the policy routes ------------
+        sp_full = ivf_pq.SearchParams(n_probes=n_lists)
+        d0, i0 = dist_ann.search(handle, sp_full, cand, batches[0], k)
+        d1, i1 = dist_ann.search(handle, sp_full, cand, batches[0], k,
+                                 routing=pol)
+        bit_identical = bool(
+            np.array_equal(np.asarray(i0), np.asarray(i1))
+            and np.array_equal(np.asarray(d0), np.asarray(d1)))
+    finally:
+        session.destroy()
+
+    # modeled QPS: per-shard scan rate from the primary arm's wall
+    # clock (rate = bottleneck rows per measured batch interval), then
+    # qps_arm = batch_rows * rate / bottleneck_rows(arm)
+    rate = prim_max * len(batches) / max(t_prim, 1e-9)
+    qps_prim = batch_rows * rate / max(prim_max, 1.0)
+    qps_routed = batch_rows * rate / max(routed_max, 1.0)
+    ratio = prim_max / max(routed_max, 1.0)
+    choice = pol.choice_summary()
+    out.append({
+        "metric": "skew_routed_qps_ratio_r2",
+        "value": round(ratio, 3), "unit": "x primary-only",
+        "vs_baseline": round(ratio, 3),
+        "detail": {
+            "seed": seed, "zipf_a": zipf_a, "n_lists": n_lists,
+            "n_probes": n_probes, "batch_rows": batch_rows,
+            "batches": len(batches), "n_devices": n_dev,
+            "scanned_rows_max_primary": int(round(prim_max)),
+            "scanned_rows_max_routed": int(round(routed_max)),
+            "recompiles_steady": int(recompiles),
+            "bit_identical_full_probe": bit_identical,
+            "heat_rebalanced": heat_rebalanced,
+            "per_rank_lists": choice.get("per_rank_lists"),
+            "per_shard_lists": choice.get("per_shard_lists"),
+        },
+    })
+    out.append({"skew_point": {"arm": "primary", "qps_model":
+                               round(qps_prim, 1),
+                               "wall_s": round(t_prim, 3),
+                               "scanned_rows_max": int(round(prim_max))}})
+    out.append({"skew_point": {"arm": "routed", "qps_model":
+                               round(qps_routed, 1),
+                               "wall_s": round(t_routed, 3),
+                               "scanned_rows_max":
+                                   int(round(routed_max))}})
+    return out
+
+
+def run_skew(conf_path: str) -> int:
+    """``--skew`` mode: the CI skewed-load chaos leg.  Builds the
+    conf's Zipf workload (seed pinned via ``RAFT_TPU_FAULT_SEED``),
+    runs :func:`bench_skew`, and FAILS (exit 1) when routed goodput at
+    ``r=2`` under the skew falls below ``min_qps_ratio`` x the
+    primary-only arm, on any steady-state recompile while the routing
+    tables update, on a full-probe bit-identity break, or on a missing
+    ``distributed.replica_choice`` flight trail."""
+    import jax
+
+    from raft_tpu.observability import flight as _flight
+
+    with open(conf_path) as f:
+        conf = json.load(f)
+    s = conf.get("skew", {})
+    if len(jax.devices()) < s.get("min_devices", 8):
+        _emit({"metric": "skew_routed_qps_ratio_r2", "skipped": True,
+               "reason": f"{len(jax.devices())} devices < "
+                         f"{s.get('min_devices', 8)}"})
+        return 0
+    seed = int(os.environ.get("RAFT_TPU_FAULT_SEED",
+                              s.get("seed", SKEW_DEFAULT_SEED)))
+    _flight.clear()
+    lines = bench_skew(
+        n_lists=s.get("n_lists", 64), dim=s.get("dim", 32),
+        rows_mu=s.get("rows_mu", 160.0),
+        size_sigma=s.get("size_sigma", 1.0),
+        zipf_a=s.get("zipf_a", 1.0),
+        n_queries=s.get("n_queries", 4096),
+        batch_rows=s.get("batch_rows", 512),
+        n_probes=s.get("n_probes", 2),
+        calib_batches=s.get("calib_batches", 8),
+        k=s.get("k", 10),
+        rebalance_overfull=s.get("rebalance_overfull", 1.15),
+        seed=seed)
+    for line in lines:
+        _emit(line)
+    head = next(ln for ln in lines
+                if ln.get("metric") == "skew_routed_qps_ratio_r2")
+    failures = []
+    bar = s.get("min_qps_ratio", 1.5)
+    if head["value"] < bar:
+        failures.append(
+            f"routed goodput {head['value']:.2f}x primary-only under "
+            f"Zipf({s.get('zipf_a', 1.0)}) skew at r=2 (bar: {bar:.2f}x)")
+    if head["detail"]["recompiles_steady"] != 0:
+        failures.append(
+            f"{head['detail']['recompiles_steady']} XLA recompiles on "
+            "warmed traffic while the routing tables updated (replica "
+            "choice must stay data, not shape)")
+    if not head["detail"]["bit_identical_full_probe"]:
+        failures.append("full-probe results with the policy active "
+                        "diverged from the primary answer — the "
+                        "per-list exactness argument broke")
+    if not _flight.events("distributed.replica_choice"):
+        failures.append("no distributed.replica_choice events landed in "
+                        "the flight recorder — the policy never routed")
+    for msg in failures:
+        print(f"SKEW SMOKE FAIL: {msg}", flush=True)
+    if failures:
+        dumped = _flight.maybe_auto_dump("skew_smoke_failure")
+        if dumped:
+            print(f"flight dump: {dumped}", flush=True)
+    return 1 if failures else 0
+
+
+# ---------------------------------------------------------------------------
 # conf-driven multi-algo harness (reference: cpp/bench/ann/conf/*.json
 # workloads + eval.pl summary conditions "QPS at recall=0.9/0.95",
 # "recall at QPS=2000"; latency mode -l)
@@ -2454,6 +2699,12 @@ if __name__ == "__main__":
                 os.path.join(os.path.dirname(__file__), "conf",
                              "quality-smoke.json")
             sys.exit(run_quality(conf))
+        elif len(sys.argv) >= 2 and sys.argv[1] == "--skew":
+            _setup_jax_cache()
+            conf = sys.argv[2] if len(sys.argv) >= 3 else \
+                os.path.join(os.path.dirname(__file__), "conf",
+                             "skew-smoke.json")
+            sys.exit(run_skew(conf))
         elif len(sys.argv) >= 2 and sys.argv[1] == "--ingest":
             _setup_jax_cache()
             conf = sys.argv[2] if len(sys.argv) >= 3 else \

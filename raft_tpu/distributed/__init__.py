@@ -9,4 +9,5 @@ reference-quality MNMG k-means and kNN natively.
 from raft_tpu.distributed import ann  # noqa: F401
 from raft_tpu.distributed import health  # noqa: F401
 from raft_tpu.distributed import kmeans  # noqa: F401
+from raft_tpu.distributed import routing  # noqa: F401
 from raft_tpu.distributed import knn  # noqa: F401

@@ -728,7 +728,8 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
            deadline: Optional[_retry.Deadline] = None,
            health=None,
            shard_deadline_s: Optional[float] = None,
-           hedge: bool = True):
+           hedge: bool = True,
+           routing=None):
     """Sharded search + merge; returns replicated (distances, global ids)
     of shape (q, k).  Accepts both placements: a
     :class:`DistributedIndex` (data-parallel full-shard scan) or a
@@ -805,6 +806,22 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
     because both scan identical lists.  A hedged shard's injected delay
     is not paid beyond the deadline; with no covering replica the shard
     is un-hedged and waited for in full (slow beats dropped).
+
+    ``routing`` (a :class:`raft_tpu.distributed.routing.RoutingPolicy`)
+    turns the replicas into a throughput lever on the HEALTHY path:
+    every batch's effective tables come from
+    :meth:`~raft_tpu.distributed.routing.RoutingPolicy.plan` — greedy
+    least-loaded replica-rank selection over the per-shard load scores
+    — instead of the fixed rank-0 primaries, and a hedge re-issues to
+    the least-loaded covering replica rather than the lowest rank.
+    Exactness is unchanged (any live assignment is bit-identical at
+    full probe: the k-bounded merge argument is per list, and replica
+    copies are identical rows), the tables stay data-not-shape (zero
+    recompiles), and each decision lands a
+    ``distributed.replica_choice`` flight event.  The routed dispatch
+    also hands the policy each batch's in-graph per-list probe
+    histogram (``observe_probes`` — a lazy device array, no host sync)
+    for probe-frequency-aware rebalancing.
     """
     with named_range("distributed::ivf_pq_search"):
         expects(handle.comms_initialized(),
@@ -864,9 +881,19 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
         residual = failed
         replica_served: Tuple[int, ...] = ()
         eff = None  # (eff_owner, eff_slot) host numpy, or None
-        if routed and rf > 1 and (failed or hedge_cand):
+        # load-aware policy: plan() honors the same keep-primary-when-
+        # uncovered contract as healthy_routing, so the residual /
+        # covered bookkeeping below composes with either table source
+        use_policy = routing is not None and routed and rf > 1
+
+        def _route_tables(d):
+            if use_policy:
+                return routing.plan(index.placement, down=d)
+            return index.placement.healthy_routing(d)
+
+        if routed and rf > 1 and (failed or hedge_cand or use_policy):
             down = set(failed) | hedge_cand
-            eo, es = index.placement.healthy_routing(tuple(sorted(down)))
+            eo, es = _route_tables(tuple(sorted(down)))
             still = down & set(np.unique(eo).tolist())
             # a hedge candidate whose lists have no live replica is
             # UN-hedged: the shard is alive, just slow — wait for it
@@ -875,13 +902,31 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
             hedged = tuple(sorted(hedge_cand - unhedged))
             down = set(failed) | set(hedged)
             if unhedged and down:
-                eo, es = index.placement.healthy_routing(
-                    tuple(sorted(down)))
+                eo, es = _route_tables(tuple(sorted(down)))
             if down:
                 still = down & set(np.unique(eo).tolist())
                 residual = tuple(sorted(set(failed) & still))
                 replica_served = tuple(sorted(down - still))
                 eff = (eo, es)
+            elif use_policy:
+                # pure load spreading: nothing down, every list served
+                # by its least-loaded live rank
+                eff = (eo, es)
+            if use_policy:
+                reason = ("failover" if failed
+                          else "hedge" if hedged else "load_spread")
+                choice = routing.choice_summary()
+                _flight.record_event(
+                    "distributed.replica_choice",
+                    trace_id=rec.trace_id if rec else None,
+                    reason=reason,
+                    scores=choice.get("scores"),
+                    per_rank_lists=choice.get("per_rank_lists"),
+                    per_shard_lists=choice.get("per_shard_lists"))
+                from raft_tpu import observability as obs
+                if obs.enabled():
+                    obs.registry().counter(
+                        "distributed.replica_choice").inc()
             if failed and set(failed) - set(residual):
                 _flight.record_event(
                     "distributed.replica_failover",
@@ -940,6 +985,7 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
                                  failed=list(residual),
                                  n_shards=index.n_shards)
         scanned = None
+        phist = None  # per-list probe histogram (routed; lazy device)
         # lifecycle-boundary kill site: a shard killed here (mid-scan)
         # keeps this search's pre-kill routing — its in-flight answer
         # completes — and the NEXT search routes around it
@@ -957,7 +1003,7 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
                     replicated = replicated[:2] + (
                         _replicate(jnp.asarray(eff[0]), handle.mesh),
                         _replicate(jnp.asarray(eff[1]), handle.mesh))
-                d, i, scanned = _entry(
+                d, i, scanned, phist = _entry(
                     "distributed.ann.search",
                     lambda: _dist_search_routed(
                         sharded, replicated, queries, k, n_probes,
@@ -980,7 +1026,7 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
                         use_pallas=r.use_pallas,
                         merge_window=r.merge_window, failed=residual)
 
-                d, i, scanned, needed = _entry(
+                d, i, scanned, needed, phist = _entry(
                     "distributed.ann.search",
                     lambda: dispatch(r.n_groups), retry_policy, deadline)
                 if not r.exact:
@@ -1000,7 +1046,7 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
                             "ivf_pq.group_overflow",
                             trace_id=rec.trace_id if rec else None,
                             calibrated_groups=r.n_groups, worst=worst)
-                        d, i, scanned, needed = dispatch(worst)
+                        d, i, scanned, needed, phist = dispatch(worst)
         elif r.form == "probe_recon":
             leaves = (index.centers, index.list_indices, index.rotation,
                       index.list_recon)
@@ -1044,6 +1090,11 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
             # stores the reference without fetching it (no host sync on
             # the dispatch path — flight.dump() materializes it later)
             rec.annotate("distributed.scanned_rows", scanned)
+        if routing is not None and phist is not None:
+            # the probe-frequency counters: the policy retains the lazy
+            # device histogram; materialization happens only in its
+            # maintenance-path refresh() — steady state stays sync-free
+            routing.observe_probes(phist)
         out = [d, i]
         if return_status:
             out.append(_status_vector(index.n_shards, residual,
@@ -1548,7 +1599,7 @@ def _dist_search_routed(sharded, replicated, queries, k, n_probes, metric,
 
     @functools.partial(shard_map, mesh=mesh,
                        in_specs=(sspecs, rspecs, P()),
-                       out_specs=(P(), P(), P()),
+                       out_specs=(P(), P(), P(), P()),
                        check_vma=False)
     def run(sl, rl, q):
         local_centers, list_recon, list_recon_sq, list_indices = sl
@@ -1558,6 +1609,12 @@ def _dist_search_routed(sharded, replicated, queries, k, n_probes, metric,
         # replicated coarse routing: every shard ranks the SAME probe
         # set deterministically, so ownership tests need no exchange
         probes = ivf_pq._select_clusters(coarse, rot, q, n_probes, metric)
+        # per-list probe histogram for the routing policy's heat window:
+        # built from the REPLICATED probe set (identical on every
+        # shard), so it replicates for free — and it stays a lazy
+        # device array until a maintenance-path refresh reads it
+        hist = jnp.zeros((owner.shape[0],), jnp.int32).at[
+            probes.reshape(-1)].add(1)
         owned = owner[probes] == s                       # (q, n_probes)
         dummy = local_centers.shape[1] - 1               # static slot L
         local_probes = jnp.where(owned, local_slot[probes],
@@ -1595,7 +1652,7 @@ def _dist_search_routed(sharded, replicated, queries, k, n_probes, metric,
             jnp.transpose(all_d, (1, 0, 2)),
             jnp.transpose(all_i, (1, 0, 2)),
             nq, k, select_min, False, select_k)
-        return md, mi, all_scanned
+        return md, mi, all_scanned, hist
 
     return run(sharded, replicated, queries)
 
@@ -1643,7 +1700,7 @@ def _dist_search_routed_grouped(sharded, replicated, queries, k, kt,
 
     @functools.partial(shard_map, mesh=mesh,
                        in_specs=(sspecs, rspecs, P()),
-                       out_specs=(P(), P(), P(), P()),
+                       out_specs=(P(), P(), P(), P(), P()),
                        check_vma=False)
     def run(sl, rl, q):
         local_centers, data, rownorm, list_indices = sl
@@ -1652,6 +1709,11 @@ def _dist_search_routed_grouped(sharded, replicated, queries, k, kt,
         slots = local_centers.shape[1]
         cap = list_indices.shape[2]
         probes = ivf_pq._select_clusters(coarse, rot, q, n_probes, metric)
+        # replicated per-list probe histogram (identical on every shard
+        # — the probe set is) for the routing policy's heat window; a
+        # lazy device array until a maintenance-path refresh
+        hist = jnp.zeros((owner.shape[0],), jnp.int32).at[
+            probes.reshape(-1)].add(1)
         owned = owner[probes] == s                       # (q, n_probes)
         # unowned probes map to the OUT-OF-RANGE sentinel slot id
         # (== slots), NOT the dummy slot: build_groups drops sentinel
@@ -1702,7 +1764,7 @@ def _dist_search_routed_grouped(sharded, replicated, queries, k, kt,
             jnp.transpose(all_d, (1, 0, 2)),
             jnp.transpose(all_i, (1, 0, 2)),
             nq, k, select_min, False, select_k)
-        return md, mi, all_scanned, all_needed
+        return md, mi, all_scanned, all_needed, hist
 
     return run(sharded, replicated, queries)
 

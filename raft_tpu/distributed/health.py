@@ -117,6 +117,9 @@ class HealthTracker:
         self._oks: List[int] = [0] * n_shards
         self._since: List[float] = [now] * n_shards
         self._transitions: Dict[str, int] = {}
+        # per-shard load demotion (note_overload): a continuous score
+        # penalty the routing policy reads back — NOT a lifecycle state
+        self._load_penalty: List[float] = [0.0] * n_shards
 
     # -- evidence ----------------------------------------------------------
     def note_straggle(self, shard: int) -> None:
@@ -140,6 +143,46 @@ class HealthTracker:
             obs.registry().counter("integrity.canary_failure").inc()
         self._strike(shard, "canary", weight=self.config.suspect_after)
 
+    def note_overload(self, shard: int, load: float) -> None:
+        """Soft evidence from the routing policy: ``shard``'s planned
+        probe load runs at ``load``× the mesh mean.  Folds the excess
+        into the shard's *load penalty* — a continuous score demotion
+        :meth:`load_penalties` exposes back to the routing policy — and
+        escalates at most to SUSPECT (so replicas absorb its traffic
+        and stragglers from it are hedged).  Overload is **not**
+        failure: a load-SUSPECT shard never advances to FAILED from
+        this signal and never enters :meth:`failed_shards`, so the
+        status vector keeps reporting it live."""
+        s = int(shard)
+        load = float(load)
+        event = None
+        strikes = 0
+        with self._lock:
+            if self._state[s] in (FAILED, CATCHING_UP):
+                return  # already out of the routing
+            # EWMA of the overload excess, clamped at zero: transient
+            # spikes decay instead of latching
+            self._load_penalty[s] = max(
+                0.0, 0.7 * self._load_penalty[s] + 0.3 * (load - 1.0))
+            now = self._clock()
+            if (self._state[s] == HEALTHY
+                    and now - self._since[s] >= self.config.dwell_s):
+                self._oks[s] = 0
+                self._strikes[s] += 1
+                strikes = self._strikes[s]
+                if strikes >= self.config.suspect_after:
+                    self._state[s] = SUSPECT
+                    self._since[s] = now
+                    self._strikes[s] = 0
+                    event = "distributed.health.suspect"
+                    self._transitions[event] = \
+                        self._transitions.get(event, 0) + 1
+            # a SUSPECT shard stays SUSPECT: load evidence accrues no
+            # strikes toward FAILED — only timeouts/canaries/straggles
+            # (genuine failure evidence) may take it further down
+        if event:
+            _emit(event, shard=s, cause="load", strikes=strikes)
+
     def note_ok(self, shard: int) -> None:
         """A passing verdict (canary OK / answered in budget): resets
         the strike run; ``ok_to_clear`` consecutive OKs clear SUSPECT
@@ -147,6 +190,9 @@ class HealthTracker:
         s = int(shard)
         recovered = False
         with self._lock:
+            # an OK verdict also decays the load demotion — pressure
+            # that stopped showing up stops costing score
+            self._load_penalty[s] *= 0.7
             if self._state[s] == SUSPECT:
                 self._oks[s] += 1
                 now = self._clock()
@@ -273,12 +319,20 @@ class HealthTracker:
             return tuple(s for s, st in enumerate(self._state)
                          if st == SUSPECT)
 
+    def load_penalties(self) -> Tuple[float, ...]:
+        """Per-shard overload demotion (EWMA of the excess-over-mean
+        from :meth:`note_overload`) — the continuous score term the
+        routing policy adds, instead of a binary up/down verdict."""
+        with self._lock:
+            return tuple(self._load_penalty)
+
     def stats(self) -> Dict[str, object]:
         """Snapshot for ops/bench: per-shard state + strike run and the
         cumulative transition counts."""
         with self._lock:
             return {"states": tuple(self._state),
                     "strikes": tuple(self._strikes),
+                    "load_penalties": tuple(self._load_penalty),
                     "transitions": dict(self._transitions)}
 
 

@@ -42,6 +42,7 @@ from typing import Callable, Dict, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from raft_tpu import observability as obs
 from raft_tpu.core.aot import executables as _aot_executables
@@ -395,16 +396,31 @@ class DistributedExecutor(Executor):
     dispatch carries an in-graph overflow flag whose single host read
     gates the exact re-dispatch — uncalibrated indexes run at the exact
     worst bound and never read it.
+
+    ``routing`` (a :class:`raft_tpu.distributed.routing.RoutingPolicy`)
+    adds load-aware replica selection with **per-bucket replica
+    groups**: when the executor builds its warmed fn table it consults
+    ``routing.spread_bucket(bucket)`` per ``(bucket, k)`` — hot
+    small-batch buckets close over the policy (every dispatch plans
+    least-loaded replica tables; data-parallel across the ranks) while
+    memory-bound large-batch buckets close over ``None`` (pinned at
+    the rank-0 primary).  The choice is baked into the fn-table
+    closure, NOT the executable cache key: routing tables are runtime
+    data, so both groups share the same warmed shapes and the AOT /
+    executable cache key is unchanged.
     """
 
     def __init__(self, handle, index, *, ks: Sequence[int] = (10,),
                  max_batch: int = 1024, search_params=None,
-                 failed_shards: Sequence[int] = ()) -> None:
+                 failed_shards: Sequence[int] = (),
+                 routing=None) -> None:
         self.handle = handle
         self.failed_shards = tuple(failed_shards)
+        self.routing = routing
         super().__init__(handle, "ivf_pq", index, ks=ks,
                          max_batch=max_batch, search_params=search_params,
                          warm="jit")
+        self._feed_routing_rows(index)
 
     def _index_dim(self, index) -> int:
         # rotation is (n_dev, dim, rot_dim) stacked (by_row) or
@@ -421,6 +437,47 @@ class DistributedExecutor(Executor):
     def _aot_fn(self, index, bucket: int, k: int, params, rung: int
                 ) -> Callable:
         raise NotImplementedError("distributed indexes are jit-warmed")
+
+    # ---- per-bucket replica groups --------------------------------------
+
+    def _bucket_routing(self, bucket: int):
+        """The bucket→replica-group map: the routing policy for hot
+        buckets (spread across replica ranks), None for memory-bound
+        ones (pinned at the primary)."""
+        r = self.routing
+        if r is None:
+            return None
+        return r if r.spread_bucket(bucket) else None
+
+    def _build_fn(self, index, bucket: int, k: int, rung: int = 0
+                  ) -> Callable:
+        # the replica-group choice is made HERE, per (bucket, k, rung),
+        # and baked into the fn-table closure — the warmed shapes and
+        # the executable cache key never see it (routing tables are
+        # runtime data, not shape)
+        params = self._rung_params[rung]
+        return self._routed_fn(index, k, params,
+                               self._bucket_routing(bucket))
+
+    def _feed_routing_rows(self, index) -> None:
+        # per-list probe cost for the policy's expected-work weights —
+        # read once per build/swap (never on the dispatch path).  The
+        # routed scans run over PADDED list slabs (every probe touches
+        # the full (cap,) slot row regardless of live rows), so the
+        # honest per-probe cost is the slab capacity — uniform across
+        # lists, which makes the plan weight pure measured heat
+        r = self.routing
+        placement = getattr(index, "placement", None)
+        li = getattr(index, "list_indices", None)
+        if r is None or placement is None or li is None:
+            return
+        n_lists = int(np.asarray(placement.owner).shape[0])
+        r.note_list_rows(np.full(n_lists, float(li.shape[-1])))
+
+    def swap_index(self, new_index) -> int:
+        n = super().swap_index(new_index)
+        self._feed_routing_rows(new_index)
+        return n
 
     def prewarm_shard_artifacts(self, scan_mode: str = "fused") -> int:
         """Load one PER-SHARD routed executable per (bucket, k, shard)
@@ -467,6 +524,9 @@ class DistributedExecutor(Executor):
         return n
 
     def _live_fn(self, index, k: int, params) -> Callable:
+        return self._routed_fn(index, k, params, self.routing)
+
+    def _routed_fn(self, index, k: int, params, routing) -> Callable:
         from raft_tpu import config
         from raft_tpu.distributed import ann
 
@@ -474,5 +534,6 @@ class DistributedExecutor(Executor):
             with config.validation_policy("off"):
                 return ann.search(self.handle, params, index,
                                   queries, k,
-                                  failed_shards=self.failed_shards)
+                                  failed_shards=self.failed_shards,
+                                  routing=routing)
         return live

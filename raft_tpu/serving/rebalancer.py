@@ -400,7 +400,7 @@ class Rebalancer:
 
 def rebalance_routed(handle, index, *,
                      config: Optional[RebalanceConfig] = None,
-                     server=None):
+                     server=None, routing=None):
     """One maintenance pass over a routed distributed index
     (:class:`raft_tpu.distributed.ann.RoutedIndex`): per-shard
     compaction passes followed by a placement recompute, published
@@ -430,6 +430,18 @@ def rebalance_routed(handle, index, *,
     lists) needs the PQ encoder and stays with the single-index pass;
     this pass repairs tombstone debt and placement skew.
 
+    **Probe-frequency-aware placement** (``routing``, a
+    :class:`raft_tpu.distributed.routing.RoutingPolicy`): the policy's
+    pending probe histograms are refreshed (the one maintenance-path
+    host read of the probe counters — steady-state dispatch stays
+    sync-free) and the LPT recompute balances by *expected probe load*
+    — the measured per-list probe rate (each probe scans the full
+    padded slot row, so the per-probe cost is the slab capacity,
+    uniform across lists) — instead of live rows alone, so a
+    synthetically hot list's replicas land on shards that are cold by
+    measured heat.  Heat skew above ``overfull_factor`` makes the pass
+    eligible even when row counts look balanced.
+
     Returns the index now serving: a new generation when repair work
     was accepted, ``index`` unchanged on a no-op.  Fault sites:
     ``rebalance.plan`` / ``rebalance.compact`` / ``rebalance.verify`` /
@@ -444,6 +456,14 @@ def rebalance_routed(handle, index, *,
     config = config or RebalanceConfig()
     faults.maybe_fail("rebalance.plan")
 
+    heat = None
+    if routing is not None:
+        routing.refresh()
+        heat = routing.expected_probe_load()
+        if heat is not None and heat.shape[0] != int(
+                index.placement.owner.shape[0]):
+            heat = None  # stale window from another index shape
+
     li = index.list_indices                       # (n_dev, L+1, cap)
     live_per_shard = jnp.sum(li >= 0, axis=(1, 2))
     dead_per_shard = jnp.sum(li <= -2, axis=(1, 2))
@@ -453,7 +473,19 @@ def rebalance_routed(handle, index, *,
                 if frac[s] >= config.dead_fraction]
     load = np.asarray(live_per_shard, np.int64)
     skew = load.max() / max(load.mean(), 1.0)
-    if not eligible and skew <= config.overfull_factor:
+    hot_skew = 0.0
+    if heat is not None:
+        # measured per-shard heat under the CURRENT primaries.  The
+        # routed scans run over PADDED list slabs — every probe costs
+        # the full (cap,) slot row whatever the live count — so the
+        # per-shard scan load is the probe rate alone (host-side
+        # tables only, no device reads)
+        own = np.asarray(index.placement.owner)
+        hot_load = np.bincount(own, weights=heat,
+                               minlength=index.n_shards)
+        hot_skew = hot_load.max() / max(hot_load.mean(), 1e-12)
+    if (not eligible and skew <= config.overfull_factor
+            and hot_skew <= config.overfull_factor):
         if obs.enabled():
             obs.registry().counter("rebalance.routed.noops").inc()
         return index
@@ -488,8 +520,20 @@ def rebalance_routed(handle, index, *,
                              jnp.take_along_axis(crsq, order, axis=1))
             code_leaves = (books, lanes, crsq)
 
+    live_rows = np.asarray(jnp.sum(gli >= 0, axis=1), np.int64)
+    weights = live_rows
+    if heat is not None:
+        # expected probe load: measured probe rate × the padded slab
+        # cost — what the makespan actually depends on (every probe
+        # scans the full (cap,) slot row, so a hot tiny list costs as
+        # much per probe as a hot huge one; a never-probed list costs
+        # nothing).  Scaling by n_lists × mean rows keeps the int64
+        # weights at row magnitudes, and the +1 floor keeps
+        # never-probed lists ordered by a stable tiebreak
+        scale = heat.shape[0] * max(float(live_rows.mean()), 1.0)
+        weights = np.maximum((heat * scale).astype(np.int64), 1)
     placement = _dann.compute_placement(
-        np.asarray(jnp.sum(gli >= 0, axis=1)), index.n_shards,
+        weights, index.n_shards,
         generation=index.placement.generation + 1,
         replication_factor=index.placement.replication_factor)
     cand = _dann._place_lists(handle, (centers, recon, rsq, gli, sizes),
@@ -508,6 +552,15 @@ def rebalance_routed(handle, index, *,
         ex = getattr(server, "executor", server)
         if getattr(ex, "index", None) is not cand:
             server.swap_index(cand)
+    if routing is not None:
+        # re-seed the policy's per-probe cost from the new placement's
+        # slab capacity — uniform over the padded lists, so the plan
+        # weight stays pure measured heat (the serving executor's
+        # swap_index does the same when a server is attached; direct
+        # callers need it here)
+        n_lists = int(np.asarray(cand.placement.owner).shape[0])
+        routing.note_list_rows(
+            np.full(n_lists, float(cand.list_indices.shape[-1])))
     if obs.enabled():
         obs.registry().counter("rebalance.routed.passes").inc()
         obs.registry().counter("rebalance.swaps").inc()

@@ -344,18 +344,33 @@ class Server:
         return self.submit(queries, k, tenant=tenant,
                            deadline=deadline).result(timeout=timeout)
 
+    # ---- routing maintenance --------------------------------------------
+
+    def refresh_routing(self) -> int:
+        """Fold the executor's pending probe histograms into the
+        routing policy's heat window (the maintenance-path host read —
+        the dispatch path only retains lazy device arrays).  Call from
+        the ops / rebalancer cadence; returns the number of batches
+        folded (0 with no policy attached)."""
+        routing = getattr(self.executor, "routing", None)
+        if routing is None:
+            return 0
+        return routing.refresh()
+
     # ---- introspection --------------------------------------------------
 
     def stats(self) -> Dict[str, object]:
         """Point-in-time serving stats (cheap; registry-backed numbers
         appear only while collection is enabled)."""
         snap = obs.snapshot() if obs.enabled() else {}
+        routing = getattr(self.executor, "routing", None)
         return {
             "queue_rows": self.queue.rows,
             "queue_requests": len(self.queue),
             "buckets": list(self.executor.buckets),
             "ks": list(self.executor.ks),
             "brownout_level": self.brownout.level,
+            "routing": routing.stats() if routing is not None else None,
             "counters": {name: v
                          for name, v in snap.get("counters", {}).items()
                          if name.startswith(("serving.", "xla."))},

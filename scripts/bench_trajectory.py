@@ -12,6 +12,13 @@ generations of schema:
   (``{"name", "search_param", "recall", "qps", ...}``), and
   ``{"summary": "QPS at recall=0.95", ...}`` rollups.
 
+Rounds that ran the multi-chip smoke also leave a ``MULTICHIP_rNN.json``
+(``{"n_devices", "rc", "ok", "skipped", "tail"}`` — ``tail`` is the
+captured stdout, which for metric-emitting legs holds the same JSON
+metric lines as the bench artifacts).  Those are folded into the same
+per-round row: pass/fail status plus any flagship metric parsed out of
+the tail.
+
 This script reduces each round to its headline numbers — the flagship
 metric(s) and the best QPS at/above a recall floor — so the perf
 history stops living only in PERFORMANCE.md prose.  Output: a markdown
@@ -21,6 +28,7 @@ table on stdout, plus the full per-round extraction as JSON with
 Usage::
 
     python scripts/bench_trajectory.py [--dir .] [--glob 'BENCH_r*.json']
+                                       [--multichip-glob 'MULTICHIP_r*.json']
                                        [--min-recall 0.95] [--json out]
 """
 
@@ -113,9 +121,33 @@ def extract_round(doc: Dict[str, Any], min_recall: float
             "point_families": families}
 
 
-def build_trajectory(paths: List[str], min_recall: float
+def extract_multichip(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """One multi-chip smoke file → status + flagships from its tail."""
+    flagships = []
+    for e in _json_lines(doc.get("tail") or ""):
+        if "metric" in e and "value" in e:
+            flagships.append({k: e[k] for k in
+                              ("metric", "value", "unit", "vs_baseline")
+                              if k in e})
+    return {"ok": bool(doc.get("ok")), "rc": doc.get("rc"),
+            "skipped": bool(doc.get("skipped")),
+            "n_devices": doc.get("n_devices"), "flagships": flagships}
+
+
+def build_trajectory(paths: List[str], min_recall: float,
+                     multichip_paths: Optional[List[str]] = None
                      ) -> List[Dict[str, Any]]:
+    multichip: Dict[Optional[int], Dict[str, Any]] = {}
+    for path in multichip_paths or []:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            multichip[_round_of(path)] = {"error": str(e)}
+            continue
+        multichip[_round_of(path)] = extract_multichip(doc)
     rounds = []
+    seen: set = set()
     for path in sorted(paths, key=lambda p: (_round_of(p) or 0, p)):
         try:
             with open(path) as f:
@@ -127,7 +159,18 @@ def build_trajectory(paths: List[str], min_recall: float
         row = extract_round(doc, min_recall)
         row["round"] = _round_of(path)
         row["file"] = os.path.basename(path)
+        if row["round"] in multichip:
+            row["multichip"] = multichip[row["round"]]
+            seen.add(row["round"])
         rounds.append(row)
+    # multi-chip-only rounds (e.g. a chaos leg landed without a BENCH
+    # artifact that round) still get a row
+    for rnd in sorted(k for k in multichip if k not in seen):
+        rounds.append({"round": rnd, "file": f"MULTICHIP_r{rnd:02d}.json",
+                       "flagships": [], "qps_at_recall": None,
+                       "point_families": {},
+                       "multichip": multichip[rnd]})
+    rounds.sort(key=lambda r: (r.get("round") or 0, r.get("file", "")))
     return rounds
 
 
@@ -137,16 +180,34 @@ def _fmt(v: Any) -> str:
     return str(v)
 
 
+def _fmt_multichip(mc: Optional[Dict[str, Any]]) -> str:
+    if not mc:
+        return "—"
+    if "error" in mc:
+        return f"unreadable: {mc['error']}"
+    if mc["skipped"]:
+        return "skipped"
+    status = ("ok" if mc["ok"] else f"FAIL rc={mc['rc']}")
+    status += f" ({mc['n_devices']}dev)"
+    if mc["flagships"]:
+        f0 = mc["flagships"][0]
+        status += (f" {f0.get('metric')}="
+                   f"{_fmt(f0.get('value', '—'))}{f0.get('unit', '')}")
+        if len(mc["flagships"]) > 1:
+            status += f" (+{len(mc['flagships']) - 1} more)"
+    return status
+
+
 def render_table(rounds: List[Dict[str, Any]], min_recall: float) -> str:
     lines = [
         f"| round | flagship metric | value | vs_baseline "
-        f"| QPS@recall>={min_recall:g} | measured |",
-        "|---|---|---|---|---|---|",
+        f"| QPS@recall>={min_recall:g} | measured | multichip |",
+        "|---|---|---|---|---|---|---|",
     ]
     for r in rounds:
         if "error" in r:
             lines.append(f"| {r['round']} | (unreadable: {r['error']}) "
-                         f"| | | | |")
+                         f"| | | | | |")
             continue
         flag = r["flagships"][0] if r["flagships"] else {}
         extra = (f" (+{len(r['flagships']) - 1} more)"
@@ -159,7 +220,8 @@ def render_table(rounds: List[Dict[str, Any]], min_recall: float) -> str:
             f"| {r['round']} | {flag.get('metric', '—')}{extra} "
             f"| {_fmt(flag.get('value', '—'))} {flag.get('unit', '')} "
             f"| {_fmt(flag.get('vs_baseline', '—'))} "
-            f"| {qa_s} | {fams or '—'} |")
+            f"| {qa_s} | {fams or '—'} "
+            f"| {_fmt_multichip(r.get('multichip'))} |")
     return "\n".join(lines)
 
 
@@ -169,6 +231,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="directory holding the BENCH round files")
     ap.add_argument("--glob", default="BENCH_r*.json",
                     help="round-file glob within --dir")
+    ap.add_argument("--multichip-glob", default="MULTICHIP_r*.json",
+                    help="multi-chip smoke-file glob within --dir "
+                         "(empty string disables the fold)")
     ap.add_argument("--min-recall", type=float, default=0.95,
                     help="recall floor for the QPS@recall column")
     ap.add_argument("--json", default=None,
@@ -179,7 +244,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"no round files match {args.glob!r} under {args.dir!r}",
               file=sys.stderr)
         return 1
-    rounds = build_trajectory(paths, args.min_recall)
+    mc_paths = (glob.glob(os.path.join(args.dir, args.multichip_glob))
+                if args.multichip_glob else [])
+    rounds = build_trajectory(paths, args.min_recall,
+                              multichip_paths=mc_paths)
     print(render_table(rounds, args.min_recall))
     if args.json:
         with open(args.json, "w") as f:

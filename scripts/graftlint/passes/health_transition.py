@@ -15,7 +15,7 @@ two things the type system can't hold:
   existing placement's ``.generation`` without threading ``generation=``
   publishes a routing change old warmed executables still answer for.
 
-Two rules, both ``health-transition``:
+Three rules, all ``health-transition``:
 
 - a function under ``raft_tpu/distributed/`` that assigns to a
   ``*state*``-named store (attribute or subscript — the tracker's
@@ -26,6 +26,14 @@ Two rules, both ``health-transition``:
   existing placement must pass a ``generation=`` keyword — it is
   re-deriving a successor placement and owes the bump.  (Fresh
   placements — ``shard_by_list`` — read no generation and stay exempt.)
+- **Load-score mutations go through the tracker** (PR 18): a function
+  under ``raft_tpu/distributed/`` or ``raft_tpu/serving/`` that
+  assigns to a ``*load_score*``-named store (the routing policy's
+  per-shard score table) must, in the same function, route the
+  evidence through a ``note_*``-named tracker method or emit the
+  paired signal — an ad-hoc score write outside the tracker seam is a
+  routing-table change no generation, event, or health state accounts
+  for.
 """
 
 from __future__ import annotations
@@ -69,6 +77,35 @@ def _emits(fn: ast.AST) -> bool:
             if callee is None:
                 continue
             if callee == "record_event" or "emit" in callee.lower():
+                return True
+    return False
+
+
+def _load_score_store(node: ast.AST):
+    """The attribute/subscript target of an assignment into a
+    ``*load_score*``-named store, or None."""
+    targets = []
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, ast.AugAssign):
+        targets = [node.target]
+    for t in targets:
+        base = t.value if isinstance(t, ast.Subscript) else t
+        if (isinstance(base, ast.Attribute)
+                and "load_score" in base.attr.lower()):
+            return t
+        if isinstance(base, ast.Name) and "load_score" in base.id.lower():
+            return t
+    return None
+
+
+def _routes_through_tracker(fn: ast.AST) -> bool:
+    """A ``note_*``-named call (the tracker's evidence seam) anywhere
+    in the function — the overload demotion path."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            callee = terminal_name(node.func)
+            if callee is not None and callee.startswith("note_"):
                 return True
     return False
 
@@ -121,6 +158,24 @@ class HealthTransitionPass:
                     f"distributed.health.* flight event + counter "
                     f"(call record_event or the module's _emit helper) "
                     f"or the chaos flight-trail gate goes blind"))
+        for mod in project.walk(*_PLACEMENT_SCOPE):
+            for fn, _stack in walk_functions(mod.tree):
+                store = None
+                for node in ast.walk(fn):
+                    store = _load_score_store(node)
+                    if store is not None:
+                        lineno = node.lineno
+                        break
+                if store is not None and not (_routes_through_tracker(fn)
+                                              or _emits(fn)):
+                    out.append(Diagnostic(
+                        mod.rel, lineno, "health-transition",
+                        f"'{fn.name}' mutates a routing load score "
+                        f"outside the tracker seam — overload evidence "
+                        f"must go through a note_* tracker method (or "
+                        f"emit the paired signal); an ad-hoc score "
+                        f"write is a routing change nothing accounts "
+                        f"for"))
         for mod in project.walk(*_PLACEMENT_SCOPE):
             for fn, _stack in walk_functions(mod.tree):
                 call = None

@@ -525,6 +525,52 @@ class TestHealthTransition:
                      rules=["health-transition"])
         assert [d.rule for d in diags] == ["health-transition"]
 
+    # -- rule 3 (PR 18): load-score mutations go through the tracker --
+
+    def test_adhoc_load_score_write_flagged(self):
+        src = ("def tune(self, s):\n"
+               "    self._load_score_rows[s] = 0.0\n")
+        diags = lint({"raft_tpu/distributed/x.py": src},
+                     rules=["health-transition"])
+        assert [d.rule for d in diags] == ["health-transition"]
+        assert "tracker seam" in diags[0].message
+
+    def test_load_score_rule_covers_serving(self):
+        src = ("def tune(self, s):\n"
+               "    self.load_scores = self.load_scores * 0.5\n")
+        diags = lint({"raft_tpu/serving/x.py": src},
+                     rules=["health-transition"])
+        assert [d.rule for d in diags] == ["health-transition"]
+
+    def test_load_score_write_through_tracker_clean(self):
+        src = ("def fold(self, planned):\n"
+               "    self._load_score_rows = 0.7 * self._load_score_rows\n"
+               "    self.tracker.note_overload(1, 2.0)\n")
+        assert lint({"raft_tpu/distributed/x.py": src},
+                    rules=["health-transition"]) == []
+
+    def test_load_score_write_with_emit_clean(self):
+        src = ("def fold(self, planned):\n"
+               "    self._load_score_rows = planned\n"
+               "    _emit('distributed.replica_choice', scores=planned)\n")
+        assert lint({"raft_tpu/distributed/x.py": src},
+                    rules=["health-transition"]) == []
+
+    def test_load_score_declaration_exempt(self):
+        # an ANNOTATED assignment is a declaration (the policy's
+        # __init__ zero-init), not a mutation — mirrors the state rule
+        src = ("import numpy as np\n"
+               "def __init__(self, n):\n"
+               "    self._load_score_rows: np.ndarray = np.zeros(n)\n")
+        assert lint({"raft_tpu/distributed/x.py": src},
+                    rules=["health-transition"]) == []
+
+    def test_load_score_rule_outside_scope_clean(self):
+        src = ("def tune(self, s):\n"
+               "    self._load_score_rows[s] = 0.0\n")
+        assert lint({"raft_tpu/neighbors/x.py": src},
+                    rules=["health-transition"]) == []
+
 
 # ---------------------------------------------------------------------------
 # host-sync

@@ -243,6 +243,72 @@ class TestReadmissionGuards:
         assert tr.state(0) == HEALTHY
 
 
+class TestOverload:
+    """PR 18: load evidence from the routing policy — a continuous
+    score demotion that caps at SUSPECT and never enters the failed
+    set (overload is not failure)."""
+
+    def test_penalty_accrues_and_caps_at_suspect(self):
+        tr, _ = _tracker(suspect_after=2)
+        for _ in range(10):
+            tr.note_overload(1, 4.0)
+        assert tr.state(1) == SUSPECT          # never FAILED from load
+        assert tr.failed_shards() == ()
+        assert tr.suspect_shards() == (1,)
+        assert tr.load_penalties()[1] > 0.0
+        assert tr.load_penalties()[0] == 0.0
+
+    def test_penalty_is_an_ewma_of_the_excess(self):
+        tr, _ = _tracker()
+        tr.note_overload(0, 3.0)
+        assert tr.load_penalties()[0] == pytest.approx(0.3 * 2.0)
+        tr.note_overload(0, 3.0)
+        assert tr.load_penalties()[0] == pytest.approx(
+            0.7 * 0.6 + 0.3 * 2.0)
+        # sub-mean load clamps at zero instead of going negative
+        for _ in range(20):
+            tr.note_overload(0, 0.1)
+        assert tr.load_penalties()[0] == 0.0
+
+    def test_ok_decays_the_penalty(self):
+        tr, _ = _tracker(suspect_after=100)
+        tr.note_overload(2, 5.0)
+        before = tr.load_penalties()[2]
+        tr.note_ok(2)
+        assert tr.load_penalties()[2] == pytest.approx(0.7 * before)
+
+    def test_failed_shard_ignores_overload(self):
+        tr, _ = _tracker(suspect_after=2, fail_after=2)
+        tr.note_timeout(3)
+        tr.note_timeout(3)
+        assert tr.state(3) == FAILED
+        tr.note_overload(3, 9.0)
+        assert tr.load_penalties()[3] == 0.0   # already out of routing
+        assert tr.state(3) == FAILED
+
+    def test_suspect_event_fires_with_load_cause(self):
+        flight.clear()
+        tr, _ = _tracker(suspect_after=2)
+        tr.note_overload(1, 4.0)
+        tr.note_overload(1, 4.0)
+        evs = flight.events("distributed.health.suspect")
+        assert evs and evs[0]["attrs"]["cause"] == "load"
+
+    def test_dwell_pins_load_escalation(self):
+        tr, clock = _tracker(suspect_after=1, dwell_s=5.0)
+        tr.note_overload(0, 4.0)
+        assert tr.state(0) == HEALTHY          # dwell not elapsed
+        assert tr.load_penalties()[0] > 0.0    # but the demotion lands
+        clock.t = 6.0
+        tr.note_overload(0, 4.0)
+        assert tr.state(0) == SUSPECT
+
+    def test_stats_expose_penalties(self):
+        tr, _ = _tracker()
+        tr.note_overload(1, 2.0)
+        assert tr.stats()["load_penalties"][1] > 0.0
+
+
 class TestPairedSignals:
     """Every transition = one flight event + the same-named counter —
     the contract graftlint's health-transition rule enforces statically

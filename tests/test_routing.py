@@ -1,0 +1,496 @@
+"""Load-aware replica routing (PR 18) — the policy that turns PR 17's
+replicas from failover spares into a throughput / tail-latency lever.
+
+Unit half: greedy least-loaded plan over the replica ranks (keeps the
+``healthy_routing`` keep-primary-when-uncovered contract), the probe
+heat window (lazy observe / maintenance-path refresh / decayed read),
+the load-score formula terms, and the overload evidence folding through
+the health tracker.  Integration half (8-device mesh): policy-routed
+search is BIT-IDENTICAL at full probe, spreads lists across replica
+ranks with zero steady-state recompiles while the tables update, a
+hedge re-issues to the *least-loaded* covering replica, a load-SUSPECT
+shard is never double-counted as failed in the status vector, and the
+probe-frequency-aware rebalance separates synthetically hot co-located
+lists.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from raft_tpu import observability as obs
+from raft_tpu.core.error import RaftError
+from raft_tpu.distributed import ann
+from raft_tpu.distributed.health import (
+    HealthConfig,
+    HealthTracker,
+    SUSPECT,
+)
+from raft_tpu.distributed.routing import RoutingConfig, RoutingPolicy
+from raft_tpu.neighbors import ivf_pq
+from raft_tpu.observability import flight
+
+
+class _StubTracker:
+    """Minimal tracker double: fixed penalties in, overload evidence
+    recorded out — isolates the policy's score math from the real
+    state machine (which tests/test_health.py owns)."""
+
+    def __init__(self, n, penalties=None):
+        self._pen = list(penalties if penalties is not None
+                         else [0.0] * n)
+        self.overloads = []
+
+    def load_penalties(self):
+        return tuple(self._pen)
+
+    def note_overload(self, shard, load):
+        self.overloads.append((int(shard), float(load)))
+
+
+# ---------------------------------------------------------------------------
+# config
+
+
+class TestRoutingConfig:
+    def test_defaults_validate(self):
+        cfg = RoutingConfig()
+        assert cfg.validate() is cfg
+
+    @pytest.mark.parametrize("kw", [dict(ewma_alpha=0.0),
+                                    dict(ewma_alpha=1.5),
+                                    dict(window_slots=0),
+                                    dict(window_decay=0.0),
+                                    dict(max_pending=0),
+                                    dict(overload_factor=0.5),
+                                    dict(hot_bucket_rows=-1)])
+    def test_rejects_bad_knobs(self, kw):
+        with pytest.raises(RaftError):
+            RoutingConfig(**kw).validate()
+
+    def test_policy_rejects_empty(self):
+        with pytest.raises(RaftError):
+            RoutingPolicy(0)
+
+
+# ---------------------------------------------------------------------------
+# the plan (pure host — no mesh)
+
+
+class TestPlanUnit:
+    NL = 32
+
+    def _placement(self, r=2, seed=11):
+        sizes = np.random.default_rng(seed).integers(5, 200, self.NL)
+        return ann.compute_placement(sizes, 8, replication_factor=r)
+
+    def test_r1_plan_is_the_primary_tables(self):
+        p = self._placement(r=1)
+        pol = RoutingPolicy(8)
+        eo, es = pol.plan(p)
+        np.testing.assert_array_equal(eo, p.owner)
+        np.testing.assert_array_equal(es, p.local_slot)
+
+    def test_plan_routes_only_to_real_owners(self):
+        p = self._placement(r=2)
+        pol = RoutingPolicy(8)
+        eo, es = pol.plan(p)
+        owners, slots = p.rank_tables()
+        for g in range(self.NL):
+            rank = np.nonzero(owners[:, g] == eo[g])[0]
+            assert rank.size == 1, f"list {g} routed to a non-owner"
+            assert es[g] == slots[rank[0], g]
+
+    def test_plan_spreads_and_balances(self):
+        # greedy LPT over both ranks must use rank 1 and end at least
+        # as balanced (by planned weight) as primary-only routing
+        p = self._placement(r=2)
+        pol = RoutingPolicy(8)
+        eo, _ = pol.plan(p)
+        choice = pol.choice_summary()
+        assert choice["per_rank_lists"][1] > 0
+        assert sum(choice["per_rank_lists"]) == self.NL
+        w = np.full(self.NL, 1.0 / self.NL)   # fresh policy: uniform
+        routed = np.bincount(eo, weights=w, minlength=8)
+        primary = np.bincount(np.asarray(p.owner), weights=w,
+                              minlength=8)
+        assert routed.max() <= primary.max() + 1e-12
+
+    def test_down_shard_excluded_and_covered(self):
+        p = self._placement(r=2)
+        pol = RoutingPolicy(8)
+        eo, _ = pol.plan(p, down=(3,))
+        assert 3 not in set(eo.tolist())
+        assert pol.choice_summary()["down"] == [3]
+
+    def test_uncovered_list_keeps_rank0_primary(self):
+        # both owners of a list down -> plan keeps the primary (same
+        # contract as healthy_routing: degraded masking owns it)
+        p = self._placement(r=2)
+        owners, _ = p.rank_tables()
+        g = 0
+        down = tuple(int(owners[j, g]) for j in range(2))
+        pol = RoutingPolicy(8)
+        eo, es = pol.plan(p, down=down)
+        assert eo[g] == p.owner[g]
+        assert es[g] == p.local_slot[g]
+
+    def test_hedge_prefers_least_loaded_covering_replica(self):
+        # satellite: the down (straggling) shard's lists must re-issue
+        # to the covering replica with the LOWEST load score, not
+        # blindly the lowest rank — penalize one covering shard and
+        # every choice must avoid it (r=3: always an alternative)
+        p = self._placement(r=3)
+        owners, _ = p.rank_tables()
+        s = int(p.owner[0])                   # the straggler
+        mine = np.nonzero(np.asarray(p.owner) == s)[0]
+        pen_shard = int(owners[1, mine[0]])   # covers some of s's lists
+        pen = [0.0] * 8
+        pen[pen_shard] = 10.0                 # 1024 rows/unit >> weights
+        pol = RoutingPolicy(8, tracker=_StubTracker(8, pen))
+        eo, _ = pol.plan(p, down=(s,))
+        for g in mine:
+            assert eo[g] != s
+            assert eo[g] != pen_shard, (
+                f"list {g} hedged onto the loaded replica "
+                f"{pen_shard} over {owners[:, g]}")
+
+    def test_load_scores_use_tracker_penalties(self):
+        pen = [0.0, 2.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0]
+        pol = RoutingPolicy(8, tracker=_StubTracker(8, pen))
+        scores = pol.shard_scores()
+        assert scores[1] == pytest.approx(
+            pol.config.penalty_rows * 2.0)
+        assert scores[0] == 0.0
+
+    def test_overload_evidence_routes_through_tracker(self):
+        # heat concentrated on one shard's lists drives its EWMA rows
+        # past overload_factor x mean -> note_overload fires with the
+        # ratio, and the mutation never touches tracker state directly
+        p = self._placement(r=1)
+        s = int(p.owner[0])
+        mine = np.nonzero(np.asarray(p.owner) == s)[0]
+        hist = np.zeros(self.NL)
+        hist[mine] = 1000.0
+        tr = _StubTracker(8)
+        pol = RoutingPolicy(8, tracker=tr)
+        pol.observe_probes(hist)
+        assert pol.refresh() == 1
+        for _ in range(8):
+            pol.plan(p)
+        assert tr.overloads, "hot shard never reported"
+        shard, ratio = tr.overloads[-1]
+        assert shard == s
+        assert ratio > pol.config.overload_factor
+
+
+class TestProbeWindow:
+    def test_refresh_empty_is_noop(self):
+        pol = RoutingPolicy(4)
+        assert pol.refresh() == 0
+        assert pol.expected_probe_load() is None
+
+    def test_window_normalizes_and_decays(self):
+        pol = RoutingPolicy(4, RoutingConfig(window_decay=0.5))
+        pol.observe_probes(np.array([10.0, 0.0, 0.0, 0.0]))
+        assert pol.refresh() == 1
+        pol.observe_probes(np.array([0.0, 10.0, 0.0, 0.0]))
+        assert pol.refresh() == 1
+        heat = pol.expected_probe_load()
+        assert heat.sum() == pytest.approx(1.0)
+        # newest slot carries weight 1.0, the older one decay=0.5
+        assert heat[1] == pytest.approx(2.0 / 3.0)
+        assert heat[0] == pytest.approx(1.0 / 3.0)
+
+    def test_window_slots_bounded(self):
+        pol = RoutingPolicy(2, RoutingConfig(window_slots=2))
+        for _ in range(5):
+            pol.observe_probes(np.ones(2))
+            pol.refresh()
+        assert pol.stats()["window_slots"] == 2
+
+    def test_pending_bounded_without_refresh(self):
+        pol = RoutingPolicy(2, RoutingConfig(max_pending=3))
+        for _ in range(10):
+            pol.observe_probes(np.ones(2))
+        assert pol.stats()["pending_batches"] == 3
+
+    def test_spread_bucket_map(self):
+        pol = RoutingPolicy(4, RoutingConfig(hot_bucket_rows=64))
+        assert pol.spread_bucket(1)
+        assert pol.spread_bucket(64)
+        assert not pol.spread_bucket(65)
+        assert not pol.spread_bucket(512)
+
+
+# ---------------------------------------------------------------------------
+# heat-weighted LPT (the rebalancer's recompute math)
+
+
+class TestHeatWeightedPlacement:
+    def test_heat_weight_separates_colocated_hot_lists(self):
+        # equal sizes: LPT wraps lists round-robin, so lists 0 and 8
+        # share shard 0.  Heat-weighted recompute (probe rate x rows,
+        # the rebalance_routed formula) makes them the two heaviest
+        # and LPT puts them on DIFFERENT shards
+        sizes = np.full(16, 100, np.int64)
+        p0 = ann.compute_placement(sizes, 8, replication_factor=2)
+        assert p0.owner[0] == p0.owner[8]
+        heat = np.full(16, 1.0)
+        heat[[0, 8]] = 50.0
+        heat /= heat.sum()
+        weights = np.maximum((sizes * heat * 16).astype(np.int64), 1)
+        p1 = ann.compute_placement(weights, 8, replication_factor=2,
+                                   generation=p0.generation + 1)
+        assert p1.owner[0] != p1.owner[8]
+        # anti-co-location still holds for each hot list's own replicas
+        for g in (0, 8):
+            assert len(set(p1.owners[:, g].tolist())) == 2
+
+
+# ---------------------------------------------------------------------------
+# integration: the 8-device mesh
+
+
+class TestRoutedSearchWithPolicy:
+    """Mesh half: mirrors ``TestReplicatedRouted``'s fixtures — the
+    policy must compose with the PR 17 failover/hedging machinery
+    without changing one bit of any answer."""
+
+    N, DIM, NL, NQ, K = 2048, 32, 32, 16, 10
+
+    @pytest.fixture(scope="class")
+    def rhandle(self):
+        devs = jax.devices()
+        if len(devs) < 8:
+            devs = jax.devices("cpu")
+        if len(devs) < 8:
+            pytest.skip("needs 8 devices")
+        from raft_tpu.comms import CommsSession
+        mesh = jax.sharding.Mesh(np.asarray(devs[:8]), ("data",))
+        s = CommsSession(mesh=mesh, axis_name="data").init()
+        yield s.worker_handle(seed=0)
+        s.destroy()
+
+    @pytest.fixture(scope="class")
+    def data(self):
+        rng = np.random.default_rng(7)
+        db = rng.normal(size=(self.N, self.DIM)).astype(np.float32)
+        q = rng.normal(size=(self.NQ, self.DIM)).astype(np.float32)
+        return db, q
+
+    @pytest.fixture(scope="class")
+    def built(self, rhandle, data):
+        db, _ = data
+        params = ivf_pq.IndexParams(n_lists=self.NL, pq_dim=8,
+                                    kmeans_n_iters=3,
+                                    cache_reconstructions=True)
+        base = ivf_pq.build(rhandle, params, db)
+        return (base, ann.shard_by_list(rhandle, base,
+                                        replication_factor=2))
+
+    @pytest.fixture(scope="class")
+    def r3(self, rhandle, built):
+        base, _ = built
+        return ann.shard_by_list(rhandle, base, replication_factor=3)
+
+    def _policy(self, tracker=None, **kw):
+        return RoutingPolicy(8, RoutingConfig(**kw) if kw else None,
+                             tracker=tracker)
+
+    # ---- bit-identity + the flight trail ---------------------------------
+
+    def test_policy_routed_bit_identical_full_probe(self, rhandle, data,
+                                                    built):
+        _, q = data
+        _, r2 = built
+        sp = ivf_pq.SearchParams(n_probes=self.NL)
+        d0, i0 = ann.search(rhandle, sp, r2, q, self.K)
+        pol = self._policy()
+        flight.clear()
+        with obs.collecting():
+            c0 = obs.registry().counter("distributed.replica_choice").value
+            d1, i1 = ann.search(rhandle, sp, r2, q, self.K, routing=pol)
+            c1 = obs.registry().counter("distributed.replica_choice").value
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d0))
+        assert c1 == c0 + 1
+        # the healthy plan really used rank 1 (replicas paying rent)
+        choice = pol.choice_summary()
+        assert choice["per_rank_lists"][1] > 0
+        assert choice["down"] == []
+        evs = flight.events("distributed.replica_choice")
+        assert evs and evs[0]["attrs"]["reason"] == "load_spread"
+        assert evs[0]["attrs"]["per_rank_lists"] == \
+            choice["per_rank_lists"]
+
+    def test_policy_routed_fused_bit_identical(self, rhandle, data,
+                                               built):
+        _, q = data
+        _, r2 = built
+        sp = ivf_pq.SearchParams(n_probes=self.NL, scan_mode="fused")
+        d0, i0 = ann.search(rhandle, sp, r2, q, self.K)
+        d1, i1 = ann.search(rhandle, sp, r2, q, self.K,
+                            routing=self._policy())
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d0))
+
+    def test_failover_with_policy_bit_identical(self, rhandle, data,
+                                                built):
+        _, q = data
+        _, r2 = built
+        sp = ivf_pq.SearchParams(n_probes=self.NL)
+        d0, i0 = ann.search(rhandle, sp, r2, q, self.K)
+        pol = self._policy()
+        flight.clear()
+        d1, i1, st = ann.search(rhandle, sp, r2, q, self.K,
+                                failed_shards=(2,), routing=pol,
+                                return_status=True)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d0))
+        st = np.asarray(st)
+        assert st[2] == ann.SHARD_REPLICA_SERVED
+        assert not np.any(st == ann.SHARD_FAILED)
+        evs = flight.events("distributed.replica_choice")
+        assert evs and evs[0]["attrs"]["reason"] == "failover"
+
+    # ---- zero recompiles while the tables update -------------------------
+
+    def test_zero_recompiles_while_tables_update(self, rhandle, data,
+                                                 built):
+        _, q = data
+        _, r2 = built
+        sp = ivf_pq.SearchParams(n_probes=8)
+        tr = HealthTracker(8, HealthConfig(suspect_after=100))
+        pol = self._policy(tracker=tr)
+        ann.search(rhandle, sp, r2, q, self.K, routing=pol)   # warm
+        with obs.collecting():
+            c0 = obs.registry().counter("xla.compiles").value
+            for step in range(4):
+                # every step shifts the scores (EWMA folds + a fresh
+                # tracker penalty) -> new effective tables, same shapes
+                tr.note_overload(step % 8, 3.0)
+                ann.search(rhandle, sp, r2, q, self.K, routing=pol)
+            c1 = obs.registry().counter("xla.compiles").value
+        assert c1 == c0, f"{c1 - c0} recompiles from table updates"
+
+    # ---- hedging: least-loaded replica (satellite) -----------------------
+
+    def test_hedge_reissues_to_least_loaded_replica(self, rhandle, data,
+                                                    r3, monkeypatch):
+        """A straggler's lists must re-issue to the covering replica
+        with the lowest load score (r=3: two candidates each), the
+        answer stays bit-identical and the wait collapses to the
+        deadline."""
+        from raft_tpu.resilience import FaultPlan, faults
+        slept = []
+        monkeypatch.setattr(faults, "_sleep", slept.append)
+        _, q = data
+        sp = ivf_pq.SearchParams(n_probes=self.NL)
+        d0, i0 = ann.search(rhandle, sp, r3, q, self.K)
+        owners, _ = r3.placement.rank_tables()
+        s = int(r3.placement.owner[0])          # the straggler
+        mine = np.nonzero(np.asarray(r3.placement.owner) == s)[0]
+        pen_shard = int(owners[1, mine[0]])     # a covering replica
+        pen = [0.0] * 8
+        pen[pen_shard] = 10.0
+        pol = self._policy(tracker=_StubTracker(8, pen))
+        plans = []
+        orig = pol.plan
+        monkeypatch.setattr(
+            pol, "plan",
+            lambda p, down=(): plans.append((tuple(down), orig(p, down)))
+            or plans[-1][1])
+        flight.clear()
+        plan = FaultPlan(seed=3).straggle_shard(s, delay=0.5)
+        with plan.active():
+            d1, i1, st = ann.search(rhandle, sp, r3, q, self.K,
+                                    shard_deadline_s=0.05,
+                                    routing=pol, return_status=True)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d0))
+        assert slept == [0.05], slept
+        assert np.asarray(st)[s] == ann.SHARD_REPLICA_SERVED
+        down, (eo, _) = plans[-1]
+        assert down == (s,)
+        for g in mine:
+            assert eo[g] != s
+            assert eo[g] != pen_shard, (
+                f"hedge sent list {g} to the loaded replica")
+        evs = flight.events("distributed.replica_choice")
+        assert evs and evs[-1]["attrs"]["reason"] == "hedge"
+        assert flight.events("distributed.hedged_read")
+
+    def test_load_suspect_not_counted_failed_in_status(self, rhandle,
+                                                       data, built):
+        """Satellite: a shard demoted to SUSPECT by pure load evidence
+        is hedge-able but NOT failed — the status vector must report it
+        replica-served (or plain OK), never SHARD_FAILED, and the
+        tracker must keep it out of failed_shards()."""
+        _, q = data
+        _, r2 = built
+        tr = HealthTracker(8, HealthConfig(suspect_after=2))
+        for _ in range(4):
+            tr.note_overload(3, 5.0)
+        assert tr.states()[3] == SUSPECT
+        assert tr.failed_shards() == ()
+        sp = ivf_pq.SearchParams(n_probes=self.NL)
+        d0, i0 = ann.search(rhandle, sp, r2, q, self.K)
+        pol = self._policy(tracker=tr)
+        d1, i1, st = ann.search(rhandle, sp, r2, q, self.K, health=tr,
+                                routing=pol, return_status=True)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d0))
+        st = np.asarray(st)
+        assert not np.any(st == ann.SHARD_FAILED)
+        assert st[3] == ann.SHARD_REPLICA_SERVED   # hedged, not dead
+
+    # ---- probe-frequency accumulation + heat-aware rebalance -------------
+
+    def test_dispatch_observes_probes_lazily(self, rhandle, data, built):
+        _, q = data
+        _, r2 = built
+        sp = ivf_pq.SearchParams(n_probes=4)
+        pol = self._policy()
+        for _ in range(3):
+            ann.search(rhandle, sp, r2, q, self.K, routing=pol)
+        assert pol.stats()["pending_batches"] == 3
+        assert pol.refresh() == 3
+        heat = pol.expected_probe_load()
+        assert heat.shape == (self.NL,)
+        assert heat.sum() == pytest.approx(1.0)
+        # 4 of 32 lists probed per query -> heat is concentrated
+        assert np.count_nonzero(heat) < self.NL
+
+    def test_heat_aware_rebalance_separates_hot_lists(self, rhandle,
+                                                      data, built):
+        """Acceptance: feed the policy a synthetic probe histogram
+        concentrated on two lists co-located on one primary shard; the
+        probe-frequency-aware rebalance must become eligible on heat
+        skew alone and the recomputed placement must pull the hot
+        pair's primaries apart — without changing one bit of the
+        answers."""
+        from raft_tpu.serving import rebalancer
+        _, q = data
+        _, r2 = built
+        own = np.asarray(r2.placement.owner)
+        s = int(np.argmax(np.bincount(own, minlength=8)))
+        g1, g2 = np.nonzero(own == s)[0][:2]
+        hist = np.ones(self.NL)
+        hist[[g1, g2]] = 5000.0
+        pol = self._policy()
+        pol.observe_probes(hist)
+        sp = ivf_pq.SearchParams(n_probes=self.NL)
+        d0, i0 = ann.search(rhandle, sp, r2, q, self.K)
+        cand = rebalancer.rebalance_routed(rhandle, r2, routing=pol)
+        assert cand is not r2, "heat skew did not make the pass eligible"
+        assert cand.placement.generation == r2.placement.generation + 1
+        new_own = np.asarray(cand.placement.owner)
+        assert new_own[g1] != new_own[g2], (
+            "hot lists still co-located after heat-aware rebalance")
+        d1, i1 = ann.search(rhandle, sp, cand, q, self.K)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d0))
+        # the pass re-seeded the policy's expected-work rows from the
+        # new placement
+        assert pol.stats()["pending_batches"] == 0
