@@ -1402,13 +1402,295 @@ def bench_ingest(res, db, queries, *, build_param=None, search_param=None,
     return out
 
 
+def bench_dist_ingest(res, db, queries, *, build_param=None,
+                      search_param=None, k=SERVING_K, clients=4,
+                      request_rows=16, duration_s=1.5, write_rows=16,
+                      write_rate_rows_per_s=32.0, kill_shard=2,
+                      kill_after=5, seed=20260805, wal_dir=None) -> list:
+    """Round-19 routed arm of the durability smoke: replicated durable
+    ingest (per-shard WALs, r=2) under concurrent routed reads with a
+    seed-pinned shard kill MID-STREAM at the ``ingest.dist.append``
+    boundary.
+
+    One :class:`~raft_tpu.serving.dist_ingest.RoutedIngest` over an
+    8-shard ``by_list`` placement at replication_factor=2, three
+    phases:
+
+    1. closed-loop routed READ baseline (all-memtable merge warmed, no
+       writer);
+    2. a writer thread streaming quorum-acked batches concurrent with
+       the same closed-loop readers; ``kill_after`` leader appends in,
+       ``FaultPlan.kill_shard_at`` drops ``kill_shard`` — the ack
+       plan re-routes onto survivors with zero recompiles and every
+       batch keeps acking;
+    3. the production recovery arc: the tracker declares the shard
+       FAILED, its WAL + memtable are wiped (process loss), the WAL
+       delta phase rebuilds them from the live replicas' logs
+       (``health.catch_up(..., ingest=...)``), readmission is
+       canary-gated, and EVERY acked id must be present in the live
+       delta tier both while the shard is down and after readmission.
+
+    Emits ``dist_ingest_writes_per_s``, ``dist_ingest_qps_concurrent``
+    (``vs_baseline`` = fraction of the no-writer routed closed loop,
+    CI bar 0.8x) and ``dist_ingest_recovery`` (catch-up records,
+    ``zero_acked_loss``, the flight-trail event counts)."""
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+
+    from raft_tpu import observability as obs
+    from raft_tpu.comms.session import CommsSession
+    from raft_tpu.distributed import ann as dist_ann
+    from raft_tpu.distributed import health
+    from raft_tpu.neighbors import ivf_pq
+    from raft_tpu.observability import flight as _flight
+    from raft_tpu.resilience import FaultPlan
+    from raft_tpu.serving.dist_ingest import DistIngestConfig, RoutedIngest
+
+    bp = build_param or {"nlist": 256, "pq_dim": 32}
+    spc = search_param or {"nprobe": 16}
+    db_h = np.asarray(db)
+    n, dim = db_h.shape
+    q = np.asarray(queries)
+    wrows = np.ascontiguousarray(db_h[:write_rows])
+    wal_root = wal_dir or tempfile.mkdtemp(prefix="raft-tpu-bench-dist-")
+    out = []
+    session = CommsSession().init()
+    try:
+        handle = session.worker_handle(seed=0)
+        n_shards = len(jax.devices())
+        base = ivf_pq.build(
+            handle,
+            ivf_pq.IndexParams(n_lists=bp["nlist"], pq_dim=bp["pq_dim"],
+                               kmeans_n_iters=bp.get("kmeans_n_iters", 4),
+                               cache_reconstructions=True),
+            db_h)
+        routed = dist_ann.shard_by_list(handle, base,
+                                        replication_factor=2)
+        sp = ivf_pq.SearchParams(n_probes=spc["nprobe"])
+        tracker = health.HealthTracker(n_shards, health.HealthConfig(
+            suspect_after=1, fail_after=1, ok_to_clear=1, dwell_s=0.0))
+        ing = RoutedIngest(
+            handle, routed, base,
+            config=DistIngestConfig(wal_dir=os.path.join(wal_root, "wal"),
+                                    memtable_capacity=1 << 14,
+                                    tomb_capacity=1 << 14),
+            tracker=tracker)
+        ing.recover()
+        with obs.collecting():
+            compiles = obs.registry().counter("xla.compiles")
+            state = {"acked": [], "unavailable": 0, "errors": 0}
+            next_id = [n]
+            # ONE routed program in flight at a time: the routed read
+            # and the write router are SPMD collectives over the full
+            # mesh, and the single-controller CPU runtime deadlocks if
+            # two threads interleave participants of different
+            # rendezvous.  Dispatch is async, so the lock alone is not
+            # enough — every search must also block_until_ready INSIDE
+            # the lock, or in-flight collective programs pile up and
+            # interleave anyway.  Both phases (baseline and concurrent)
+            # queue through the same lock, so the QPS ratio stays
+            # apples to apples — the writer steals device time, which
+            # is exactly what the gate measures.
+            dispatch = threading.Lock()
+
+            def locked_search(sub):
+                with dispatch:
+                    jax.block_until_ready(ing.search(sp, sub, k))
+
+            def write_batch():
+                nid = next_id[0]
+                ids = np.arange(nid, nid + write_rows, dtype=np.int64)
+                next_id[0] = nid + write_rows
+                try:
+                    with dispatch:
+                        ing.write(ids, wrows)
+                except Exception as exc:  # noqa: BLE001 - bench keeps going
+                    if type(exc).__name__ == "Unavailable":
+                        state["unavailable"] += 1
+                    else:
+                        state["errors"] += 1
+                    return False
+                state["acked"].append(nid)
+                return True
+
+            def closed_loop(dur):
+                done = [0] * clients
+                stop_at = time.perf_counter() + dur
+
+                def client(j):
+                    base_q = (j * 131) % max(1, q.shape[0] - request_rows)
+                    sub = q[base_q:base_q + request_rows]
+                    while time.perf_counter() < stop_at:
+                        locked_search(sub)
+                        done[j] += sub.shape[0]
+
+                ts = [threading.Thread(target=client, args=(j,))
+                      for j in range(clients)]
+                t0 = time.perf_counter()
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                return sum(done) / (time.perf_counter() - t0)
+
+            # warm the write router + BOTH read paths: healthy, and the
+            # masked failover view (same shapes, but the mask fill ops
+            # are their own tiny executables — first masked read after
+            # the kill must not compile inside the fence)
+            ing.prewarm([write_rows])
+            write_batch()
+            locked_search(q[:request_rows])
+            warm_plan = FaultPlan(seed=seed).kill_shard_at(
+                "ingest.dist.route", kill_shard, after=0)
+            with warm_plan.active():
+                write_batch()          # fires the warm kill at route
+                locked_search(q[:request_rows])       # masked view
+            locked_search(q[:request_rows])           # healthy again
+            baseline_qps = closed_loop(duration_s)
+
+            # ---- phase 2: writer + readers, shard killed mid-stream --
+            c0 = compiles.value
+            acked0 = len(state["acked"])
+            stop_writer = threading.Event()
+
+            def writer():
+                # open-loop at the conf's offered write rate (same
+                # contract as the single-node arm): the routed arm
+                # measures failover correctness under a steady write
+                # load, not the quorum-append ceiling
+                period = write_rows / max(write_rate_rows_per_s, 1e-9)
+                deadline = time.perf_counter()
+                while not stop_writer.is_set():
+                    write_batch()
+                    deadline += period
+                    lag = deadline - time.perf_counter()
+                    if lag > 0:
+                        time.sleep(lag)
+                    else:
+                        deadline = time.perf_counter()
+
+            plan = FaultPlan(seed=seed).kill_shard_at(
+                "ingest.dist.append", kill_shard, after=kill_after)
+            with plan.active():
+                wt = threading.Thread(target=writer, daemon=True)
+                t_phase = time.perf_counter()
+                wt.start()
+                concurrent_qps = closed_loop(duration_s)
+                stop_writer.set()
+                wt.join(timeout=30.0)
+                elapsed = time.perf_counter() - t_phase
+                # the decision loop declares the killed shard FAILED
+                # while the plan still masks it
+                tracker.note_timeout(kill_shard)
+                tracker.note_timeout(kill_shard)
+            recompiles_steady = int(compiles.value - c0)
+            kill_fired = sum(spec.fired for spec in plan.specs) == 1
+            acked_batches = len(state["acked"]) - acked0
+
+            def live_delta_ids(skip=()):
+                ids = set()
+                for s in range(n_shards):
+                    if s in skip:
+                        continue
+                    li, _, _, _ = ing.memtables[s].fold_items()
+                    ids.update(int(i) for i in li)
+                return ids
+
+            def lost_acked(present):
+                # a batch counts as lost if ANY of its acked rows is
+                # absent from the live delta tier
+                return [nid for nid in state["acked"]
+                        if any(i not in present
+                               for i in range(nid, nid + write_rows))]
+
+            # ---- phase 3: process loss -> delta catch-up -> readmit --
+            if ing._wals[kill_shard] is not None:
+                ing._wals[kill_shard].close()
+                ing._wals[kill_shard] = None
+            os.unlink(ing.wal_path(kill_shard))
+            ing.memtables[kill_shard].reset()
+            lost_down = lost_acked(live_delta_ids(skip=(kill_shard,)))
+            t0 = time.perf_counter()
+            caught = health.catch_up(handle, ing.index, kill_shard,
+                                     tracker=tracker, ingest=ing)
+            readmitted = health.readmit(handle, ing, caught, kill_shard,
+                                        tracker=tracker)
+            recovery_s = time.perf_counter() - t0
+            lost_after = lost_acked(live_delta_ids())
+            locked_search(q[:request_rows])        # post-readmit serve
+            dist_events = sum(
+                len(_flight.events(f"serving.ingest.dist.{name}"))
+                for name in ("catch_up", "write_error", "unavailable",
+                             "replay", "fold"))
+            health_events = sum(
+                len(_flight.events(f"distributed.health.{name}"))
+                for name in ("failed", "suspect", "catch_up",
+                             "readmitted"))
+        ing.close()
+    finally:
+        session.destroy()
+    if wal_dir is None:
+        shutil.rmtree(wal_root, ignore_errors=True)
+    frac = concurrent_qps / max(baseline_qps, 1e-9)
+    out.append({
+        "metric": "dist_ingest_writes_per_s",
+        "value": round(acked_batches * write_rows / elapsed, 1),
+        "unit": "rows/s",
+        "vs_baseline": 1.0,
+        "detail": {"write_rows": write_rows, "n_shards": n_shards,
+                   "offered_rows_per_s": write_rate_rows_per_s,
+                   "replication_factor": 2, "seed": seed,
+                   "kill_site": "ingest.dist.append",
+                   "kill_shard": kill_shard, "kill_fired": kill_fired,
+                   "acked_batches": acked_batches,
+                   "unavailable_refusals": state["unavailable"],
+                   "writer_errors": state["errors"]},
+    })
+    out.append({
+        "metric": "dist_ingest_qps_concurrent",
+        "value": round(concurrent_qps, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(frac, 3),
+        "detail": {"baseline_qps_no_writer": round(baseline_qps, 1),
+                   "fraction_of_baseline": round(frac, 3),
+                   "recompiles_steady": recompiles_steady,
+                   "clients": clients, "request_rows": request_rows},
+    })
+    out.append({
+        "metric": "dist_ingest_recovery",
+        "value": round(recovery_s, 3),
+        "unit": "s",
+        "vs_baseline": 1.0,
+        "detail": {"acked_rows": len(state["acked"]) * write_rows,
+                   "zero_acked_loss_while_down": not lost_down,
+                   "zero_acked_loss_after_readmit": not lost_after,
+                   "lost_batches_while_down": len(lost_down),
+                   "lost_batches_after_readmit": len(lost_after),
+                   "readmitted": bool(readmitted),
+                   "dist_flight_events": dist_events,
+                   "health_flight_events": health_events},
+    })
+    return out
+
+
 def run_ingest(conf_path: str) -> int:
     """``--ingest`` mode: the CI durability smoke.  Builds the conf's
     dataset, runs :func:`bench_ingest` (open-loop writer at 2x the
     calibrated write peak concurrent with closed-loop reads, then
     kill-and-recover), and FAILS (exit 1) on concurrent-read QPS below
     the bar, ANY acked-write loss after recovery, steady-state
-    recompiles, or a missing WAL-replay event trail."""
+    recompiles, or a missing WAL-replay event trail.
+
+    A ``routed`` section in the conf's ``ingest`` block adds the
+    round-19 replicated arm (:func:`bench_dist_ingest`): per-shard
+    WALs at r=2 with a seed-pinned mid-stream shard kill, gated on
+    zero acked loss (both while the shard is down and after the
+    catch-up readmission), the same 0.8x read-QPS bar, zero
+    steady-state recompiles, and a non-empty ``ingest.dist`` + health
+    flight trail.  Skipped (not failed) under 8 devices."""
     from raft_tpu import DeviceResources
     from raft_tpu.observability import flight as _flight
 
@@ -1466,6 +1748,78 @@ def run_ingest(conf_path: str) -> int:
         failures.append("no serving.ingest.replay events landed in the "
                         "flight recorder — recovery never replayed the "
                         "WAL")
+    r = g.get("routed")
+    if r:
+        import jax as _jax
+        if len(_jax.devices()) < 8:
+            print("INGEST ROUTED SKIP: <8 devices, replicated routed "
+                  "arm needs the 8-shard mesh", flush=True)
+        else:
+            _flight.clear()
+            rlines = bench_dist_ingest(
+                res, db, queries,
+                build_param=r.get("build_param", s.get("build_param")),
+                search_param=r.get("search_param",
+                                   s.get("search_param")),
+                k=s.get("k", SERVING_K),
+                clients=r.get("clients", 4),
+                request_rows=r.get("request_rows", 16),
+                duration_s=r.get("duration_s", 1.5),
+                write_rows=r.get("write_rows", 16),
+                write_rate_rows_per_s=r.get("write_rate_rows_per_s",
+                                            32.0),
+                kill_shard=r.get("kill_shard", 2),
+                kill_after=r.get("kill_after", 5),
+                seed=r.get("seed", 20260805))
+            for line in rlines:
+                _emit(line)
+            rby = {ln["metric"]: ln for ln in rlines}
+            rbar = r.get("min_qps_fraction_of_baseline", bar)
+            rqps = rby["dist_ingest_qps_concurrent"]
+            if rqps["vs_baseline"] < rbar:
+                failures.append(
+                    f"routed concurrent-read QPS "
+                    f"{rqps['vs_baseline']:.2f}x the no-writer routed "
+                    f"baseline with a shard killed mid-stream "
+                    f"(bar: {rbar:.2f}x)")
+            if rqps["detail"]["recompiles_steady"] != 0:
+                failures.append(
+                    f"{rqps['detail']['recompiles_steady']} XLA "
+                    "recompiles across the routed write->failover->"
+                    "search steady state (masked replica views must "
+                    "keep the merge pytree constant)")
+            rw = rby["dist_ingest_writes_per_s"]["detail"]
+            if not rw["kill_fired"]:
+                failures.append(
+                    "seed-pinned shard kill never fired — the routed "
+                    "arm measured a healthy cluster")
+            if rw["writer_errors"]:
+                failures.append(
+                    f"{rw['writer_errors']} routed writer errors "
+                    "(quorum re-planning must absorb a single-shard "
+                    "kill at r=2; Unavailable is the only refusal)")
+            rrec = rby["dist_ingest_recovery"]["detail"]
+            if not rrec["zero_acked_loss_while_down"]:
+                failures.append(
+                    f"ACKED WRITE LOSS while shard down: "
+                    f"{rrec['lost_batches_while_down']} acked batches "
+                    f"unreadable from surviving replicas")
+            if not rrec["zero_acked_loss_after_readmit"]:
+                failures.append(
+                    f"ACKED WRITE LOSS after catch-up: "
+                    f"{rrec['lost_batches_after_readmit']} acked "
+                    f"batches missing post-readmission")
+            if not rrec["readmitted"]:
+                failures.append("caught-up shard failed canary "
+                                "readmission")
+            if not rrec["dist_flight_events"]:
+                failures.append("no serving.ingest.dist.* events in "
+                                "the flight recorder — the routed "
+                                "write path left no trail")
+            if not rrec["health_flight_events"]:
+                failures.append("no distributed.health.* events in the "
+                                "flight recorder — the failover arc "
+                                "left no trail")
     for msg in failures:
         print(f"INGEST SMOKE FAIL: {msg}", flush=True)
     if failures:

@@ -477,6 +477,31 @@ def export_ivf_pq_routed_search(res, index, shard: int, n_probes: int,
     return buf
 
 
+def warm_write_router(index, batches: Sequence[int]) -> int:
+    """Pre-trace the distributed WRITE router (round 19) at the serving
+    write-batch shapes: one jitted ``_select_clusters`` call per batch
+    size with ``n_probes=1`` against the replicated coarse quantizer —
+    exactly what :func:`raft_tpu.distributed.ann.route_vectors` runs per
+    upsert/delete.  Called from the routed ingest tier's ``prewarm`` so
+    the first write after a deploy (or the first re-routed write after a
+    failover) hits a warm executable; routing tables are data, so
+    placement changes never invalidate these traces.  Returns the number
+    of shapes warmed."""
+    from raft_tpu.distance.types import DistanceType
+    from raft_tpu.neighbors import ivf_pq
+
+    warmed = 0
+    for b in sorted({int(b) for b in batches if int(b) > 0}):
+        zeros = jax.numpy.zeros((b, index.dim),
+                                index.coarse_centers.dtype)
+        out = ivf_pq._select_clusters(index.coarse_centers,
+                                      index.rotation, zeros, 1,
+                                      DistanceType(index.metric))
+        jax.block_until_ready(out)
+        warmed += 1
+    return warmed
+
+
 def export_ivf_flat_search(res, index, n_probes: int, k: int,
                            batch: int) -> io.BytesIO:
     """Export the IVF-Flat search at fixed (batch, k, n_probes): raw

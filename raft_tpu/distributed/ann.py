@@ -1589,6 +1589,24 @@ def _gather_global(index: RoutedIndex):
     return centers, recon, rsq, li, sizes, code_leaves
 
 
+def route_vectors(index: RoutedIndex, vectors) -> np.ndarray:
+    """The distributed WRITE path's list router (round 19): the global
+    IVF list each row lands in, ranked by the SAME replicated coarse
+    quantizer the probe path uses — a row's home list is its top probe
+    (``n_probes=1``), so a written row is found by exactly the probes
+    that would scan it after a fold.  One jitted call keyed by the
+    write-batch shape; :func:`raft_tpu.core.aot.warm_write_router`
+    pre-traces the serving batch shapes so the first write after a
+    deploy or failover is compile-free."""
+    vecs = jnp.asarray(vectors, jnp.float32)
+    expects(vecs.ndim == 2 and vecs.shape[1] == index.dim,
+            f"distributed.ann.route_vectors: vectors must be "
+            f"(n, {index.dim}), got {tuple(vecs.shape)}")
+    probes = ivf_pq._select_clusters(index.coarse_centers, index.rotation,
+                                     vecs, 1, DistanceType(index.metric))
+    return np.asarray(probes).reshape(-1)
+
+
 @functools.partial(jax.jit, static_argnames=("k", "n_probes", "metric",
                                              "axis_name", "mesh", "failed"))
 def _dist_search_routed(sharded, replicated, queries, k, n_probes, metric,

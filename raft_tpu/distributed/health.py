@@ -143,6 +143,15 @@ class HealthTracker:
             obs.registry().counter("integrity.canary_failure").inc()
         self._strike(shard, "canary", weight=self.config.suspect_after)
 
+    def note_write_error(self, shard: int) -> None:
+        """Hard evidence from the distributed write path (round 19):
+        ``shard`` failed to make an appended WAL record durable (fsync
+        error).  A shard that cannot persist writes cannot count toward
+        a write quorum, so this strikes like a timeout — enough to
+        suspect a healthy shard at once; repeated errors fail it and
+        the ack planner re-plans quorums onto the surviving replicas."""
+        self._strike(shard, "write", weight=self.config.suspect_after)
+
     def note_overload(self, shard: int, load: float) -> None:
         """Soft evidence from the routing policy: ``shard``'s planned
         probe load runs at ``load``× the mesh mean.  Folds the excess
@@ -342,7 +351,7 @@ class HealthTracker:
 
 def catch_up(handle, index, shard: int, *,
              tracker: Optional[HealthTracker] = None,
-             stale=None):
+             stale=None, ingest=None):
     """Anti-entropy catch-up for recovering ``shard``: rebuild its
     leaves from the live index (whose replicas hold every list the
     shard owned — the generation-delta replay source, the same
@@ -355,7 +364,15 @@ def catch_up(handle, index, shard: int, *,
     ``stale`` (the index snapshot the shard went down holding, when the
     caller retained one) only feeds the ``generation_delta`` attribute
     on the ``distributed.health.catch_up`` event — how far behind the
-    shard was."""
+    shard was.
+
+    ``ingest`` (a :class:`raft_tpu.serving.dist_ingest.RoutedIngest`,
+    round 19) adds the WAL **delta phase**: before the leaves are
+    re-placed, the recovering shard's per-shard WAL + memtable are
+    rebuilt by replaying the records it missed from the live replicas'
+    logs (``RoutedIngest.catch_up_shard`` — site
+    ``ingest.dist.catch_up``), so the readmitted shard's delta tier is
+    whole, not just its folded leaves."""
     from raft_tpu.distributed import ann
     expects(index.placement is not None,
             "health.catch_up: index carries no placement map")
@@ -365,6 +382,12 @@ def catch_up(handle, index, shard: int, *,
         _mutate.generation(index))
     if tracker is not None:
         tracker.begin_catch_up(shard, generation_delta=delta)
+    if ingest is not None:
+        # the WAL delta phase runs while the shard is CATCHING_UP (out
+        # of the routing), BEFORE the placement re-bump: live replicas'
+        # logs are the authoritative record of every acked write the
+        # shard missed
+        ingest.catch_up_shard(shard)
     placement = dataclasses.replace(
         index.placement, generation=index.placement.generation + 1)
     # one generation bump: rebalance_placement gathers the live global

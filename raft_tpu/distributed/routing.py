@@ -350,6 +350,46 @@ class RoutingPolicy:
             }
         return eff_owner, eff_slot
 
+    def ack_plan(self, placement, down: Sequence[int] = (), *,
+                 lists: Optional[Sequence[int]] = None
+                 ) -> Dict[int, List[int]]:
+        """Write-side companion of :meth:`plan` (round 19, distributed
+        ingest): for each global list, the ORDERED live owner shards the
+        write path appends to and gates the ack on.
+
+        Every live owner still receives the record (replication is not
+        optional); the ORDER decides which owner is the list's *ack
+        leader* (first entry — classified as the ``ingest.dist.append``
+        site; the rest are ``ingest.dist.replicate``) and, under a
+        partial quorum ``w < r``, which owners' durability the ack
+        prefers to wait on.  Ordering is replica-rank order re-ranked by
+        the live load score (least-loaded first, shard id as the tie
+        break), so a write-heavy shard sheds ack-leadership the same way
+        the read plan sheds probes.  Shards in ``down`` are excluded
+        entirely — a FAILED shard has no write eligibility; a list with
+        an empty entry has lost ALL its replicas and the caller must
+        refuse the write with a typed ``Unavailable``.
+
+        ``lists`` restricts the plan to the touched lists (the write
+        batch's routed home lists) — the per-write cost is then
+        O(touched x r), never O(n_lists)."""
+        owners, _ = placement.rank_tables()
+        r, n_lists = owners.shape
+        expects(placement.n_shards == self.n_shards,
+                f"routing: policy sized for {self.n_shards} shards, "
+                f"placement has {placement.n_shards}")
+        downset = {int(s) for s in down}
+        scores = self.shard_scores()
+        targets = (range(n_lists) if lists is None
+                   else [int(g) for g in lists])
+        out: Dict[int, List[int]] = {}
+        for g in targets:
+            live = [int(owners[j, g]) for j in range(r)
+                    if int(owners[j, g]) not in downset]
+            live.sort(key=lambda s: (float(scores[s]), s))
+            out[int(g)] = live
+        return out
+
     def choice_summary(self) -> Dict[str, object]:
         """The last plan's decision record — chosen per-rank/per-shard
         list counts plus the scores they were chosen against (the

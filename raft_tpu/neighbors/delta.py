@@ -156,6 +156,72 @@ def merge_with_main(main_d, main_i, queries, data, ids, tombs, *,
                             k=int(k), metric=DistanceType(metric))
 
 
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def _merge_with_main_multi(main_d, main_i, queries, datas, idss, tombs,
+                           k: int, metric):
+    """Multi-shard delta merge (round 19, distributed ingest): every
+    per-shard memtable joins the :func:`grouped.finalize_topk` merge as
+    one more shard.  Two things the single-delta merge never needed:
+
+    - the tombstone mask over the MAIN index is the UNION of every
+      shard's tombstone set (deletes broadcast to all live shards, so
+      any live memtable may carry the only copy of a tombstone);
+    - replicated placement stores each row on ``r`` shards, so the same
+      id can surface from up to ``r`` deltas — duplicates are masked to
+      the worst/-1 sentinel before the final select, keeping exactly one
+      candidate per id (best distance, earliest position on ties; live
+      copies are bit-identical replicas, so any survivor is correct).
+
+    The shard tuples are pytree inputs: a down shard is passed as a
+    masked view (ids/tombs all -1) with the SAME shapes, so shard
+    membership is data, not shape — zero recompiles across failover."""
+    nq = main_d.shape[0]
+    select_min = metric != DistanceType.InnerProduct
+    worst = jnp.inf if select_min else -jnp.inf
+    all_tombs = jnp.concatenate([t.reshape(-1) for t in tombs])
+    hit = (main_i >= 0) & jnp.isin(main_i, all_tombs)
+    ds = [jnp.where(hit, worst, main_d)]
+    is_ = [jnp.where(hit, -1, main_i)]
+    for data, ids in zip(datas, idss):
+        dd, di = _scan_body(data, ids, queries, k, metric)
+        ds.append(dd)
+        is_.append(di)
+    alld = jnp.concatenate(ds, axis=1)
+    alli = jnp.concatenate(is_, axis=1)
+    # replica dedup over the (nq, C) candidate strip: candidate j is
+    # dropped when some j' holds the same id with a better distance (or
+    # an equal distance at an earlier position).  C = k*(n_shards+2) is
+    # small, so the O(C^2) mask is a few comparisons per query.
+    pos = jnp.arange(alld.shape[1])
+    same = (alli[:, :, None] == alli[:, None, :]) & (alli[:, :, None] >= 0)
+    if select_min:
+        beats = alld[:, None, :] < alld[:, :, None]
+    else:
+        beats = alld[:, None, :] > alld[:, :, None]
+    beats = beats | ((alld[:, None, :] == alld[:, :, None])
+                     & (pos[None, None, :] < pos[None, :, None]))
+    dup = jnp.any(same & beats, axis=-1)
+    alld = jnp.where(dup, worst, alld)
+    alli = jnp.where(dup, -1, alli)
+    return grouped.finalize_topk(alld, alli, nq, k, select_min,
+                                 sqrt=False, select_k_fn=select_k)
+
+
+def merge_with_main_multi(main_d, main_i, queries, deltas, tombs, *,
+                          k: int, metric
+                          ) -> Tuple[jax.Array, jax.Array]:
+    """Merge the main-index top-k with EVERY shard memtable's delta scan
+    (``deltas`` is a sequence of ``(data, ids)`` device views, ``tombs``
+    the matching tombstone arrays), deduplicating replicated rows and
+    masking the union of tombstones — see :func:`_merge_with_main_multi`.
+    """
+    datas = tuple(d for d, _ in deltas)
+    idss = tuple(i for _, i in deltas)
+    return _merge_with_main_multi(main_d, main_i, queries, datas, idss,
+                                  tuple(tombs), k=int(k),
+                                  metric=DistanceType(metric))
+
+
 class Memtable:
     """Host-canonical mutable row store with a shape-static device view.
 
@@ -350,3 +416,18 @@ class Memtable:
             live_rows = self._data[live].astype(np.float32)
             tomb_ids = np.array(sorted(self._tombs), np.int32)
             return live_ids, live_rows, tomb_ids
+
+    def fold_items(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                  np.ndarray]:
+        """:meth:`fold_payload` plus the per-row LSNs:
+        ``(live_ids, live_rows, live_lsns, tomb_ids)``.  The distributed
+        fold unions payloads across shard memtables and needs the LSN to
+        break duplicate-id collisions deterministically (replicated
+        copies share an LSN; keep-max-LSN keeps the newest write when a
+        partial-quorum history left copies at different LSNs)."""
+        with self._lock:
+            live = np.nonzero(self._ids[:self._n_used] >= 0)[0]
+            return (self._ids[live].astype(np.int64),
+                    self._data[live].astype(np.float32),
+                    self._slot_lsn[live].astype(np.int64),
+                    np.array(sorted(self._tombs), np.int64))

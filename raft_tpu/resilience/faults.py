@@ -32,6 +32,17 @@ site                                    where it fires
 ``serialize.read``                      :mod:`raft_tpu.core.serialize`
 ``checkpoint.save`` /                   every :class:`CheckpointManager`
 ``checkpoint.load``                     stage persisted / restored
+``ingest.<step>``                       single-writer ingest tier
+                                        (``append``, ``fsync``, ``apply``,
+                                        ``fold``, ``truncate``) — see
+                                        :mod:`raft_tpu.serving.ingest`
+``ingest.dist.<step>``                  routed replicated ingest tier
+                                        (``route``, ``append``, ``ack``,
+                                        ``replicate``, ``fold``,
+                                        ``catch_up``) — see
+                                        :mod:`raft_tpu.serving.dist_ingest`;
+                                        ``kill_shard_at`` here is the write
+                                        -path kill matrix
 ======================================  ====================================
 
 Scripting is explicit and deterministic::

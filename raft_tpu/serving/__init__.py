@@ -42,7 +42,13 @@ RAFT-class deployment interposes between users and the GPU/TPU):
   main index (the delta-as-extra-shard ``finalize_topk`` merge), and
   periodically folds the memtable into the main index as a
   checkpointed, gated compaction; ``recover()`` replays the WAL to
-  bit-identical state after a kill at any boundary.
+  bit-identical state after a kill at any boundary;
+- :mod:`~raft_tpu.serving.dist_ingest` — the replicated durable write
+  path over the routed distributed index: owner-routed writes through
+  the replicated coarse quantizer, per-shard CRC-framed WALs with a
+  write-quorum ack, typed :class:`Unavailable` refusal when a list
+  loses every replica, WAL delta catch-up for recovering shards, and
+  an all-memtable fold under one placement-generation bump.
 
 Quick tour::
 
@@ -78,6 +84,11 @@ from raft_tpu.serving.executor import (  # noqa: F401
     DistributedExecutor,
     Executor,
 )
+from raft_tpu.serving.dist_ingest import (  # noqa: F401
+    DistIngestConfig,
+    RoutedIngest,
+    Unavailable,
+)
 from raft_tpu.serving.ingest import (  # noqa: F401
     IngestConfig,
     IngestServer,
@@ -101,6 +112,7 @@ __all__ = [
     "BrownoutConfig",
     "BrownoutController",
     "BrownoutState",
+    "DistIngestConfig",
     "DistributedExecutor",
     "DynamicBatcher",
     "Executor",
@@ -113,8 +125,10 @@ __all__ = [
     "Rebalancer",
     "rebalance_routed",
     "Request",
+    "RoutedIngest",
     "Server",
     "ServerConfig",
+    "Unavailable",
     "ShadowConfig",
     "ShadowMonitor",
     "TokenBucket",

@@ -1,0 +1,703 @@
+"""Replicated durable ingest over the routed distributed index.
+
+The PR 13 ingest tier is single-writer: ONE WAL, ONE memtable, folded
+into ONE index.  This module (round 19) extends it onto the replicated
+routed placement: ``write()`` routes each upsert to its home IVF list
+through the SAME replicated coarse quantizer the probe path uses
+(:func:`raft_tpu.distributed.ann.route_vectors` — a written row is
+found by exactly the probes that would scan it after a fold), every
+owning replica appends to its OWN per-shard CRC-framed WAL + memtable,
+and the ack gates on a **write quorum** ``w`` (default ``w = r``, the
+replication factor; ``w < r`` is permitted — the id<0 mask seam plus
+the k-bounded merge guarantee reads still see every acked row from any
+single live replica).
+
+Layout (per shard ``s``)::
+
+    <wal_dir>/shard-<s>/wal.log    # that shard's framed record stream
+    <wal_dir>/fold/                # ONE CheckpointManager for the fold
+
+**The two-LSN broadcast-tombstone scheme.**  Routing is by VECTOR, so
+re-upserting an id whose embedding moved may route it to a DIFFERENT
+list — and a different owner set — leaving stale live copies of the id
+on the old owners, invisible to the new ones.  Every upsert therefore
+consumes two global LSNs: ``base+1`` is an ``OP_DELETE`` record
+carrying the WHOLE batch's ids, broadcast to EVERY live shard (it
+tombstones any stale copy anywhere and masks the main index through
+the union-tombstone merge), and ``base+2`` is the ``OP_UPSERT`` record
+each owner receives with its owned row subset.  Both records share one
+per-shard fsync, the returned ack LSN is the upsert's, and the
+memtable's lsn-idempotence still holds (one record per LSN per shard).
+Deletes are the degenerate case: one LSN, broadcast everywhere.
+
+**Write ownership follows the health lifecycle.**  A FAILED (or
+CATCHING_UP) shard has no write eligibility: the ack planner
+(:meth:`raft_tpu.distributed.routing.RoutingPolicy.ack_plan`) re-plans
+acks onto the surviving replicas with zero recompiles (routing tables
+are data, not shape).  A write whose touched lists have lost ALL their
+replicas refuses with a typed :class:`Unavailable` — before a single
+WAL byte — instead of silently dropping.  A per-shard fsync failure
+strikes the shard (``HealthTracker.note_write_error``) and fails the
+ack only when it leaves some touched list under quorum.
+
+**Catch-up delta phase.**  A recovering shard's WAL + memtable are
+rebuilt from the live replicas' logs (:meth:`RoutedIngest.catch_up_shard`,
+invoked by :func:`raft_tpu.distributed.health.catch_up` while the
+shard is CATCHING_UP): records are merged ACROSS source WALs by global
+LSN (row subsets union per LSN), upsert rows are re-routed and
+filtered to the lists the shard owns at any rank, deletes are kept
+whole (they were broadcast), and the rebuilt log is fsync'd before the
+canary-gated readmission.
+
+**Fold.**  :meth:`RoutedIngest.fold` drains ALL shard memtables under
+ONE placement-generation bump: the per-shard fold payloads are unioned
+with keep-max-LSN duplicate-id resolution, applied to the single-node
+base index as the delete+extend upsert pattern (one index generation
+bump), verified + canary-gated, committed to the checkpoint (the
+crash-window discipline of the PR 13 fold: before the marker rolls
+back, after it rolls forward), re-sharded under the NEXT placement
+generation, published, and only then are the per-shard WALs truncated
+and memtables reset.
+
+Fault sites: ``ingest.dist.{route,append,ack,replicate,fold,catch_up}``
+(plus the per-shard WALs' inherited ``ingest.{append,fsync,truncate}``)
+— the kill matrix injects ``FaultPlan.kill_shard_at`` at every one and
+asserts zero acked-row loss and bit-identical post-recovery search at
+r=2.  Counters: ``serving.ingest.dist.{appended,acked,replayed,folds}``;
+events: ``serving.ingest.dist.{unavailable,write_error,replay,
+catch_up,fold}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu import observability as obs
+from raft_tpu.core.error import RaftError, expects
+from raft_tpu.core.serialize import CorruptIndexError
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.integrity import canary as _canary
+from raft_tpu.integrity.verify import verify as _verify_index
+from raft_tpu.neighbors import delta as _delta
+from raft_tpu.neighbors import ivf_pq
+from raft_tpu.neighbors import mutate as _mutate
+from raft_tpu.observability import flight as _flight
+from raft_tpu.resilience import faults
+from raft_tpu.resilience.checkpoint import CheckpointManager
+from raft_tpu.serving.ingest import (
+    _FOLD_STAGE,
+    _OPS,
+    _WAL_FILE,
+    WriteAheadLog,
+    _id_span,
+    encode_record,
+    scan_wal,
+)
+
+_FOLD_DIR = "fold"
+
+
+def _count(name: str) -> None:
+    if obs.enabled():
+        obs.registry().counter(name).inc()
+
+
+def _gauge(name: str, value: float) -> None:
+    if obs.enabled():
+        obs.registry().gauge(name).set(value)
+
+
+class Unavailable(RaftError):
+    """A write's routed lists have lost ALL their replicas (or no shard
+    is live at all): the write is REFUSED — typed, before any WAL byte
+    — never silently dropped.  Retry after a catch-up readmits a
+    replica."""
+
+
+@dataclasses.dataclass
+class DistIngestConfig:
+    """Distributed write-path knobs (docs/api.md "Distributed ingest &
+    write quorum").
+
+    ``write_quorum`` is ``w`` — how many owning replicas must be
+    fsync-durable before a write acks (0 means ``w = r``, full
+    replication).  ``w < r`` trades re-replication debt for ack
+    latency; reads stay correct from any single acked replica (id<0
+    mask seam + k-bounded merge).  The remaining knobs mirror
+    :class:`~raft_tpu.serving.ingest.IngestConfig` per shard."""
+
+    wal_dir: str = "dist-ingest-wal"
+    write_quorum: int = 0
+    memtable_capacity: int = 1024
+    tomb_capacity: int = 1024
+    max_memtable_rows: int = 8192
+    fold_rows: int = 0
+    fold_tombstones: int = 0
+    verify_level: str = "statistical"
+
+
+class RoutedIngest:
+    """The durable replicated write path over one
+    :class:`~raft_tpu.distributed.ann.RoutedIndex` plus its single-node
+    base index (the fold substrate — the routed pytree is re-sharded
+    from it under each placement-generation bump).
+
+    ``tracker`` (a :class:`~raft_tpu.distributed.health.HealthTracker`)
+    makes write eligibility follow the shard lifecycle; ``policy`` (a
+    :class:`~raft_tpu.distributed.routing.RoutingPolicy`) load-orders
+    the ack plan.  Both optional: without them, down shards come from
+    the active fault plan alone and acks follow replica-rank order.
+    Call :meth:`recover` before the first :meth:`write`."""
+
+    def __init__(self, handle, routed, base, *,
+                 config: Optional[DistIngestConfig] = None,
+                 tracker=None, policy=None,
+                 clock=time.monotonic) -> None:
+        from raft_tpu.distributed import ann as _dann
+        expects(isinstance(routed, _dann.RoutedIndex)
+                and routed.placement is not None,
+                "dist_ingest: RoutedIngest needs a RoutedIndex with a "
+                "placement map (placement='by_list')")
+        self.handle = handle
+        self.config = config or DistIngestConfig()
+        self.tracker = tracker
+        self.policy = policy
+        self._clock = clock
+        self._index = routed
+        self._base = base
+        self.n_shards = int(routed.n_shards)
+        self.dim = int(routed.dim)
+        self.metric = DistanceType(routed.metric)
+        self.memtables = [
+            _delta.Memtable(self.dim,
+                            capacity=self.config.memtable_capacity,
+                            tomb_capacity=self.config.tomb_capacity,
+                            metric=self.metric)
+            for _ in range(self.n_shards)]
+        for s in range(self.n_shards):
+            os.makedirs(self._shard_dir(s), exist_ok=True)
+        self._ck = CheckpointManager(
+            os.path.join(self.config.wal_dir, _FOLD_DIR))
+        self._wals: List[Optional[WriteAheadLog]] = [None] * self.n_shards
+        self._server = None
+        self._lsn = 0
+        self._lock = threading.Lock()        # append order + routing
+        self._fold_lock = threading.Lock()
+        self._recovered = False
+
+    # ---- wiring ----------------------------------------------------------
+
+    def _shard_dir(self, s: int) -> str:
+        return os.path.join(self.config.wal_dir, f"shard-{s}")
+
+    def wal_path(self, s: int) -> str:
+        return os.path.join(self._shard_dir(s), _WAL_FILE)
+
+    def bind(self, server) -> None:
+        """Attach a publish target for fold generations (``Server``-like:
+        anything with ``swap_index``).  Unlike the single-writer tier
+        there is no delta-seam attach — the distributed read path merges
+        every shard memtable through :meth:`search`."""
+        self._server = server
+
+    def swap_index(self, routed) -> None:
+        """Install a new routed generation (the readmission publish
+        path: :func:`raft_tpu.distributed.health.readmit` hands the
+        caught-up index here or to a bound server)."""
+        self._index = routed
+        if self._server is not None:
+            self._server.swap_index(routed)
+
+    @property
+    def index(self):
+        return self._index
+
+    def _down(self) -> Tuple[int, ...]:
+        down = set(faults.failed_shards(self.n_shards))
+        if self.tracker is not None:
+            down |= set(self.tracker.failed_shards())
+        return tuple(sorted(down))
+
+    def _open_wal(self, s: int) -> WriteAheadLog:
+        if self._wals[s] is None:
+            self._wals[s] = WriteAheadLog(self.wal_path(s))
+        return self._wals[s]
+
+    # ---- recovery --------------------------------------------------------
+
+    def recover(self, base=None, routed=None):
+        """Roll an interrupted fold forward/back, then per shard: repair
+        a torn WAL tail and replay the intact records into that shard's
+        memtable.  Returns the routed index to serve.  Idempotent; must
+        run before the first :meth:`write`.
+
+        Roll-FORWARD (commit marker present): the checkpointed fold
+        candidate (base index + placement) is re-sharded and served, and
+        the interrupted per-shard truncations complete.  Roll-BACK
+        (fold died before its marker): the base index is untouched and
+        the full per-shard replay reproduces every logged record."""
+        from raft_tpu.distributed import ann as _dann
+        if base is not None:
+            self._base = base
+        if routed is not None:
+            self._index = routed
+        rolled_forward = False
+        if self._ck.has(_FOLD_STAGE):
+            try:
+                cand, placement, fold_lsn = self._load_fold()
+                self._base = cand
+                self._index = _dann.shard_by_list(self.handle, cand,
+                                                  placement=placement)
+                for s in range(self.n_shards):
+                    self._open_wal(s).truncate_all()
+                    self.memtables[s].reset()
+                self._ck.clear()
+                rolled_forward = True
+                _flight.record_event("serving.ingest.dist.replay",
+                                     rolled_forward=True,
+                                     fold_lsn=fold_lsn,
+                                     generation=_mutate.generation(cand))
+            except CorruptIndexError:
+                self._ck.clear()
+        elif self._ck.completed:
+            self._ck.clear()
+        if not rolled_forward:
+            last_lsn = 0
+            total = 0
+            dropped = 0
+            for s in range(self.n_shards):
+                wal = self._open_wal(s)
+                records, good_end = scan_wal(wal.read_bytes())
+                dropped += wal.repair_tail(good_end)
+                for rec in records:
+                    if self.memtables[s].apply(rec):
+                        total += 1
+                        _count("serving.ingest.dist.replayed")
+                last_lsn = max(last_lsn,
+                               max((r.lsn for r in records), default=0))
+            self._lsn = max(self._lsn, last_lsn)
+            if total or dropped:
+                _flight.record_event("serving.ingest.dist.replay",
+                                     rolled_forward=False, records=total,
+                                     truncated_bytes=dropped,
+                                     last_lsn=self._lsn)
+        self._recovered = True
+        return self._index
+
+    # ---- the write path --------------------------------------------------
+
+    def write(self, ids, vectors=None, *, op: str = "upsert",
+              tenant: str = "default") -> int:
+        """Route one upsert/delete batch to its list owners, append to
+        every live owning replica's WAL (upserts ride the two-LSN
+        broadcast-tombstone scheme — see the module docstring), fsync
+        per shard, and ack once the write quorum ``w`` holds for every
+        touched list.  Returns the batch's ack LSN.
+
+        A raised exception means NOT acknowledged — the records may be
+        durable on some replicas and the caller must retry (idempotent
+        by id and LSN).  :class:`Unavailable` means some touched list
+        has NO live replica: nothing was appended anywhere."""
+        expects(self._recovered,
+                "dist_ingest: recover() must run before the first write")
+        opcode = _OPS.get(op)
+        expects(opcode is not None,
+                f"dist_ingest: op must be 'upsert' or 'delete', got {op!r}")
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        expects(ids.size > 0, "dist_ingest: write needs at least one id")
+        expects(int(ids.min()) >= 0,
+                "dist_ingest: source ids must be >= 0")
+        if opcode == _delta.OP_UPSERT:
+            vecs = np.ascontiguousarray(vectors, np.float32)
+            if vecs.ndim == 1:
+                vecs = vecs[None, :]
+            expects(vecs.shape == (ids.size, self.dim),
+                    f"dist_ingest: vectors must be ({ids.size}, "
+                    f"{self.dim}), got {vecs.shape}")
+        else:
+            expects(vectors is None, "dist_ingest: delete takes no vectors")
+            vecs = None
+        with self._lock:
+            return self._write_locked(opcode, ids, vecs)
+
+    def _write_locked(self, opcode: int, ids: np.ndarray,
+                      vecs: Optional[np.ndarray]) -> int:
+        from raft_tpu.distributed import ann as _dann
+        # lifecycle-boundary kill site: a shard killed HERE is seen by
+        # the NEXT write's down-set; this write keeps pre-kill routing
+        # (the documented kill_shard_at membership semantics)
+        faults.maybe_fail("ingest.dist.route")
+        down = self._down()
+        downset = set(down)
+        live = [s for s in range(self.n_shards) if s not in downset]
+        placement = self._index.placement
+        if opcode == _delta.OP_UPSERT:
+            lists = _dann.route_vectors(self._index, vecs)
+            touched = sorted({int(g) for g in lists})
+            plan = self._ack_plan(placement, down, touched)
+            lost = [g for g in touched if not plan[g]]
+        else:
+            lists = None
+            touched = []
+            plan = {}
+            lost = [] if live else [-1]
+        if lost:
+            _count("serving.ingest.dist.unavailable")
+            _flight.record_event("serving.ingest.dist.unavailable",
+                                 lists=[int(g) for g in lost],
+                                 rows=int(ids.size), down=list(down))
+            raise Unavailable(
+                f"dist_ingest: lists {lost} have no live replica "
+                f"(down shards {list(down)}) — the write is refused, "
+                f"not dropped; retry after a replica is readmitted")
+        r = placement.replication_factor
+        w = min(self.config.write_quorum or r, r)
+        # leaders: the first live owner of each touched list (deletes:
+        # the lowest live shard) — their appends classify as the
+        # ``ingest.dist.append`` site, every other live shard's as
+        # ``ingest.dist.replicate``
+        leaders = ({plan[g][0] for g in touched} if touched
+                   else {live[0]})
+        base = self._lsn
+        tomb_rec = encode_record(base + 1, _delta.OP_DELETE, ids, None)
+        tomb = _delta.Record(lsn=base + 1, op=_delta.OP_DELETE, ids=ids)
+        up_recs: Dict[int, Tuple[bytes, _delta.Record]] = {}
+        if opcode == _delta.OP_UPSERT:
+            owners_of: Dict[int, List[int]] = {}
+            for g in touched:
+                for s in plan[g]:
+                    owners_of.setdefault(s, []).append(g)
+            for s, gs in owners_of.items():
+                mask = np.isin(lists, gs)
+                sub_ids = ids[mask]
+                sub_vecs = vecs[mask]
+                up_recs[s] = (
+                    encode_record(base + 2, _delta.OP_UPSERT, sub_ids,
+                                  sub_vecs),
+                    _delta.Record(lsn=base + 2, op=_delta.OP_UPSERT,
+                                  ids=sub_ids, vectors=sub_vecs))
+            ack_lsn = base + 2
+        else:
+            ack_lsn = base + 1
+        self._lsn = ack_lsn
+        synced: set = set()
+        first_err: Optional[BaseException] = None
+        for s in live:
+            try:
+                # literal site per branch: the leader's append is the
+                # ``ingest.dist.append`` boundary, every other replica's
+                # the ``ingest.dist.replicate`` one
+                if s in leaders:
+                    faults.maybe_fail("ingest.dist.append")
+                else:
+                    faults.maybe_fail("ingest.dist.replicate")
+                wal = self._open_wal(s)
+                wal.append(tomb_rec)
+                if s in up_recs:
+                    wal.append(up_recs[s][0])
+                # ONE fsync covers both records — the tombstone and its
+                # upsert half are atomically durable together
+                wal.sync()
+                synced.add(s)
+                _count("serving.ingest.dist.appended")
+            except Exception as exc:      # noqa: BLE001 — per-shard fault
+                if first_err is None:
+                    first_err = exc
+                _count("serving.ingest.dist.write_error")
+                _flight.record_event("serving.ingest.dist.write_error",
+                                     shard=int(s), lsn=ack_lsn,
+                                     error=type(exc).__name__)
+                if self.tracker is not None:
+                    self.tracker.note_write_error(s)
+                continue
+            # searchable on the durable replicas (memtable order == WAL
+            # order per shard; visibility decoupled from the quorum ack,
+            # same as the single-writer tier)
+            self.memtables[s].apply(tomb)
+            if s in up_recs:
+                self.memtables[s].apply(up_recs[s][1])
+        faults.maybe_fail("ingest.dist.ack")
+        if opcode == _delta.OP_UPSERT:
+            short = [g for g in touched
+                     if len([s for s in plan[g] if s in synced])
+                     < min(w, len(plan[g]))]
+        else:
+            short = [] if len(synced) >= min(w, len(live)) else [-1]
+        if short:
+            if first_err is not None:
+                raise first_err
+            raise Unavailable(
+                f"dist_ingest: write quorum w={w} not met for lists "
+                f"{short} — the batch is NOT acknowledged; retry")
+        _count("serving.ingest.dist.acked")
+        _gauge("serving.ingest.dist.last_lsn", ack_lsn)
+        return ack_lsn
+
+    def _ack_plan(self, placement, down: Sequence[int],
+                  lists: Sequence[int]) -> Dict[int, List[int]]:
+        if self.policy is not None:
+            return self.policy.ack_plan(placement, down, lists=lists)
+        owners, _ = placement.rank_tables()
+        downset = {int(s) for s in down}
+        return {int(g): [int(owners[j, g]) for j in range(owners.shape[0])
+                         if int(owners[j, g]) not in downset]
+                for g in lists}
+
+    # ---- the read path ---------------------------------------------------
+
+    def search(self, params, queries, k: int, **kwargs):
+        """Routed search merged with EVERY shard memtable's delta scan
+        (:func:`raft_tpu.neighbors.delta.merge_with_main_multi`).  Down
+        shards join as MASKED views (ids/tombs all -1) with identical
+        shapes, so shard membership stays data, not shape — zero
+        recompiles across failover; the k-bounded merge pulls every
+        acked row from whichever live replica holds it."""
+        from raft_tpu.distributed import ann as _dann
+        from raft_tpu.integrity import boundary as _boundary
+        queries = np.asarray(queries, np.float32)
+        if queries.ndim == 1:
+            queries = queries[None, :]
+        queries, _ok = _boundary.check_matrix(
+            queries, "queries", site="serving.ingest.dist.search",
+            dim=self.dim, allow_empty=False, host=True)
+        q = jnp.asarray(queries)
+        d, i = _dann.search(self.handle, params, self._index, q, int(k),
+                            health=self.tracker, routing=self.policy,
+                            **kwargs)
+        downset = set(self._down())
+        deltas = []
+        tombs = []
+        for s in range(self.n_shards):
+            data, mids, tb = self.memtables[s].device_view()
+            if s in downset:
+                mids = jnp.full_like(mids, -1)
+                tb = jnp.full_like(tb, -1)
+            deltas.append((data, mids))
+            tombs.append(tb)
+        return _delta.merge_with_main_multi(d, i, q, deltas, tombs,
+                                            k=int(k), metric=self.metric)
+
+    # ---- catch-up delta phase --------------------------------------------
+
+    def catch_up_shard(self, shard: int) -> int:
+        """Rebuild ``shard``'s WAL + memtable from the live replicas'
+        logs — the delta phase of
+        :func:`raft_tpu.distributed.health.catch_up`, run while the
+        shard is CATCHING_UP (out of the routing).  Records are merged
+        across the source WALs by global LSN (row subsets union per
+        LSN), upsert rows are re-routed and kept only when their home
+        list is owned by ``shard`` at ANY replica rank, deletes are
+        kept whole (they were broadcast).  Returns the number of
+        records the rebuilt shard holds."""
+        from raft_tpu.distributed import ann as _dann
+        s = int(shard)
+        expects(0 <= s < self.n_shards,
+                f"dist_ingest: shard {s} out of range")
+        with self._lock:
+            faults.maybe_fail("ingest.dist.catch_up")
+            downset = set(self._down()) | {s}
+            sources = [j for j in range(self.n_shards) if j not in downset]
+            expects(bool(sources),
+                    "dist_ingest: catch-up needs at least one live "
+                    "replica to replay from")
+            # merge by LSN across sources: replicated copies of a record
+            # share an LSN; partial-quorum histories leave different
+            # subsets per source, so rows UNION per LSN
+            ops: Dict[int, int] = {}
+            rows: Dict[int, Dict[int, Optional[np.ndarray]]] = {}
+            for j in sources:
+                data = self._open_wal(j).read_bytes()
+                records, _good_end = scan_wal(data)
+                for rec in records:
+                    ops[rec.lsn] = rec.op
+                    bucket = rows.setdefault(rec.lsn, {})
+                    for t, i in enumerate(rec.ids):
+                        bucket[int(i)] = (rec.vectors[t]
+                                          if rec.vectors is not None
+                                          else None)
+            owned = {int(g) for g in
+                     self._index.placement.shard_lists(s)}
+            wal = self._open_wal(s)
+            wal.truncate_all()
+            self.memtables[s].reset()
+            kept = 0
+            for lsn in sorted(ops):
+                op = ops[lsn]
+                rids = np.array(sorted(rows[lsn]), np.int64)
+                if op == _delta.OP_UPSERT:
+                    vecs = np.stack([rows[lsn][int(i)] for i in rids]
+                                    ) if rids.size else np.zeros(
+                                        (0, self.dim), np.float32)
+                    home = (_dann.route_vectors(self._index, vecs)
+                            if rids.size else np.zeros(0, np.int64))
+                    keep = np.array([g in owned for g in home], bool)
+                    rids, vecs = rids[keep], vecs[keep]
+                    if not rids.size:
+                        continue
+                else:
+                    vecs = None
+                rec = _delta.Record(lsn=lsn, op=op, ids=rids, vectors=vecs)
+                wal.append(encode_record(lsn, op, rids, vecs))
+                self.memtables[s].apply(rec)
+                kept += 1
+            wal.sync()
+            _flight.record_event("serving.ingest.dist.catch_up",
+                                 shard=s, records=kept,
+                                 sources=len(sources),
+                                 rows=self.memtables[s].live_rows)
+            return kept
+
+    # ---- fold ------------------------------------------------------------
+
+    def maybe_fold(self):
+        """Fold when the summed memtable rows / tombstones cross the
+        configured thresholds (the maintenance-pass hook); returns the
+        new routed index or None."""
+        rows = sum(m.live_rows for m in self.memtables)
+        tombs = sum(m.n_tombstones for m in self.memtables)
+        cfg = self.config
+        if ((cfg.fold_rows and rows >= cfg.fold_rows)
+                or (cfg.fold_tombstones and tombs >= cfg.fold_tombstones)):
+            return self.fold()
+        return None
+
+    def fold(self):
+        """Drain ALL shard memtables into the base index under ONE
+        placement-generation bump: union the per-shard fold payloads
+        (keep-max-LSN per duplicate id — replicated copies share an
+        LSN; a partial-quorum history keeps the newest write), run the
+        delete+extend upsert pattern on the single-node base (one index
+        generation bump), verify + canary-gate, commit the checkpoint,
+        re-shard under the bumped placement, publish, then truncate
+        every shard WAL and reset every memtable.  Returns the new
+        routed index, or None when every delta tier is empty."""
+        from raft_tpu.distributed import ann as _dann
+        with self._fold_lock, self._lock:
+            if all(m.live_rows == 0 and m.n_tombstones == 0
+                   for m in self.memtables):
+                return None
+            faults.maybe_fail("ingest.dist.fold")
+            with obs.stage("serving.ingest.dist.fold"):
+                fold_lsn = self._lsn
+                best: Dict[int, Tuple[int, np.ndarray]] = {}
+                tomb_ids: set = set()
+                for mem in self.memtables:
+                    li, rows, lsns, tids = mem.fold_items()
+                    tomb_ids.update(int(t) for t in tids)
+                    for j in range(li.size):
+                        i = int(li[j])
+                        cur = best.get(i)
+                        if cur is None or int(lsns[j]) > cur[0]:
+                            best[i] = (int(lsns[j]), rows[j])
+                live_ids = np.array(sorted(best), np.int64)
+                live_rows = (np.stack([best[int(i)][1] for i in live_ids])
+                             if live_ids.size
+                             else np.zeros((0, self.dim), np.float32))
+                base = self._base
+                parent_gen = _mutate.generation(base)
+                clear = np.union1d(
+                    np.array(sorted(tomb_ids), np.int64),
+                    live_ids).astype(np.int32)
+                cand = base
+                if clear.size:
+                    cand = ivf_pq.delete(self.handle, cand,
+                                         jnp.asarray(clear))
+                if live_ids.size:
+                    cand = ivf_pq.extend(self.handle, cand,
+                                         jnp.asarray(live_rows),
+                                         jnp.asarray(live_ids))
+                cand.generation = parent_gen + 1
+                _verify_index(cand, self.config.verify_level,
+                              res=self.handle, n_rows=_id_span(cand))
+                if getattr(cand, "canaries", None) is not None:
+                    _canary.health_check(self.handle, cand,
+                                         raise_on_fail=True)
+                # ONE placement-generation bump for the whole drain: the
+                # re-shard below carries every shard's drained rows
+                old_placement = self._index.placement
+                new_placement = _dann.compute_placement(
+                    np.asarray(_mutate.live_sizes(cand.list_indices)),
+                    self.n_shards,
+                    generation=old_placement.generation + 1,
+                    replication_factor=old_placement.replication_factor)
+                # durable commit marker BEFORE the publish: a kill after
+                # this point rolls FORWARD in recover()
+                self._save_fold(cand, new_placement, fold_lsn)
+                routed = _dann.shard_by_list(self.handle, cand,
+                                             placement=new_placement)
+                self._base = cand
+                self.swap_index(routed)
+                for s in range(self.n_shards):
+                    self._open_wal(s).truncate_all()
+                    self.memtables[s].reset()
+                self._ck.clear()
+                _count("serving.ingest.dist.folds")
+                _flight.record_event(
+                    "serving.ingest.dist.fold",
+                    rows=int(live_ids.size),
+                    tombstones=len(tomb_ids), fold_lsn=fold_lsn,
+                    generation=_mutate.generation(cand),
+                    placement_generation=new_placement.generation)
+            return routed
+
+    def _save_fold(self, cand, placement, fold_lsn: int) -> None:
+        buf = io.BytesIO()
+        ivf_pq.serialize(self.handle, buf, cand)
+        pbuf = io.BytesIO()
+        from raft_tpu.distributed import ann as _dann
+        _dann.placement_to_stream(self.handle, pbuf, placement)
+        self._ck.save(_FOLD_STAGE, {
+            "index": np.frombuffer(buf.getvalue(), np.uint8),
+            "placement": np.frombuffer(pbuf.getvalue(), np.uint8),
+            "generation": np.asarray([_mutate.generation(cand)], np.int64),
+            "fold_lsn": np.asarray([fold_lsn], np.int64)})
+
+    def _load_fold(self):
+        from raft_tpu.distributed import ann as _dann
+        arrays = self._ck.load(_FOLD_STAGE)
+        cand = ivf_pq.deserialize(
+            self.handle, io.BytesIO(bytes(arrays["index"])))
+        cand.generation = int(arrays["generation"][0])
+        placement = _dann.placement_from_stream(
+            self.handle, io.BytesIO(bytes(arrays["placement"])))
+        return cand, placement, int(arrays["fold_lsn"][0])
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def prewarm(self, batches: Sequence[int]) -> int:
+        """Pre-trace the write router at the serving batch shapes (see
+        :func:`raft_tpu.core.aot.warm_write_router`) so the first write
+        after a deploy or failover is compile-free."""
+        from raft_tpu.core import aot as _aot
+        return _aot.warm_write_router(self._index, batches)
+
+    def close(self) -> None:
+        for s in range(self.n_shards):
+            if self._wals[s] is not None:
+                self._wals[s].close()
+                self._wals[s] = None
+
+    def __enter__(self) -> "RoutedIngest":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def stats(self) -> Dict[str, object]:
+        return {
+            "last_lsn": self._lsn,
+            "memtable_rows": [m.live_rows for m in self.memtables],
+            "tombstones": [m.n_tombstones for m in self.memtables],
+            "wal_bytes": [w.size_bytes if w is not None else 0
+                          for w in self._wals],
+            "down": list(self._down()),
+            "placement_generation": self._index.placement.generation,
+        }

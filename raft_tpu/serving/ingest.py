@@ -278,8 +278,9 @@ class IngestConfig:
     writes shed with :class:`Overloaded` until a fold drains the tier.
     ``write_quotas`` maps tenant -> (rate_rows_per_s, burst_rows).
     ``fold_rows`` / ``fold_tombstones`` are the ``maybe_fold``
-    thresholds (0 disables that trigger; the rebalancer hook calls
-    ``maybe_fold`` each pass).
+    size thresholds; ``fold_replay_debt_rows`` / ``fold_visibility_lag_s``
+    are the round-19 WAL-lag / visibility-target triggers (0 disables
+    any trigger; the rebalancer hook calls ``maybe_fold`` each pass).
     """
 
     wal_dir: str = "ingest-wal"
@@ -289,6 +290,15 @@ class IngestConfig:
     max_wal_bytes: int = 64 << 20
     fold_rows: int = 0
     fold_tombstones: int = 0
+    #: fold when the WAL replay debt (rows logged since the last fold —
+    #: the rows a recovery would have to replay) reaches this bound
+    #: (0 disables)
+    fold_replay_debt_rows: int = 0
+    #: fold when the OLDEST unfolded record has been pinned in the
+    #: delta tier longer than this many seconds (0 disables) — the
+    #: visibility-target trigger: it bounds both recovery replay time
+    #: and how long the memtable merge carries a row
+    fold_visibility_lag_s: float = 0.0
     write_quotas: Optional[Dict[str, Tuple[float, float]]] = None
     verify_level: str = "statistical"
 
@@ -328,8 +338,17 @@ class IngestServer:
         self._sync_cond = threading.Condition()
         self._synced_lsn = 0
         self._sync_busy = False
+        # group-commit failure fence: bumped when a group fsync fails,
+        # with the exception retained so every rider of the failed
+        # group re-raises it instead of riding a later, luckier fsync
+        self._sync_epoch = 0
+        self._sync_exc: Optional[BaseException] = None
         self._backpressured = False
         self._recovered = False
+        # fold-trigger state (round 19): rows a recovery would replay
+        # and the append time of the oldest unfolded record
+        self._replay_debt_rows = 0
+        self._oldest_pending_ts: Optional[float] = None
 
     # ---- wiring ----------------------------------------------------------
 
@@ -399,6 +418,9 @@ class IngestServer:
                     _count("serving.ingest.replayed")
             self._lsn = max((r.lsn for r in records), default=0)
             self._synced_lsn = self._lsn
+            self._replay_debt_rows = int(
+                sum(r.ids.size for r in records))
+            self._oldest_pending_ts = (self._clock() if records else None)
             if records or dropped:
                 _flight.record_event("serving.ingest.replay",
                                      rolled_forward=False, records=replayed,
@@ -467,6 +489,9 @@ class IngestServer:
             t_append = _trace.now() if rt is not None else 0.0
             self._wal.append(encode_record(lsn, opcode, ids, vecs))
             self._lsn = lsn
+            self._replay_debt_rows += int(ids.size)
+            if self._oldest_pending_ts is None:
+                self._oldest_pending_ts = t0
             _count("serving.ingest.appended")
             # apply inside the append lock: memtable order == WAL order,
             # so replay reproduces the live state record for record.
@@ -500,11 +525,30 @@ class IngestServer:
     def _sync_upto(self, lsn: int) -> None:
         """Group commit: wait until the WAL is durable through ``lsn``.
         The first waiter performs ONE fsync covering every record
-        appended so far; concurrent writers ride it."""
+        appended so far; concurrent writers ride it.
+
+        A failed fsync fails the ack of the WHOLE group: the performer
+        re-raises, and every rider whose record was in flight during
+        the failed epoch re-raises the same error instead of silently
+        riding a later, luckier fsync — their rows were applied
+        (visible) but never proven durable, so acking them would break
+        the durability contract.  The WAL tail is left exactly as
+        appended: any torn suffix is the repairable-tail case
+        :func:`scan_wal` already handles, so the next :meth:`recover`
+        repairs and replays cleanly.  Writers arriving AFTER the
+        failure start a fresh epoch and may ack on a new fsync."""
+        with self._sync_cond:
+            if self._synced_lsn >= lsn:
+                return
+            epoch = self._sync_epoch
         while True:
             with self._sync_cond:
                 if self._synced_lsn >= lsn:
                     return
+                if self._sync_epoch != epoch:
+                    # a group fsync covering our in-flight record
+                    # failed: this ack fails with the group
+                    raise self._sync_exc
                 if self._sync_busy:
                     self._sync_cond.wait(timeout=1.0)
                     continue
@@ -513,9 +557,11 @@ class IngestServer:
                 with self._lock:
                     target = self._lsn
                 self._wal.sync()
-            except BaseException:
+            except BaseException as exc:
                 with self._sync_cond:
                     self._sync_busy = False
+                    self._sync_epoch += 1
+                    self._sync_exc = exc
                     self._sync_cond.notify_all()
                 raise
             with self._sync_cond:
@@ -575,14 +621,39 @@ class IngestServer:
     # ---- fold ------------------------------------------------------------
 
     def maybe_fold(self):
-        """Fold when a configured threshold is crossed (the rebalancer's
-        per-pass hook); returns the new index or None."""
+        """Fold when a configured trigger fires (the rebalancer's
+        per-pass hook); returns the new index or None.
+
+        Two trigger families: the PR 13 size thresholds (``fold_rows``
+        / ``fold_tombstones``) and the round-19 WAL-lag / visibility
+        targets — ``fold_replay_debt_rows`` fires when the rows a
+        recovery would have to replay exceed the bound, and
+        ``fold_visibility_lag_s`` fires when the oldest unfolded record
+        has been pinned in the delta tier past the target.  Each
+        lag-family firing ticks its own counter
+        (``serving.ingest.fold_trigger.rows`` /
+        ``serving.ingest.fold_trigger.lag``) so the fold cadence is
+        attributable to a cause, not just observed."""
         rows, tombs = self.memtable.live_rows, self.memtable.n_tombstones
-        if ((self.config.fold_rows and rows >= self.config.fold_rows)
-                or (self.config.fold_tombstones
-                    and tombs >= self.config.fold_tombstones)):
-            return self.fold()
-        return None
+        cfg = self.config
+        trigger = None
+        if ((cfg.fold_rows and rows >= cfg.fold_rows)
+                or (cfg.fold_tombstones
+                    and tombs >= cfg.fold_tombstones)):
+            trigger = "threshold"
+        elif (cfg.fold_replay_debt_rows
+                and self._replay_debt_rows >= cfg.fold_replay_debt_rows):
+            trigger = "rows"
+            _count("serving.ingest.fold_trigger.rows")
+        elif (cfg.fold_visibility_lag_s
+                and self._oldest_pending_ts is not None
+                and (self._clock() - self._oldest_pending_ts
+                     >= cfg.fold_visibility_lag_s)):
+            trigger = "lag"
+            _count("serving.ingest.fold_trigger.lag")
+        if trigger is None:
+            return None
+        return self.fold()
 
     def fold(self):
         """Fold the memtable into the main index: one checkpointed,
@@ -640,6 +711,8 @@ class IngestServer:
                 mem.reset()
                 with self._sync_cond:
                     self._synced_lsn = self._lsn
+                self._replay_debt_rows = 0
+                self._oldest_pending_ts = None
                 self._ck.clear()
                 _count("serving.ingest.folds")
                 _flight.record_event("serving.ingest.fold",
@@ -695,6 +768,7 @@ class IngestServer:
             "tombstones": self.memtable.n_tombstones,
             "memtable_capacity": self.memtable.capacity,
             "backpressured": self._backpressured,
+            "replay_debt_rows": self._replay_debt_rows,
         }
 
 

@@ -35,6 +35,14 @@ Two rules:
   published index's array leaves (``list_data``, ``centers``, …) in
   place: in-flight readers pinned on the old generation would observe
   the mutation mid-scan.
+- ``generation-discipline`` (shard-local folds, round 19): a
+  serving-layer fold that drains the ROUTED tier's per-shard memtables
+  — recognizable because the function mentions the placement — must
+  additionally thread the PLACEMENT generation (read
+  ``<placement>.generation``, e.g. ``placement.generation + 1`` into
+  ``compute_placement``): the executable cache keys routed programs on
+  the placement generation, so a per-shard drain republished under the
+  same placement generation serves stale routing tables.
 """
 
 from __future__ import annotations
@@ -123,6 +131,28 @@ def _calls_name(fn: ast.AST, name: str) -> bool:
 def _params(fn: ast.AST) -> set:
     a = fn.args
     return {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+
+
+def _mentions_placement(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and "placement" in n.id:
+            return True
+        if isinstance(n, ast.Attribute) and "placement" in n.attr:
+            return True
+        if isinstance(n, ast.keyword) and n.arg and "placement" in n.arg:
+            return True
+    return False
+
+
+def _threads_placement_generation(fn: ast.AST) -> bool:
+    """True when ``fn`` reads ``<placement-ish>.generation`` — the
+    evidence a shard-local fold derives the NEXT placement generation
+    from the current one instead of republishing under the same one."""
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Attribute) and node.attr == "generation"
+                and _mentions_placement(node.value)):
+            return True
+    return False
 
 
 def _mentions_generation(node: ast.AST) -> bool:
@@ -224,4 +254,27 @@ class GenerationDisciplinePass:
                     f"publishing it — route the candidate through "
                     f"swap_index (or bump .generation) so warmed "
                     f"executables never alias a stale generation"))
+        # shard-local fold rule (round 19): a serving fold that drains
+        # the routed tier's per-shard memtables (it mentions the
+        # placement) must thread the PLACEMENT generation too — routed
+        # executables are keyed on it, so a drain republished under the
+        # same placement generation serves stale routing tables
+        for mod in project.walk("raft_tpu/serving/"):
+            for fn, stack in walk_functions(mod.tree):
+                if "fold" not in fn.name.lower():
+                    continue
+                deriver = _constructs_index(fn) or _derives_index(fn)
+                if deriver is None:
+                    continue
+                if not _mentions_placement(fn):
+                    continue
+                if _threads_placement_generation(fn):
+                    continue
+                out.append(Diagnostic(
+                    mod.rel, deriver.lineno, "generation-discipline",
+                    f"'{fn.name}' drains shard-local memtables without "
+                    f"threading the placement generation — derive the "
+                    f"published placement from "
+                    f"'<placement>.generation + 1' so routed "
+                    f"executable-cache keys advance with the drain"))
         return out

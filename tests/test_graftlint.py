@@ -154,6 +154,41 @@ class TestGenerationDiscipline:
                "    return extend(self.res, base, rows, ids)\n")
         assert lint({"raft_tpu/ops/x.py": src}) == []
 
+    # -- shard-local folds (round 19: placement-generation threading) --
+
+    def test_fold_using_placement_without_generation_flagged(self):
+        src = ("def fold(self, base, rows, ids, placement):\n"
+               "    cand = extend(self.res, base, rows, ids)\n"
+               "    cand.generation = base.generation + 1\n"
+               "    routed = shard_by_list(self.handle, cand,\n"
+               "                           placement=placement)\n"
+               "    self.swap_index(routed)\n"
+               "    return routed\n")
+        diags = lint({"raft_tpu/serving/x.py": src})
+        assert [d.rule for d in diags] == ["generation-discipline"]
+        assert "placement generation" in diags[0].message
+
+    def test_fold_threading_placement_generation_clean(self):
+        src = ("def fold(self, base, rows, ids, placement):\n"
+               "    cand = extend(self.res, base, rows, ids)\n"
+               "    cand.generation = base.generation + 1\n"
+               "    nxt = compute_placement(\n"
+               "        sizes, n, generation=placement.generation + 1)\n"
+               "    routed = shard_by_list(self.handle, cand,\n"
+               "                           placement=nxt)\n"
+               "    self.swap_index(routed)\n"
+               "    return routed\n")
+        assert lint({"raft_tpu/serving/x.py": src}) == []
+
+    def test_placement_fold_rule_ignores_placement_free_folds(self):
+        # the PR 13 single-writer fold never mentions the placement —
+        # the shard-local rule must not fire on it
+        src = ("def fold(self, base, rows, ids):\n"
+               "    cand = extend(self.res, base, rows, ids)\n"
+               "    cand.generation = base.generation + 1\n"
+               "    return cand\n")
+        assert lint({"raft_tpu/serving/x.py": src}) == []
+
 
 # ---------------------------------------------------------------------------
 # mask-seam
@@ -737,6 +772,30 @@ class TestLiveTree:
         assert "serving.ingest.replay" in d["events"]
         assert "serving.ingest.backpressure" in d["events"]
         assert "serving.ingest.fold" in d["stages"]
+        # fold-trigger attribution counters (round 19, satellite)
+        assert "serving.ingest.fold_trigger.rows" in d["counters"]
+        assert "serving.ingest.fold_trigger.lag" in d["counters"]
+        # the distributed ingest surface (round 19): per-shard WAL
+        # counters, the write-path kill-matrix fault sites, and the
+        # quorum/catch-up flight events, all from literal call sites
+        for name in ("serving.ingest.dist.appended",
+                     "serving.ingest.dist.acked",
+                     "serving.ingest.dist.replayed",
+                     "serving.ingest.dist.folds",
+                     "serving.ingest.dist.unavailable",
+                     "serving.ingest.dist.write_error"):
+            assert name in d["counters"], name
+        for site in ("ingest.dist.route", "ingest.dist.append",
+                     "ingest.dist.ack", "ingest.dist.replicate",
+                     "ingest.dist.fold", "ingest.dist.catch_up"):
+            assert site in d["fault_sites"], site
+        for name in ("serving.ingest.dist.unavailable",
+                     "serving.ingest.dist.write_error",
+                     "serving.ingest.dist.replay",
+                     "serving.ingest.dist.catch_up",
+                     "serving.ingest.dist.fold"):
+            assert name in d["events"], name
+        assert "serving.ingest.dist.fold" in d["stages"]
         # trace spans (serving.request registers through the
         # start_request parameter default) and flight anomaly events
         assert "serving.request" in d["spans"]

@@ -960,3 +960,146 @@ class TestIngestTracing:
         assert frt.attrs["rows"] == 8
         assert "generation" in frt.attrs
         srv.close()
+
+
+# ---------------------------------------------------------------------------
+# round 19 satellites: the group-commit failure fence, the WAL-lag /
+# visibility fold triggers, and replay racing live readers
+
+
+class TestRound19Satellites:
+    def test_fsync_failure_fails_whole_group_commit(self, tmp_path,
+                                                    monkeypatch):
+        """A failed group fsync fails the ack for EVERY rider of that
+        group — the performer raises, and a waiter whose record was
+        covered re-raises the same exception through the epoch fence —
+        and the tail stays repairable: the records were appended, so
+        the next good fsync (or a recover) makes them durable."""
+        rng = np.random.default_rng(40)
+        srv = _ingest(tmp_path, memtable_capacity=64)
+        srv.recover()
+        in_sync = threading.Event()
+        release = threading.Event()
+        calls = []
+        orig = ingest.WriteAheadLog.sync
+
+        def patched(wal):
+            if not calls:
+                calls.append(1)
+                in_sync.set()
+                assert release.wait(10.0)
+                raise OSError("injected fsync failure")
+            return orig(wal)
+
+        monkeypatch.setattr(ingest.WriteAheadLog, "sync", patched)
+        errs = {}
+
+        def writer(name, i):
+            try:
+                srv.write(np.array([i]), _rows(rng, 1))
+            except BaseException as e:  # noqa: BLE001
+                errs[name] = e
+
+        t1 = threading.Thread(target=writer, args=("performer", 9001))
+        t1.start()
+        assert in_sync.wait(10.0)        # performer is inside fsync
+        t2 = threading.Thread(target=writer, args=("rider", 9002))
+        t2.start()
+        # the rider appends its record, then parks on the busy group
+        deadline = 50
+        while srv.stats()["last_lsn"] < 2 and deadline:
+            threading.Event().wait(0.1)
+            deadline -= 1
+        threading.Event().wait(0.3)      # let the rider reach the fence
+        release.set()
+        t1.join(10.0)
+        t2.join(10.0)
+        assert isinstance(errs.get("performer"), OSError)
+        assert isinstance(errs.get("rider"), OSError)
+        assert errs["rider"] is errs["performer"]   # the fence re-raises
+        # both records were appended; the NEXT write's good fsync (and
+        # any recover) sees them — no acked state was lost, only acks
+        assert srv.write(np.array([9003]), _rows(rng, 1)) == 3
+        dig = srv.memtable.digest()
+        srv.close()
+        srv2 = _ingest(tmp_path, memtable_capacity=64)
+        srv2.recover()
+        assert srv2.memtable.digest() == dig
+        assert srv2.stats()["last_lsn"] == 3
+        srv2.close()
+
+    def test_fold_trigger_replay_debt_rows(self, tmp_path, res,
+                                           flat_index):
+        rng = np.random.default_rng(41)
+        srv = _ingest(tmp_path, res=res, fold_replay_debt_rows=3)
+        srv.recover(base_index=flat_index)
+        with obs.collecting():
+            srv.write(np.array([8200, 8201]), _rows(rng, 2))
+            assert srv.maybe_fold() is None          # debt 2 < 3
+            assert srv.stats()["replay_debt_rows"] == 2
+            srv.write(np.array([8202]), _rows(rng, 1))
+            assert srv.maybe_fold() is not None      # debt 3 fires
+            snap = obs.snapshot()["counters"]
+            assert snap["serving.ingest.fold_trigger.rows"] == 1
+            assert "serving.ingest.fold_trigger.lag" not in snap
+        assert srv.stats()["replay_debt_rows"] == 0  # fold clears debt
+        srv.close()
+
+    def test_fold_trigger_visibility_lag(self, tmp_path, res,
+                                         flat_index):
+        rng = np.random.default_rng(42)
+        t = [100.0]
+        srv = ingest.IngestServer(
+            res, ingest.IngestConfig(wal_dir=str(tmp_path / "wal"),
+                                     memtable_capacity=32,
+                                     tomb_capacity=32,
+                                     fold_visibility_lag_s=5.0),
+            dim=DIM, clock=lambda: t[0])
+        srv.recover(base_index=flat_index)
+        srv.write(np.array([8300]), _rows(rng, 1))
+        with obs.collecting():
+            assert srv.maybe_fold() is None          # age 0 < 5s
+            t[0] += 10.0                             # oldest row ages out
+            assert srv.maybe_fold() is not None
+            snap = obs.snapshot()["counters"]
+            assert snap["serving.ingest.fold_trigger.lag"] == 1
+        # a fresh write restarts the visibility clock
+        srv.write(np.array([8301]), _rows(rng, 1))
+        assert srv.maybe_fold() is None
+        srv.close()
+
+    def test_recover_replay_races_concurrent_reads(self, tmp_path):
+        """recover() replays under the append lock while a closed-loop
+        reader hammers the memtable search path — no exception, no torn
+        view, and the final state is the full bit-identical replay."""
+        rng = np.random.default_rng(43)
+        srv = _ingest(tmp_path, memtable_capacity=256)
+        srv.recover()
+        for j in range(40):
+            srv.write(np.array([j]), _rows(rng, 1))
+        dig = srv.memtable.digest()
+        srv.close()
+        srv2 = _ingest(tmp_path, memtable_capacity=256)
+        stop = threading.Event()
+        errs = []
+        seen = []
+
+        def reader():
+            q = np.zeros((1, DIM), np.float32)
+            while not stop.is_set():
+                try:
+                    _, i = srv2.memtable.search(q, 5)
+                    seen.append(int((np.asarray(i) >= 0).sum()))
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+                    return
+
+        rt = threading.Thread(target=reader)
+        rt.start()
+        srv2.recover()
+        stop.set()
+        rt.join(10.0)
+        assert not errs
+        assert seen                                   # the loop really ran
+        assert srv2.memtable.digest() == dig
+        srv2.close()
