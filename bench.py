@@ -2070,6 +2070,206 @@ def run_quality(conf_path: str) -> int:
     return 1 if failures else 0
 
 
+# filtered-search selectivity grid (round 20): fraction of rows each
+# query's admission bitset passes
+FILTERED_SELECTIVITIES = (0.01, 0.1, 0.5, 1.0)
+
+
+def bench_filtered(res, db, queries, *, build_param=None, search_param=None,
+                   k=SERVING_K, n_queries=256,
+                   selectivities=FILTERED_SELECTIVITIES, runs=5,
+                   recompile_probes=6) -> list:
+    """Filtered-search selectivity sweep at the flagship operating point.
+
+    For each selectivity ``s`` a per-query random bitset admits ``s*n``
+    rows and the probe budget scales to ``nprobe/s`` (capped at full
+    probe) so both arms examine the SAME admitted-candidate budget —
+    under that normalization a correct admission seam can only make the
+    problem easier (fewer competitors per admitted candidate), so the
+    gate ``filtered_recall >= unfiltered_recall`` is an invariant, not
+    a tuning target.  Recall is measured against the exact top-
+    ``min(k, admitted)`` of each query's admitted set (a filter with
+    fewer than k admissible rows is not penalized for the shortfall).
+    Emits one ``filtered_qps@s*`` line per selectivity plus the
+    ``filtered_recall_gate`` summary with the steady-state recompile
+    count across varying filters at a fixed bucket.
+    """
+    import jax.numpy as jnp
+
+    from raft_tpu import observability as obs
+    from raft_tpu import serving
+    from raft_tpu.filters import SampleFilter, query_filter_words
+    from raft_tpu.neighbors import ivf_pq
+
+    bp = build_param or {"nlist": 256, "pq_dim": 32}
+    spc = search_param or {"nprobe": 16}
+    n_lists, nprobe = bp["nlist"], spc["nprobe"]
+    index = ivf_pq.build(
+        res, ivf_pq.IndexParams(n_lists=n_lists, pq_dim=bp["pq_dim"],
+                                kmeans_n_iters=bp.get("kmeans_n_iters", 4)),
+        db)
+
+    def sp_at(p):
+        return ivf_pq.SearchParams(
+            n_probes=p, scan_mode=spc.get("scan_mode", "auto"),
+            per_probe_topk=spc.get("per_probe_topk", 0))
+
+    q = np.asarray(queries)[:n_queries]
+    dbn = np.asarray(db)
+    nq, n = q.shape[0], dbn.shape[0]
+    # exact squared distances once (host): ground truth over ANY
+    # admitted subset is a masked argsort of this
+    qd = q.astype(np.float64)
+    dbd = dbn.astype(np.float64)
+    dist = ((qd * qd).sum(1)[:, None] + (dbd * dbd).sum(1)[None, :]
+            - 2.0 * qd @ dbd.T)
+
+    def timed(sp, filt):
+        qj = jnp.asarray(q)
+        d, i = ivf_pq.search(res, sp, index, qj, k, filter=filt)  # warm
+        t0 = time.perf_counter()
+        for _ in range(runs):
+            _, i = ivf_pq.search(res, sp, index, qj, k, filter=filt)
+        np.asarray(i)                      # host readback fence
+        return nq / ((time.perf_counter() - t0) / runs), np.asarray(i)
+
+    def recall_against(found, mask):
+        hits = total = 0
+        for qi in range(nq):
+            adm = np.nonzero(mask[qi])[0]
+            k_eff = min(k, adm.size)
+            if not k_eff:
+                continue
+            gt = adm[np.argsort(dist[qi, adm], kind="stable")[:k_eff]]
+            hits += np.isin(found[qi], gt).sum()
+            total += k_eff
+        return hits / total if total else 1.0
+
+    rng = np.random.default_rng(20)
+    out = []
+    qps_unf, i_unf = timed(sp_at(nprobe), None)
+    recall_unf = recall_against(i_unf, np.ones((nq, n), bool))
+
+    grid = []
+    for s in selectivities:
+        mask = (rng.random((nq, n)) < s if s < 1.0
+                else np.ones((nq, n), bool))
+        filt = SampleFilter.from_mask(mask)
+        p = min(n_lists, int(np.ceil(nprobe / s)))
+        qps_f, i_f = timed(sp_at(p), filt)
+        stray = sum(int(not mask[qi, ii]) for qi in range(nq)
+                    for ii in i_f[qi] if ii >= 0)
+        point = {
+            "selectivity": s,
+            "n_probes": p,
+            "filtered_qps": round(qps_f, 1),
+            "filtered_recall": round(recall_against(i_f, mask), 4),
+            "unfiltered_recall": round(recall_unf, 4),
+            "admitted_budget_rows": int(filt.admitted_counts().mean()),
+            "inadmissible_returned": stray,
+        }
+        grid.append(point)
+        out.append({
+            "metric": f"filtered_qps@s{s:g}",
+            "value": point["filtered_qps"],
+            "unit": "queries/s",
+            "vs_baseline": round(qps_f / max(qps_unf, 1e-9), 3),
+            "detail": point,
+        })
+
+    # filters are data, not shape: varying bitsets at a fixed bucket
+    # must not trigger a single steady-state recompile
+    with obs.collecting():
+        ex = serving.Executor(res, "ivf_pq", index, ks=(k,),
+                              max_batch=64, search_params=sp_at(nprobe),
+                              warm="jit", filter_rows=n)
+        qb = jnp.asarray(q[:64])
+        warm = query_filter_words(
+            SampleFilter.from_mask(rng.random((64, n)) < 0.5), 64, "bench")
+        ex.search_bucket(qb, 64, k, filter_words=warm)[0].block_until_ready()
+        c0 = obs.registry().counter("xla.compiles").value
+        for _ in range(recompile_probes):
+            fw = query_filter_words(
+                SampleFilter.from_mask(rng.random((64, n)) < 0.2),
+                64, "bench")
+            ex.search_bucket(qb, 64, k,
+                             filter_words=fw)[0].block_until_ready()
+        recompiles = int(obs.registry().counter("xla.compiles").value - c0)
+
+    out.append({
+        "metric": "filtered_recall_gate",
+        "value": round(min(pt["filtered_recall"] - pt["unfiltered_recall"]
+                           for pt in grid), 4),
+        "unit": "recall_delta",
+        "vs_baseline": round(recall_unf, 4),
+        "detail": {
+            "unfiltered_qps": round(qps_unf, 1),
+            "unfiltered_recall": round(recall_unf, 4),
+            "recompiles_steady": recompiles,
+            "grid": grid,
+            "k": k, "n_db": n, "batch": nq,
+            "n_lists": n_lists, "nprobe": nprobe,
+        },
+    })
+    return out
+
+
+def run_filtered(conf_path: str) -> int:
+    """``--filtered`` mode: the CI filtered-search smoke.  FAILS (exit 1)
+    when any selectivity's filtered recall@k falls below the unfiltered
+    recall@k at the matched admitted-candidate budget, when any
+    inadmissible id is returned, or on any steady-state recompile
+    across varying filters at a fixed bucket."""
+    from raft_tpu import DeviceResources
+    from raft_tpu.observability import flight as _flight
+
+    with open(conf_path) as f:
+        conf = json.load(f)
+    res = DeviceResources(seed=0)
+    db, queries = _make_dataset(conf["dataset"])
+    g = conf["filtered"]
+    lines = bench_filtered(
+        res, db, queries,
+        build_param=g.get("build_param"),
+        search_param=g.get("search_param"),
+        k=g.get("k", SERVING_K),
+        n_queries=g.get("n_queries", 256),
+        selectivities=tuple(g.get("selectivities",
+                                  FILTERED_SELECTIVITIES)),
+        runs=g.get("runs", 5),
+        recompile_probes=g.get("recompile_probes", 6))
+    for line in lines:
+        _emit(line)
+    gate = next(ln for ln in lines
+                if ln["metric"] == "filtered_recall_gate")
+    eps = g.get("recall_epsilon", 0.0)
+    failures = []
+    for pt in gate["detail"]["grid"]:
+        if pt["filtered_recall"] + eps < pt["unfiltered_recall"]:
+            failures.append(
+                f"selectivity {pt['selectivity']}: filtered recall "
+                f"{pt['filtered_recall']:.4f} below unfiltered "
+                f"{pt['unfiltered_recall']:.4f} at matched admitted "
+                f"budget (n_probes={pt['n_probes']})")
+        if pt["inadmissible_returned"]:
+            failures.append(
+                f"selectivity {pt['selectivity']}: "
+                f"{pt['inadmissible_returned']} inadmissible ids "
+                "returned — the admission seam leaked")
+    if gate["detail"]["recompiles_steady"] != 0:
+        failures.append(
+            f"{gate['detail']['recompiles_steady']} XLA recompiles "
+            "across varying filters at a fixed bucket (filters must be "
+            "data, not shape)")
+    for msg in failures:
+        print(f"FILTERED SMOKE FAIL: {msg}", flush=True)
+    if failures:
+        dumped = _flight.maybe_auto_dump("filtered_smoke_failure")
+        if dumped:
+            print(f"flight dump: {dumped}", flush=True)
+    return 1 if failures else 0
+
+
 MUTATION_CHURN = 0.01          # writer deletes AND extends 1% per cycle
 
 
@@ -3059,6 +3259,12 @@ if __name__ == "__main__":
                 os.path.join(os.path.dirname(__file__), "conf",
                              "skew-smoke.json")
             sys.exit(run_skew(conf))
+        elif len(sys.argv) >= 2 and sys.argv[1] == "--filtered":
+            _setup_jax_cache()
+            conf = sys.argv[2] if len(sys.argv) >= 3 else \
+                os.path.join(os.path.dirname(__file__), "conf",
+                             "filtered-smoke.json")
+            sys.exit(run_filtered(conf))
         elif len(sys.argv) >= 2 and sys.argv[1] == "--ingest":
             _setup_jax_cache()
             conf = sys.argv[2] if len(sys.argv) >= 3 else \

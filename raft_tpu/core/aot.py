@@ -67,12 +67,17 @@ def save_search_fn(stream: BinaryIO, fn: Callable, arrays: Sequence,
                    example_queries) -> None:
     """One-file deployment artifact: captured arrays + exported program.
 
-    ``fn(arrays..., queries) -> (distances, indices)``; ``arrays`` are
-    baked into the artifact (host numpy), queries stay a runtime input.
+    ``fn(arrays..., *runtime) -> (distances, indices)``; ``arrays`` are
+    baked into the artifact (host numpy).  ``example_queries`` is the
+    runtime input — a single queries example, or a tuple of runtime
+    inputs (e.g. ``(queries, filter_words)`` for a filtered export); the
+    loaded callable takes them positionally.
     """
     import jax.numpy as jnp
 
-    blob = export_fn(fn, tuple(arrays) + (example_queries,))
+    runtime = (example_queries if isinstance(example_queries, tuple)
+               else (example_queries,))
+    blob = export_fn(fn, tuple(arrays) + runtime)
     # non-executable container on purpose: npz for the arrays + a
     # length-prefixed raw program blob (a pickle payload would execute
     # arbitrary code when loading an untrusted artifact).  bf16 has no
@@ -111,8 +116,8 @@ def load_search_fn(stream: BinaryIO) -> Callable:
                 a = jax.lax.bitcast_convert_type(a, jnp.bfloat16)
             arrays.append(a)
 
-    def g(queries):
-        return call(*arrays, queries)
+    def g(*runtime):
+        return call(*arrays, *runtime)
 
     return g
 
@@ -252,7 +257,8 @@ def executables() -> ExecutableCache:
 def export_ivf_pq_search(res, index, n_probes: int, k: int, batch: int,
                          *, scan_mode: str = "recon",
                          group_capacity: int = 0,
-                         merge_window=0) -> io.BytesIO:
+                         merge_window=0,
+                         n_filter_words: int = 0) -> io.BytesIO:
     """Export the flagship IVF-PQ search at fixed (batch, k, n_probes)
     into a self-contained artifact (reference analogue: serialized index
     + the prebuilt search instantiation).
@@ -297,6 +303,12 @@ def export_ivf_pq_search(res, index, n_probes: int, k: int, batch: int,
     expects(scan_mode in ("recon", "codes", "lut", "fused"),
             "aot: scan_mode must be 'recon', 'codes', 'lut' or 'fused'")
     metric = index.metric
+    # n_filter_words > 0 adds a second runtime input: a (batch, n_words)
+    # int32 packed admission bitset (raft_tpu.filters.bitset), threaded
+    # through the scan's admission seam.  Filters are data, not shape —
+    # one filtered artifact serves every predicate at this bucket
+    # (all-ones words = unfiltered).
+    nfw = int(n_filter_words)
 
     if scan_mode == "fused" and index.list_recon is None:
         scan_mode = "lut"
@@ -316,17 +328,18 @@ def export_ivf_pq_search(res, index, n_probes: int, k: int, batch: int,
                                        cap * rot * 2, G * rot * 4)
 
             def fn(centers, list_recon, list_recon_sq, list_indices,
-                   rotation, queries):
+                   rotation, queries, *rt):
                 probes = ivf_pq._select_clusters(centers, rotation,
                                                  queries, n_probes,
                                                  metric)
                 return ivf_pq._search_impl_recon_grouped(
                     centers, list_recon, list_recon_sq, list_indices,
                     rotation, queries, probes, k, metric, n_groups,
-                    block, merge_window=merge_window)
+                    block, merge_window=merge_window,
+                    filter_words=rt[0] if nfw else None)
         else:
             def fn(centers, list_recon, list_recon_sq, list_indices,
-                   rotation, queries):
+                   rotation, queries, *rt):
                 # the precomputed norms ride in the artifact — without
                 # them the exported program would recompute a full pass
                 # over the recon cache per batch (they are runtime
@@ -334,7 +347,8 @@ def export_ivf_pq_search(res, index, n_probes: int, k: int, batch: int,
                 return ivf_pq._search_impl_recon(
                     centers, list_recon, list_indices, rotation, queries,
                     k=k, n_probes=n_probes, metric=metric,
-                    list_recon_sq=list_recon_sq)
+                    list_recon_sq=list_recon_sq,
+                    filter_words=rt[0] if nfw else None)
 
         arrays = (index.centers, index.list_recon, index.list_recon_sq,
                   index.list_indices, index.rotation)
@@ -343,20 +357,22 @@ def export_ivf_pq_search(res, index, n_probes: int, k: int, batch: int,
         pq_bits = index.pq_bits
 
         def fn(centers, codebooks, list_codes, list_indices, rotation,
-               queries):
+               queries, *rt):
             return ivf_pq._search_impl(
                 centers, codebooks, list_codes, list_indices, rotation,
                 queries, k=k, n_probes=n_probes, metric=metric,
                 codebook_kind=codebook_kind, lut_dtype=jax.numpy.float32,
-                pq_bits=pq_bits)
+                pq_bits=pq_bits, filter_words=rt[0] if nfw else None)
 
         arrays = (index.centers, index.codebooks, index.list_codes,
                   index.list_indices, index.rotation)
 
     example_q = jax.ShapeDtypeStruct((batch, index.dim),
                                      index.centers.dtype)
+    runtime = ((example_q, jax.ShapeDtypeStruct((batch, nfw), np.int32))
+               if nfw else example_q)
     buf = io.BytesIO()
-    save_search_fn(buf, fn, arrays, example_q)
+    save_search_fn(buf, fn, arrays, runtime)
     buf.seek(0)
     return buf
 
@@ -366,7 +382,8 @@ def export_ivf_pq_routed_search(res, index, shard: int, n_probes: int,
                                 scan_mode: str = "recon",
                                 group_capacity: int = 0,
                                 merge_window=0,
-                                replica_rank: int = 0) -> io.BytesIO:
+                                replica_rank: int = 0,
+                                n_filter_words: int = 0) -> io.BytesIO:
     """Export ONE shard's routed (``placement="by_list"``) search
     program at fixed (batch, k, n_probes): replicated coarse routing +
     ownership mask + the shard-local scan over the owned lists +
@@ -422,6 +439,10 @@ def export_ivf_pq_routed_search(res, index, shard: int, n_probes: int,
     metric = index.metric
     slots = int(index.local_centers.shape[1])
     dummy = slots - 1
+    # filtered routed export: the SAME (batch, n_words) bitset every
+    # shard receives (filters address global row ids, so the broadcast
+    # needs no per-shard slicing)
+    nfw = int(n_filter_words)
 
     if scan_mode == "fused":
         n_groups = int(group_capacity) or grouped.group_capacity(
@@ -433,7 +454,7 @@ def export_ivf_pq_routed_search(res, index, shard: int, n_probes: int,
                                    cap * rot * 2, G * rot * 4)
 
         def fn(coarse, rotation, owner, local_slot, local_centers,
-               list_recon, list_recon_sq, list_indices, queries):
+               list_recon, list_recon_sq, list_indices, queries, *rt):
             probes = ivf_pq._select_clusters(coarse, rotation, queries,
                                              n_probes, metric)
             owned = owner[probes] == shard
@@ -445,10 +466,11 @@ def export_ivf_pq_routed_search(res, index, shard: int, n_probes: int,
             return ivf_pq._search_impl_recon_grouped(
                 local_centers, list_recon, list_recon_sq, list_indices,
                 rotation, queries, local_probes, k, metric, n_groups,
-                block, merge_window=merge_window)
+                block, merge_window=merge_window,
+                filter_words=rt[0] if nfw else None)
     else:
         def fn(coarse, rotation, owner, local_slot, local_centers,
-               list_recon, list_recon_sq, list_indices, queries):
+               list_recon, list_recon_sq, list_indices, queries, *rt):
             probes = ivf_pq._select_clusters(coarse, rotation, queries,
                                              n_probes, metric)
             owned = owner[probes] == shard
@@ -457,7 +479,8 @@ def export_ivf_pq_routed_search(res, index, shard: int, n_probes: int,
             return ivf_pq._search_impl_recon(
                 local_centers, list_recon, list_indices, rotation,
                 queries, k=k, n_probes=n_probes, metric=metric,
-                probes=local_probes, list_recon_sq=list_recon_sq)
+                probes=local_probes, list_recon_sq=list_recon_sq,
+                filter_words=rt[0] if nfw else None)
 
     if replica_rank > 0:
         rank_owner, rank_slot = index.placement.rank_tables()
@@ -471,8 +494,10 @@ def export_ivf_pq_routed_search(res, index, shard: int, n_probes: int,
         index.list_indices[shard]))
     example_q = jax.ShapeDtypeStruct((batch, index.dim),
                                      index.coarse_centers.dtype)
+    runtime = ((example_q, jax.ShapeDtypeStruct((batch, nfw), np.int32))
+               if nfw else example_q)
     buf = io.BytesIO()
-    save_search_fn(buf, fn, arrays, example_q)
+    save_search_fn(buf, fn, arrays, runtime)
     buf.seek(0)
     return buf
 
@@ -503,35 +528,44 @@ def warm_write_router(index, batches: Sequence[int]) -> int:
 
 
 def export_ivf_flat_search(res, index, n_probes: int, k: int,
-                           batch: int) -> io.BytesIO:
+                           batch: int, *,
+                           n_filter_words: int = 0) -> io.BytesIO:
     """Export the IVF-Flat search at fixed (batch, k, n_probes): raw
     list vectors + exported scan program in one artifact (reference
     analogue: the per-(T, IdxT, veclen) interleaved-scan instantiations
-    in cpp/src/neighbors/ivfflat_*)."""
+    in cpp/src/neighbors/ivfflat_*).  ``n_filter_words`` > 0 adds the
+    packed admission bitset as a second runtime input (see
+    :func:`export_ivf_pq_search`)."""
     from raft_tpu.neighbors import ivf_flat
 
     metric = index.metric
+    nfw = int(n_filter_words)
 
-    def fn(centers, list_data, list_indices, queries):
+    def fn(centers, list_data, list_indices, queries, *rt):
         return ivf_flat._search_impl(centers, list_data, list_indices,
                                      queries, k=k, n_probes=n_probes,
-                                     metric=metric)
+                                     metric=metric,
+                                     filter_words=rt[0] if nfw else None)
 
     example_q = jax.ShapeDtypeStruct((batch, index.dim),
                                      index.centers.dtype)
+    runtime = ((example_q, jax.ShapeDtypeStruct((batch, nfw), np.int32))
+               if nfw else example_q)
     buf = io.BytesIO()
     save_search_fn(buf, fn, (index.centers, index.list_data,
-                             index.list_indices), example_q)
+                             index.list_indices), runtime)
     buf.seek(0)
     return buf
 
 
 def export_brute_force_knn(res, database, k: int, batch: int, *,
-                           metric=None, metric_arg: float = 2.0
-                           ) -> io.BytesIO:
+                           metric=None, metric_arg: float = 2.0,
+                           n_filter_words: int = 0) -> io.BytesIO:
     """Export exact brute-force kNN over a fixed database at (batch, k):
     the database rides in the artifact, queries stay the runtime input
-    (reference analogue: the brute_force_knn instantiation units)."""
+    (reference analogue: the brute_force_knn instantiation units).
+    ``n_filter_words`` > 0 adds the packed admission bitset as a second
+    runtime input (see :func:`export_ivf_pq_search`)."""
     from raft_tpu.distance.types import DistanceType
     from raft_tpu.neighbors import brute_force
 
@@ -539,15 +573,23 @@ def export_brute_force_knn(res, database, k: int, batch: int, *,
         metric = DistanceType.L2Unexpanded
     database = jax.numpy.asarray(database)
     tile = min(brute_force._TILE_N, database.shape[0])
+    nfw = int(n_filter_words)
 
-    def fn(db, queries):
+    def fn(db, queries, *rt):
+        if nfw:
+            return brute_force._knn_impl(
+                db, queries, k, metric, metric_arg, tile,
+                filter_words=rt[0],
+                id_offset=jax.numpy.int32(0))
         return brute_force._knn_impl(db, queries, k, metric, metric_arg,
                                      tile)
 
     example_q = jax.ShapeDtypeStruct((batch, database.shape[1]),
                                      database.dtype)
+    runtime = ((example_q, jax.ShapeDtypeStruct((batch, nfw), np.int32))
+               if nfw else example_q)
     buf = io.BytesIO()
-    save_search_fn(buf, fn, (database,), example_q)
+    save_search_fn(buf, fn, (database,), runtime)
     buf.seek(0)
     return buf
 
@@ -555,7 +597,8 @@ def export_brute_force_knn(res, database, k: int, batch: int, *,
 def export_cagra_search(res, index, k: int, batch: int, *,
                         itopk: int = 64, search_width: int = 1,
                         max_iterations: int = 0,
-                        walk_pdim: int = 0) -> io.BytesIO:
+                        walk_pdim: int = 0,
+                        n_filter_words: int = 0) -> io.BytesIO:
     """Export the CAGRA packed-neighborhood walk at fixed (batch, k,
     itopk, search_width) into a self-contained artifact: walk table +
     entry set + exported walk program (reference analogue: serialized
@@ -589,32 +632,36 @@ def export_cagra_search(res, index, k: int, batch: int, *,
     rerank = max(min(itopk, max(32, 2 * k)), k)
     metric = index.metric
     deg = index.graph_degree
+    nfw = int(n_filter_words)
 
     if quant:
         def fn(dataset, table, entry_proj, entry_sq, entry_ids, proj,
-               scales, queries):
+               scales, queries, *rt):
             return cagra._search_impl_walk(
                 dataset, table, entry_proj, entry_sq, entry_ids, proj,
                 queries, k, itopk, search_width, max_iter, metric,
-                rerank, deg, quant=True, scales=scales)
+                rerank, deg, quant=True, scales=scales,
+                filter_words=rt[0] if nfw else None)
 
         arrays = (index.dataset, cache.table, cache.entry_proj,
                   cache.entry_sq, cache.entry_ids, cache.proj,
                   cache.scales)
     else:
         def fn(dataset, table, entry_proj, entry_sq, entry_ids, proj,
-               queries):
+               queries, *rt):
             return cagra._search_impl_walk(
                 dataset, table, entry_proj, entry_sq, entry_ids, proj,
                 queries, k, itopk, search_width, max_iter, metric,
-                rerank, deg)
+                rerank, deg, filter_words=rt[0] if nfw else None)
 
         arrays = (index.dataset, cache.table, cache.entry_proj,
                   cache.entry_sq, cache.entry_ids, cache.proj)
 
     example_q = jax.ShapeDtypeStruct((batch, index.dim),
                                      index.dataset.dtype)
+    runtime = ((example_q, jax.ShapeDtypeStruct((batch, nfw), np.int32))
+               if nfw else example_q)
     buf = io.BytesIO()
-    save_search_fn(buf, fn, arrays, example_q)
+    save_search_fn(buf, fn, arrays, runtime)
     buf.seek(0)
     return buf

@@ -65,6 +65,7 @@ from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import ensure_array
 from raft_tpu.core.tracing import range as named_range
 from raft_tpu.distance.types import DistanceType
+from raft_tpu.filters import bitset as _fbits
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.neighbors import grouped
 from raft_tpu.neighbors import ivf_pq
@@ -564,19 +565,27 @@ def _build_spmd(handle, params: ivf_pq.IndexParams, dataset, mesh, axis,
 @functools.partial(jax.jit, static_argnames=("k", "n_probes", "metric",
                                              "axis_name", "mesh", "failed"))
 def _dist_search(index_leaves, queries, k, n_probes, metric, axis_name,
-                 mesh, failed=()):
+                 mesh, failed=(), filter_words=None):
     # only the leaves the recon search kernel consumes are threaded through
     specs = tuple(P(axis_name, *([None] * (leaf.ndim - 1)))
                   for leaf in index_leaves)
+    # filtered search (round 20): the bitset addresses GLOBAL row ids —
+    # exactly what every shard's list_indices store — so one replicated
+    # (q, n_words) buffer serves all shards unsliced.  Presence is part
+    # of the trace signature: the unfiltered graph is unchanged.
+    has_f = filter_words is not None
+    in_specs = (specs, P()) + ((P(),) if has_f else ())
+    out_specs = (P(), P()) + ((P(),) if has_f else ())
 
     @functools.partial(shard_map, mesh=mesh,
-                       in_specs=(specs, P()), out_specs=(P(), P()),
+                       in_specs=in_specs, out_specs=out_specs,
                        check_vma=False)
-    def run(leaves, q):
+    def run(leaves, q, *rest):
         centers, list_indices, rotation, list_recon = leaves
         ld, li = ivf_pq._search_impl_recon(
             centers[0], list_recon[0], list_indices[0], rotation[0], q,
-            k, n_probes, metric)
+            k, n_probes, metric,
+            filter_words=rest[0] if has_f else None)
         select_min = metric != DistanceType.InnerProduct
         if failed:
             # degraded mode: a failed shard contributes only sentinel
@@ -592,12 +601,21 @@ def _dist_search(index_leaves, queries, k, n_probes, metric, axis_name,
         all_d = jax.lax.all_gather(ld, axis_name)   # (n_dev, q, k)
         all_i = jax.lax.all_gather(li, axis_name)
         nq = q.shape[0]
-        return select_k(
+        md, mi = select_k(
             jnp.transpose(all_d, (1, 0, 2)).reshape(nq, -1), k,
             in_idx=jnp.transpose(all_i, (1, 0, 2)).reshape(nq, -1),
             select_min=select_min)
+        if has_f:
+            # per-shard admitted-row counter: candidates this shard
+            # contributed to the exchange after the admission fold
+            # (starved slots are already id -1)
+            admitted = jax.lax.all_gather(
+                jnp.sum((li >= 0).astype(jnp.int32)), axis_name)
+            return md, mi, admitted
+        return md, mi
 
-    return run(index_leaves, queries)
+    args = (index_leaves, queries) + ((filter_words,) if has_f else ())
+    return run(*args)
 
 
 def _recon_sq_stack(index: DistributedIndex) -> jax.Array:
@@ -637,7 +655,8 @@ def _merge_gathered(ld, li, q, k, metric, axis_name, failed):
     "form", "use_pallas", "merge_window", "failed"))
 def _dist_search_grouped(index_leaves, queries, k, kt, n_probes, metric,
                          axis_name, mesh, n_groups, form,
-                         use_pallas=False, merge_window=1, failed=()):
+                         use_pallas=False, merge_window=1, failed=(),
+                         filter_words=None):
     """Data-parallel grouped/fused scan under ``shard_map`` (round 10):
     every shard runs the SAME formulation ladder the single-index search
     picks, at the worst-case static group capacity — the capacity is a
@@ -645,12 +664,16 @@ def _dist_search_grouped(index_leaves, queries, k, kt, n_probes, metric,
     and this jitted function carries no overflow plumbing at all."""
     specs = tuple(P(axis_name, *([None] * (leaf.ndim - 1)))
                   for leaf in index_leaves)
+    has_f = filter_words is not None
+    in_specs = (specs, P()) + ((P(),) if has_f else ())
+    out_specs = (P(), P()) + ((P(),) if has_f else ())
 
     @functools.partial(shard_map, mesh=mesh,
-                       in_specs=(specs, P()), out_specs=(P(), P()),
+                       in_specs=in_specs, out_specs=out_specs,
                        check_vma=False)
-    def run(leaves, q):
+    def run(leaves, q, *rest):
         centers, list_recon, list_recon_sq, list_indices, rotation = leaves
+        fw = rest[0] if has_f else None
         probes = ivf_pq._select_clusters(centers[0], rotation[0], q,
                                          n_probes, metric)
         cap, rot = list_recon.shape[2], list_recon.shape[3]
@@ -658,7 +681,7 @@ def _dist_search_grouped(index_leaves, queries, k, kt, n_probes, metric,
             ld, li = ivf_pq._search_impl_fused_recon_grouped(
                 centers[0], list_recon[0], list_recon_sq[0],
                 list_indices[0], rotation[0], q, probes, k, kt, metric,
-                n_groups, merge_window=merge_window)
+                n_groups, merge_window=merge_window, filter_words=fw)
         else:
             G = grouped.GROUP
             block = grouped.block_size(n_groups, G * cap * 8,
@@ -666,10 +689,17 @@ def _dist_search_grouped(index_leaves, queries, k, kt, n_probes, metric,
             ld, li = ivf_pq._search_impl_recon_grouped(
                 centers[0], list_recon[0], list_recon_sq[0],
                 list_indices[0], rotation[0], q, probes, k, metric,
-                n_groups, block, use_pallas=use_pallas, kt=kt)
-        return _merge_gathered(ld, li, q, k, metric, axis_name, failed)
+                n_groups, block, use_pallas=use_pallas, kt=kt,
+                filter_words=fw)
+        md, mi = _merge_gathered(ld, li, q, k, metric, axis_name, failed)
+        if has_f:
+            admitted = jax.lax.all_gather(
+                jnp.sum((li >= 0).astype(jnp.int32)), axis_name)
+            return md, mi, admitted
+        return md, mi
 
-    return run(index_leaves, queries)
+    args = (index_leaves, queries) + ((filter_words,) if has_f else ())
+    return run(*args)
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -677,26 +707,36 @@ def _dist_search_grouped(index_leaves, queries, k, kt, n_probes, metric,
     "axis_name", "mesh", "failed"))
 def _dist_search_lut(index_leaves, queries, k, n_probes, metric,
                      codebook_kind, lut_dtype, pq_bits, axis_name, mesh,
-                     failed=()):
+                     failed=(), filter_words=None):
     """Data-parallel LUT scan under ``shard_map``: the traceable LUT
     formulation computes the same quantized distance the codes kernel
     streams, so a ``codes``/``lut`` request answers with code-domain
     distances instead of lowering to the recon scan."""
     specs = tuple(P(axis_name, *([None] * (leaf.ndim - 1)))
                   for leaf in index_leaves)
+    has_f = filter_words is not None
+    in_specs = (specs, P()) + ((P(),) if has_f else ())
+    out_specs = (P(), P()) + ((P(),) if has_f else ())
 
     @functools.partial(shard_map, mesh=mesh,
-                       in_specs=(specs, P()), out_specs=(P(), P()),
+                       in_specs=in_specs, out_specs=out_specs,
                        check_vma=False)
-    def run(leaves, q):
+    def run(leaves, q, *rest):
         centers, codebooks, list_codes, list_indices, rotation = leaves
         ld, li = ivf_pq._search_impl(
             centers[0], codebooks[0], list_codes[0], list_indices[0],
             rotation[0], q, k, n_probes, metric, codebook_kind,
-            lut_dtype, pq_bits=pq_bits)
-        return _merge_gathered(ld, li, q, k, metric, axis_name, failed)
+            lut_dtype, pq_bits=pq_bits,
+            filter_words=rest[0] if has_f else None)
+        md, mi = _merge_gathered(ld, li, q, k, metric, axis_name, failed)
+        if has_f:
+            admitted = jax.lax.all_gather(
+                jnp.sum((li >= 0).astype(jnp.int32)), axis_name)
+            return md, mi, admitted
+        return md, mi
 
-    return run(index_leaves, queries)
+    args = (index_leaves, queries) + ((filter_words,) if has_f else ())
+    return run(*args)
 
 
 def ground_truth_params(index, params=None) -> ivf_pq.SearchParams:
@@ -729,7 +769,8 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
            health=None,
            shard_deadline_s: Optional[float] = None,
            hedge: bool = True,
-           routing=None):
+           routing=None,
+           filter=None):
     """Sharded search + merge; returns replicated (distances, global ids)
     of shape (q, k).  Accepts both placements: a
     :class:`DistributedIndex` (data-parallel full-shard scan) or a
@@ -822,6 +863,21 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
     also hands the policy each batch's in-graph per-list probe
     histogram (``observe_probes`` — a lazy device array, no host sync)
     for probe-frequency-aware rebalancing.
+
+    ``filter`` (round 20): a :class:`raft_tpu.filters.SampleFilter` (or
+    a ``(q, n_rows)`` bool mask) over GLOBAL row ids.  The packed
+    ``(q, n_words)`` bitset is broadcast replicated alongside the
+    queries — shards consume it unsliced because their ``list_indices``
+    store global ids, so the admission fold commutes with both
+    placements, replica failover, and hedging (replica copies scan
+    identical rows).  Filtered full-probe results are bit-identical to
+    a post-hoc-filtered exact scan; starved slots pad with ``(inf,
+    -1)``.  The filter is data, not shape: varying filters reuse the
+    warmed executable, and presence/absence is a separate trace.  Each
+    shard's admitted-candidate count rides the existing gather — with
+    ``return_stats=True`` the stats dict gains ``admitted_rows``, and
+    the lazy per-shard vector is annotated on the ambient trace as
+    ``distributed.admitted_rows``.
     """
     with named_range("distributed::ivf_pq_search"):
         expects(handle.comms_initialized(),
@@ -837,6 +893,7 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
                 set(failed) | set(health.failed_shards())))
         nq = int(queries.shape[0])
         k = int(k)
+        fw = _fbits.query_filter_words(filter, nq, "distributed.ann.search")
         routed = isinstance(index, RoutedIndex)
         rec = _rtrace.current()
         rf = (index.placement.replication_factor
@@ -986,6 +1043,7 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
                                  n_shards=index.n_shards)
         scanned = None
         phist = None  # per-list probe histogram (routed; lazy device)
+        admitted = None  # per-shard admitted-candidate counts (filtered)
         # lifecycle-boundary kill site: a shard killed here (mid-scan)
         # keeps this search's pre-kill routing — its in-flight answer
         # completes — and the NEXT search routes around it
@@ -1003,13 +1061,17 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
                     replicated = replicated[:2] + (
                         _replicate(jnp.asarray(eff[0]), handle.mesh),
                         _replicate(jnp.asarray(eff[1]), handle.mesh))
-                d, i, scanned, phist = _entry(
+                out = _entry(
                     "distributed.ann.search",
                     lambda: _dist_search_routed(
                         sharded, replicated, queries, k, n_probes,
                         index.metric, comms.axis_name, handle.mesh,
-                        failed=residual),
+                        failed=residual, filter_words=fw),
                     retry_policy, deadline)
+                if fw is not None:
+                    d, i, scanned, phist, admitted = out
+                else:
+                    d, i, scanned, phist = out
             else:
                 sharded, replicated = _routed_leaves(index, r.form)
                 if eff is not None:
@@ -1019,14 +1081,16 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
                     ) + replicated[4:]
 
                 def dispatch(ng):
-                    return _dist_search_routed_grouped(
+                    out = _dist_search_routed_grouped(
                         sharded, replicated, queries, k, r.kt, n_probes,
                         index.metric, comms.axis_name, handle.mesh, ng,
                         r.form, pq_bits=int(index.pq_bits),
                         use_pallas=r.use_pallas,
-                        merge_window=r.merge_window, failed=residual)
+                        merge_window=r.merge_window, failed=residual,
+                        filter_words=fw)
+                    return out if fw is not None else out + (None,)
 
-                d, i, scanned, needed, phist = _entry(
+                d, i, scanned, needed, phist, admitted = _entry(
                     "distributed.ann.search",
                     lambda: dispatch(r.n_groups), retry_policy, deadline)
                 if not r.exact:
@@ -1046,41 +1110,47 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
                             "ivf_pq.group_overflow",
                             trace_id=rec.trace_id if rec else None,
                             calibrated_groups=r.n_groups, worst=worst)
-                        d, i, scanned, needed, phist = dispatch(worst)
+                        (d, i, scanned, needed, phist,
+                         admitted) = dispatch(worst)
         elif r.form == "probe_recon":
             leaves = (index.centers, index.list_indices, index.rotation,
                       index.list_recon)
-            d, i = _entry(
+            out = _entry(
                 "distributed.ann.search",
                 lambda: _dist_search(leaves, queries, k, n_probes,
                                      index.metric, comms.axis_name,
-                                     handle.mesh, failed=residual),
+                                     handle.mesh, failed=residual,
+                                     filter_words=fw),
                 retry_policy, deadline)
+            (d, i, admitted) = out if fw is not None else out + (None,)
         elif r.form == "lut":
             leaves = (index.centers, index.codebooks, index.list_codes,
                       index.list_indices, index.rotation)
             lut_dtype = jnp.dtype(
                 getattr(params, "lut_dtype", jnp.float32)).name
-            d, i = _entry(
+            out = _entry(
                 "distributed.ann.search",
                 lambda: _dist_search_lut(
                     leaves, queries, k, n_probes, index.metric,
                     index.codebook_kind, lut_dtype,
                     int(index.pq_bits), comms.axis_name, handle.mesh,
-                    failed=residual),
+                    failed=residual, filter_words=fw),
                 retry_policy, deadline)
+            (d, i, admitted) = out if fw is not None else out + (None,)
         else:
             leaves = (index.centers, index.list_recon,
                       _recon_sq_stack(index), index.list_indices,
                       index.rotation)
-            d, i = _entry(
+            out = _entry(
                 "distributed.ann.search",
                 lambda: _dist_search_grouped(
                     leaves, queries, k, r.kt, n_probes, index.metric,
                     comms.axis_name, handle.mesh, r.n_groups, r.form,
                     use_pallas=r.use_pallas,
-                    merge_window=r.merge_window, failed=residual),
+                    merge_window=r.merge_window, failed=residual,
+                    filter_words=fw),
                 retry_policy, deadline)
+            (d, i, admitted) = out if fw is not None else out + (None,)
         # lifecycle-boundary kill site: post-dispatch, pre-merge-return
         # — a kill here lands after the candidate gather, next search
         # sees the shard down
@@ -1090,6 +1160,15 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
             # stores the reference without fetching it (no host sync on
             # the dispatch path — flight.dump() materializes it later)
             rec.annotate("distributed.scanned_rows", scanned)
+        if fw is not None:
+            from raft_tpu import observability as obs
+            if obs.enabled():
+                obs.registry().counter(
+                    "distributed.ann.search.filtered").inc()
+            if rec is not None and admitted is not None:
+                # lazy, like scanned_rows: per-shard admitted-candidate
+                # counts ride the existing candidate gather
+                rec.annotate("distributed.admitted_rows", admitted)
         if routing is not None and phist is not None:
             # the probe-frequency counters: the policy retains the lazy
             # device histogram; materialization happens only in its
@@ -1111,10 +1190,14 @@ def search(handle, params: ivf_pq.SearchParams, index, queries, k: int, *,
                 # graftlint: disable=host-sync -- opt-in stats readback (return_stats=True), not the serving dispatch
                 per = np.asarray(scanned, np.int64)
             gather = (index.n_shards, nq, k)
-            out.append({"scanned_rows": per, "gather_shape": gather,
-                        "scan_mode": {"probe_recon": "recon"}.get(
-                            r.form, r.form),
-                        "n_probes": int(n_probes)})
+            stats = {"scanned_rows": per, "gather_shape": gather,
+                     "scan_mode": {"probe_recon": "recon"}.get(
+                         r.form, r.form),
+                     "n_probes": int(n_probes)}
+            if admitted is not None:
+                # graftlint: disable=host-sync -- opt-in stats readback (return_stats=True), not the serving dispatch
+                stats["admitted_rows"] = np.asarray(admitted, np.int64)
+            out.append(stats)
         return tuple(out) if len(out) > 2 else (d, i)
 
 
@@ -1610,16 +1693,22 @@ def route_vectors(index: RoutedIndex, vectors) -> np.ndarray:
 @functools.partial(jax.jit, static_argnames=("k", "n_probes", "metric",
                                              "axis_name", "mesh", "failed"))
 def _dist_search_routed(sharded, replicated, queries, k, n_probes, metric,
-                        axis_name, mesh, failed=()):
+                        axis_name, mesh, failed=(), filter_words=None):
     sspecs = tuple(P(axis_name, *([None] * (leaf.ndim - 1)))
                    for leaf in sharded)
     rspecs = tuple(P() for _ in replicated)
+    # the bitset addresses GLOBAL ids — the routed list_indices store
+    # exactly those, so one replicated buffer serves every shard and the
+    # replica-failover table swaps compose unchanged (replica copies are
+    # identical rows, so the admission fold commutes with routing)
+    has_f = filter_words is not None
+    in_specs = (sspecs, rspecs, P()) + ((P(),) if has_f else ())
+    out_specs = (P(),) * (5 if has_f else 4)
 
     @functools.partial(shard_map, mesh=mesh,
-                       in_specs=(sspecs, rspecs, P()),
-                       out_specs=(P(), P(), P(), P()),
+                       in_specs=in_specs, out_specs=out_specs,
                        check_vma=False)
-    def run(sl, rl, q):
+    def run(sl, rl, q, *rest):
         local_centers, list_recon, list_recon_sq, list_indices = sl
         coarse, rot, owner, local_slot = rl
         s = jax.lax.axis_index(axis_name)
@@ -1643,7 +1732,8 @@ def _dist_search_routed(sharded, replicated, queries, k, n_probes, metric,
         ld, li = ivf_pq._search_impl_recon(
             local_centers[0], list_recon[0], list_indices[0], rot, q,
             k, n_probes, metric, probes=local_probes,
-            list_recon_sq=list_recon_sq[0])
+            list_recon_sq=list_recon_sq[0],
+            filter_words=rest[0] if has_f else None)
         select_min = metric != DistanceType.InnerProduct
         scanned = (jnp.sum(owned.astype(jnp.int32)) * cap).astype(
             jnp.int32)
@@ -1670,9 +1760,15 @@ def _dist_search_routed(sharded, replicated, queries, k, n_probes, metric,
             jnp.transpose(all_d, (1, 0, 2)),
             jnp.transpose(all_i, (1, 0, 2)),
             nq, k, select_min, False, select_k)
+        if has_f:
+            admitted = jax.lax.all_gather(
+                jnp.sum((li >= 0).astype(jnp.int32)), axis_name)
+            return md, mi, all_scanned, hist, admitted
         return md, mi, all_scanned, hist
 
-    return run(sharded, replicated, queries)
+    args = (sharded, replicated, queries) + (
+        (filter_words,) if has_f else ())
+    return run(*args)
 
 
 def _routed_leaves(index: "RoutedIndex", form: str):
@@ -1700,7 +1796,7 @@ def _dist_search_routed_grouped(sharded, replicated, queries, k, kt,
                                 n_probes, metric, axis_name, mesh,
                                 n_groups, form, pq_bits=0,
                                 use_pallas=False, merge_window=1,
-                                failed=()):
+                                failed=(), filter_words=None):
     """Routed (by_list) grouped/fused scan under ``shard_map``
     (round 10): the tentpole dispatch.  Replicated coarse routing picks
     the probe set, ownership maps it to local slots, and the shard scans
@@ -1715,13 +1811,16 @@ def _dist_search_routed_grouped(sharded, replicated, queries, k, kt,
     sspecs = tuple(P(axis_name, *([None] * (leaf.ndim - 1)))
                    for leaf in sharded)
     rspecs = tuple(P() for _ in replicated)
+    has_f = filter_words is not None
+    in_specs = (sspecs, rspecs, P()) + ((P(),) if has_f else ())
+    out_specs = (P(),) * (6 if has_f else 5)
 
     @functools.partial(shard_map, mesh=mesh,
-                       in_specs=(sspecs, rspecs, P()),
-                       out_specs=(P(), P(), P(), P(), P()),
+                       in_specs=in_specs, out_specs=out_specs,
                        check_vma=False)
-    def run(sl, rl, q):
+    def run(sl, rl, q, *rest):
         local_centers, data, rownorm, list_indices = sl
+        fw = rest[0] if has_f else None
         coarse, rot, owner, local_slot = rl[:4]
         s = jax.lax.axis_index(axis_name)
         slots = local_centers.shape[1]
@@ -1745,12 +1844,13 @@ def _dist_search_routed_grouped(sharded, replicated, queries, k, kt,
             ld, li = ivf_pq._search_impl_fused_codes_grouped(
                 local_centers[0], rl[4], data[0], rownorm[0],
                 list_indices[0], rot, q, local_probes, k, kt, metric,
-                n_groups, pq_bits, merge_window=merge_window)
+                n_groups, pq_bits, merge_window=merge_window,
+                filter_words=fw)
         elif form == "fused_recon":
             ld, li = ivf_pq._search_impl_fused_recon_grouped(
                 local_centers[0], data[0], rownorm[0], list_indices[0],
                 rot, q, local_probes, k, kt, metric, n_groups,
-                merge_window=merge_window)
+                merge_window=merge_window, filter_words=fw)
         else:
             rot_dim = data.shape[3]
             G = grouped.GROUP
@@ -1759,7 +1859,7 @@ def _dist_search_routed_grouped(sharded, replicated, queries, k, kt,
             ld, li = ivf_pq._search_impl_recon_grouped(
                 local_centers[0], data[0], rownorm[0], list_indices[0],
                 rot, q, local_probes, k, metric, n_groups, block,
-                use_pallas=use_pallas, kt=kt)
+                use_pallas=use_pallas, kt=kt, filter_words=fw)
         select_min = metric != DistanceType.InnerProduct
         scanned = (jnp.sum(owned.astype(jnp.int32)) * cap).astype(
             jnp.int32)
@@ -1782,9 +1882,15 @@ def _dist_search_routed_grouped(sharded, replicated, queries, k, kt,
             jnp.transpose(all_d, (1, 0, 2)),
             jnp.transpose(all_i, (1, 0, 2)),
             nq, k, select_min, False, select_k)
+        if has_f:
+            admitted = jax.lax.all_gather(
+                jnp.sum((li >= 0).astype(jnp.int32)), axis_name)
+            return md, mi, all_scanned, all_needed, hist, admitted
         return md, mi, all_scanned, all_needed, hist
 
-    return run(sharded, replicated, queries)
+    args = (sharded, replicated, queries) + (
+        (filter_words,) if has_f else ())
+    return run(*args)
 
 
 def rebalance_placement(handle, index: RoutedIndex, *,

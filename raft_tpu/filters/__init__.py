@@ -1,0 +1,22 @@
+"""Filtered & multi-tenant search — per-query admission bitsets.
+
+Reference: cpp/include/raft/neighbors/sample_filter{,_types}.hpp (the
+``sample_filter`` hook on ivf_pq/ivf_flat search).  See docs/api.md,
+"Filtered search & tenancy" for the bitset layout, the kernel admission
+seam, and the selectivity cost model.
+"""
+
+from raft_tpu.filters.bitset import (  # noqa: F401
+    BITS_PER_WORD,
+    SampleFilter,
+    as_filter,
+    group_admission_words,
+    n_words_for,
+    pack_mask,
+    query_bits,
+    query_filter_words,
+    unpack_words,
+)
+from raft_tpu.filters.tenant import TenantFilter  # noqa: F401
+from raft_tpu.filters import hybrid  # noqa: F401
+from raft_tpu.filters.hybrid import candidates_to_filter  # noqa: F401

@@ -1,0 +1,65 @@
+"""Hybrid dense+sparse retrieval — lexical candidates as an admission set.
+
+The classic two-tower hybrid: a sparse (lexical/BM25-like) pass over CSR
+term vectors proposes per-query candidate ids, and the dense IVF-PQ scan
+re-ranks *only those* — expressed here as a bitset filter, so the fused
+kernels do the intersection for free through the same admission seam as
+predicate filters.  The dense scan stays at full fidelity over the
+admitted set, and the result is bit-identical to brute-forcing the
+admitted ids (the filtered-parity contract).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import numpy as np
+
+from raft_tpu.core.error import expects
+from raft_tpu.filters.bitset import SampleFilter, n_words_for
+from raft_tpu.sparse.formats import CsrMatrix
+
+
+def candidates_to_filter(sparse_ids, n_rows: int) -> SampleFilter:
+    """Per-query candidate id lists -> admission bitset.
+
+    ``sparse_ids`` is (nq, k_sparse) int; negative ids (select_k padding
+    when a query matched fewer than k_sparse rows) are skipped.
+    """
+    ids = np.asarray(sparse_ids, np.int64)
+    expects(ids.ndim == 2, "hybrid: sparse_ids must be (nq, k_sparse)")
+    nq = ids.shape[0]
+    words = np.zeros((nq, n_words_for(n_rows)), np.uint32)
+    for q in range(nq):
+        row = ids[q]
+        row = row[(row >= 0) & (row < n_rows)]
+        np.bitwise_or.at(words[q], row >> 5,
+                         np.uint32(1) << (row & 31).astype(np.uint32))
+    return SampleFilter.from_words(words.view(np.int32), n_rows)
+
+
+def search(res, params, index, queries, k: int, *,
+           sparse_queries: CsrMatrix, sparse_database: CsrMatrix,
+           k_sparse: int, sparse_metric: int = None
+           ) -> Tuple[jax.Array, jax.Array]:
+    """Hybrid search: sparse lexical candidate generation fused into the
+    dense IVF-PQ scan as a per-query filter.
+
+    ``sparse_queries``/``sparse_database`` are the lexical (e.g. tf-idf)
+    CSR representations of the same corpus the dense index was built
+    from — database row r must be dense id r.  ``k_sparse`` is the
+    candidate budget per query (the selectivity knob: recall of the
+    hybrid result is bounded by sparse candidate recall).
+    """
+    from raft_tpu.distance.types import DistanceType
+    from raft_tpu.neighbors import ivf_pq
+    from raft_tpu.sparse.neighbors import brute_force_knn_sparse
+
+    if sparse_metric is None:
+        sparse_metric = DistanceType.InnerProduct
+    _, cand = brute_force_knn_sparse(sparse_queries, sparse_database,
+                                     k_sparse, metric=sparse_metric)
+    filt = candidates_to_filter(np.asarray(cand),
+                                int(sparse_database.shape[0]))
+    return ivf_pq.search(res, params, index, queries, k, filter=filt)

@@ -90,7 +90,7 @@ def make(res, dataset, *, metric: int, n_queries: int = DEFAULT_QUERIES,
                      gt_ids=np.asarray(gt, np.int32), floor=float(floor))
 
 
-def _search_canaries(res, index, cs: CanarySet) -> np.ndarray:
+def _search_canaries(res, index, cs: CanarySet, filter=None) -> np.ndarray:
     """Re-search the sentinels on ``index``; returns (c, k) found ids."""
     from raft_tpu.core.outputs import raw
     from raft_tpu.neighbors import cagra, ivf_flat, ivf_pq
@@ -98,27 +98,27 @@ def _search_canaries(res, index, cs: CanarySet) -> np.ndarray:
     q = jnp.asarray(cs.queries)
     if isinstance(index, ivf_flat.Index):
         p = ivf_flat.SearchParams(n_probes=min(32, index.n_lists))
-        _, ids = raw(ivf_flat.search)(res, p, index, q, cs.k)
+        _, ids = raw(ivf_flat.search)(res, p, index, q, cs.k, filter=filter)
     elif isinstance(index, ivf_pq.Index):
         p = ivf_pq.SearchParams(n_probes=min(32, index.n_lists))
-        _, ids = raw(ivf_pq.search)(res, p, index, q, cs.k)
+        _, ids = raw(ivf_pq.search)(res, p, index, q, cs.k, filter=filter)
     elif isinstance(index, cagra.Index):
         _, ids = raw(cagra.search)(res, cagra.SearchParams(), index, q,
-                                   cs.k)
+                                   cs.k, filter=filter)
     elif type(index).__name__ == "RoutedIndex":
         # by_list distributed index (lazy import: integrity must not pull
         # the comms fabric in); ``res`` is the worker handle here — the
         # routed health check passes it through
         from raft_tpu.distributed import ann as _dann
         p = ivf_pq.SearchParams(n_probes=min(32, index.n_lists))
-        _, ids = _dann.search(res, p, index, q, cs.k)
+        _, ids = _dann.search(res, p, index, q, cs.k, filter=filter)
     else:
         raise TypeError(
             f"health_check: unsupported index type {type(index).__name__}")
     return np.asarray(ids)
 
 
-def measure(res, index, cs: CanarySet) -> float:
+def measure(res, index, cs: CanarySet, *, filter=None) -> float:
     """Canary recall of ``index`` against the stored ground truth.
 
     Deleted rows (tombstones in the IVF ``list_indices``, or a graph
@@ -126,14 +126,33 @@ def measure(res, index, cs: CanarySet) -> float:
     ground-truth sets and the denominator: a delete legitimately removes
     stored neighbors, and counting them as misses would fail the floor
     for a perfectly healthy index.  An index whose every ground-truth id
-    was deleted measures 1.0 (nothing left to find)."""
+    was deleted measures 1.0 (nothing left to find).
+
+    ``filter`` (round 20, the filtered variant): a
+    :class:`~raft_tpu.filters.SampleFilter` applied to BOTH sides — the
+    sentinel search runs under the filter, and inadmissible ids leave
+    the ground-truth sets and the denominator, exactly like tombstones.
+    Measures that the admission seam preserves recall over the admitted
+    set rather than penalizing the filter itself."""
     from raft_tpu.neighbors import mutate as _mutate
 
-    found = _search_canaries(res, index, cs)
+    found = _search_canaries(res, index, cs, filter=filter)
     dropped = _mutate.deleted_ids(index)
+    admitted = None
+    if filter is not None:
+        from raft_tpu.filters import bitset as _fb
+        mask = np.asarray(_fb.unpack_words(jnp.asarray(filter.words),
+                                           filter.n_rows)) != 0
+        if mask.shape[0] == 1:
+            mask = np.broadcast_to(mask, (cs.n_queries, mask.shape[1]))
+        admitted = mask
     hits = total = 0
-    for f, t in zip(found, cs.gt_ids):
+    for row, (f, t) in enumerate(zip(found, cs.gt_ids)):
         gt = set(t.tolist()) - dropped if dropped else set(t.tolist())
+        if admitted is not None:
+            adm = admitted[row]
+            cov = adm.shape[0]
+            gt = {i for i in gt if i < cov and adm[i]}
         total += len(gt)
         hits += len(set(f.tolist()) & gt)
     return hits / total if total else 1.0

@@ -133,6 +133,42 @@ def _verify_ids_in_range(kind: str, list_indices: np.ndarray,
               f"{sl} is >= the index's {n_rows} rows", coord=(li, sl))
 
 
+def _verify_namespaces(kind: str, live_ids: np.ndarray,
+                       namespaces) -> None:
+    """Tenant-namespace invariants (round 20): the declared id ranges
+    must be pairwise disjoint, and every live id must fall inside some
+    declared tenant's range — otherwise a filtered search could leak a
+    row to the wrong tenant (or to nobody).  ``namespaces`` is a
+    :class:`raft_tpu.filters.TenantFilter`; violations raise the typed
+    error naming the violating (tenant, id)."""
+    spans = sorted((int(lo), int(hi), t)
+                   for t, (lo, hi) in namespaces.ranges.items())
+    for (lo, hi, t) in spans:
+        if not (0 <= lo <= hi):
+            _fail("namespace.range",
+                  f"tenant {t!r} declares an invalid id range "
+                  f"[{lo}, {hi})", coord=(t, lo))
+    for (lo0, hi0, t0), (lo1, hi1, t1) in zip(spans, spans[1:]):
+        if hi0 > lo1:
+            _fail("namespace.disjoint",
+                  f"tenant ranges overlap: {t0!r} [{lo0},{hi0}) and "
+                  f"{t1!r} [{lo1},{hi1}) — an id in the overlap would "
+                  f"serve two tenants", coord=(t0, t1))
+    live = np.unique(live_ids[live_ids >= 0].astype(np.int64))
+    if live.size == 0:
+        return
+    los = np.asarray([s[0] for s in spans], np.int64)
+    his = np.asarray([s[1] for s in spans], np.int64)
+    j = np.searchsorted(los, live, side="right") - 1
+    inside = (j >= 0) & (live < his[np.clip(j, 0, len(his) - 1)])
+    if not inside.all():
+        i = int(live[int(np.argmin(inside))])
+        _fail("namespace.coverage",
+              f"{kind}: live id {i} falls outside every declared tenant "
+              f"namespace — it is unreachable under tenant filtering",
+              coord=(namespaces.owner_of(i), i))
+
+
 def _verify_finite(kind: str, name: str, arr: np.ndarray) -> None:
     fin = np.isfinite(arr)
     if not fin.all():
@@ -377,7 +413,7 @@ def _verify_cagra(index, level: str) -> None:
 # ---------------------------------------------------------------------------
 
 def verify(index, level: str = "structural", *, res=None,
-           n_rows=None) -> None:
+           n_rows=None, namespaces=None) -> None:
     """Verify every invariant of ``index`` at the given level; raises
     :class:`IntegrityError` naming the first violation.  ``level="full"``
     additionally runs the recall canary and therefore requires ``res``
@@ -386,7 +422,13 @@ def verify(index, level: str = "structural", *, res=None,
     ``n_rows`` overrides the id-space bound for the source-id range
     check; the default assumes the build convention (ids are exactly
     ``0..sum(list_sizes)-1``).  Pass the true universe size for indexes
-    extended with custom ids."""
+    extended with custom ids.
+
+    ``namespaces`` (round 20): a :class:`raft_tpu.filters.TenantFilter`
+    declaring the tenant id ranges the index serves under — checked for
+    pairwise disjointness and full coverage of every live id (invariants
+    ``namespace.disjoint`` / ``namespace.coverage``, coord = the
+    violating (tenant, id))."""
     from raft_tpu.neighbors import cagra, ivf_flat, ivf_pq
 
     if level not in _LEVELS:
@@ -397,10 +439,23 @@ def verify(index, level: str = "structural", *, res=None,
     with obs.stage("verify"):
         if isinstance(index, ivf_flat.Index):
             _verify_ivf_flat(index, level, n_rows)
+            if namespaces is not None:
+                _verify_namespaces("ivf_flat",
+                                   np.asarray(index.list_indices),
+                                   namespaces)
         elif isinstance(index, ivf_pq.Index):
             _verify_ivf_pq(index, level, n_rows)
+            if namespaces is not None:
+                _verify_namespaces("ivf_pq",
+                                   np.asarray(index.list_indices),
+                                   namespaces)
         elif isinstance(index, cagra.Index):
             _verify_cagra(index, level)
+            if namespaces is not None:
+                # cagra ids are implicit dataset row positions
+                _verify_namespaces(
+                    "cagra", np.arange(index.size, dtype=np.int64),
+                    namespaces)
         else:
             raise TypeError(
                 f"verify: unsupported index type {type(index).__name__}")

@@ -28,6 +28,7 @@ from raft_tpu.integrity import boundary as _boundary
 from raft_tpu.core.tracing import range as named_range
 from raft_tpu.distance.pairwise import pairwise_distance
 from raft_tpu.distance.types import DistanceType
+from raft_tpu.filters import bitset as _fbits
 from raft_tpu.matrix.select_k import merge_topk, select_k
 from raft_tpu.core.outputs import auto_convert_output, raw
 
@@ -35,7 +36,8 @@ _TILE_N = 8192
 
 
 @functools.partial(jax.jit, static_argnames=("k", "metric", "tile_n"))
-def _knn_impl(database, queries, k, metric, metric_arg, tile_n):
+def _knn_impl(database, queries, k, metric, metric_arg, tile_n,
+              filter_words=None, id_offset=None):
     n, dim = database.shape
     nq = queries.shape[0]
     select_min = metric != DistanceType.InnerProduct
@@ -55,6 +57,16 @@ def _knn_impl(database, queries, k, metric, metric_arg, tile_n):
                               metric_arg=metric_arg).astype(jnp.float32)
         valid = (t * tile_n + jnp.arange(tile_n)) < n
         d = jnp.where(valid[None, :], d, worst)
+        if filter_words is not None:
+            # admission by GLOBAL id: row j of tile t is global id
+            # id_offset + t*tile_n + j — the id space the filter (and a
+            # sharded caller's global_id_offset) declares
+            gids = (id_offset + t * tile_n
+                    + jnp.arange(tile_n, dtype=jnp.int32))
+            adm = _fbits.query_bits(
+                filter_words, jnp.arange(nq),
+                jnp.broadcast_to(gids[None, :], (nq, tile_n)))
+            d = jnp.where(adm > 0, d, worst)
         kt = min(k, tile_n)
         td, ti = select_k(d, kt, select_min=select_min)
         ti = ti.astype(jnp.int32) + t * tile_n
@@ -63,6 +75,10 @@ def _knn_impl(database, queries, k, metric, metric_arg, tile_n):
 
     (best_d, best_i), _ = jax.lax.scan(
         step, init, (db_tiles, jnp.arange(n_tiles)))
+    if filter_words is not None:
+        # a query can now run out of admissible rows: surface the
+        # (worst, -1) sentinel rather than a positional id
+        best_i = jnp.where(best_d == worst, -1, best_i)
     return best_d, best_i
 
 
@@ -77,6 +93,7 @@ def knn(
     metric_arg: float = 2.0,
     global_id_offset: int = 0,
     tile_n: int = _TILE_N,
+    filter=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact kNN of ``queries`` (q, d) against ``database`` (n, d).
 
@@ -84,6 +101,13 @@ def knn(
     ``(distances (q, k), indices (q, k) int32)`` sorted best-first;
     ``global_id_offset`` shifts returned ids (the reference's translation
     argument for row-partitioned databases).
+
+    ``filter`` restricts the scan to admitted rows: a
+    :class:`raft_tpu.filters.SampleFilter` (or an (q, n) bool mask)
+    whose bit ``j`` admits GLOBAL id ``j`` — i.e. ids *after* the
+    ``global_id_offset`` shift, so a sharded caller can broadcast one
+    filter over the whole logical id space.  Slots with no admissible
+    row come back as ``(worst, -1)``.
     """
     with named_range("brute_force::knn"):
         database = ensure_array(database, "database")
@@ -95,10 +119,16 @@ def knn(
         queries, ok_rows = _boundary.check_matrix(
             queries, "queries", site="brute_force.knn",
             dim=database.shape[1])
+        fw = _fbits.query_filter_words(
+            filter, queries.shape[0], "brute_force.knn")
         tile = min(tile_n, database.shape[0])
-        d, i = _knn_impl(database, queries, k, metric, metric_arg, tile)
+        d, i = _knn_impl(database, queries, k, metric, metric_arg, tile,
+                         filter_words=fw,
+                         id_offset=jnp.int32(global_id_offset)
+                         if fw is not None else None)
         if global_id_offset:
-            i = i + global_id_offset
+            # -1 is the starved-slot sentinel under filtering; keep it
+            i = jnp.where(i >= 0, i + global_id_offset, i)
         if ok_rows is not None:
             d, i = _boundary.mask_search_outputs(
                 d, i, ok_rows,

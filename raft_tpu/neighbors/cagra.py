@@ -72,6 +72,7 @@ from raft_tpu import observability as obs
 from raft_tpu.integrity import boundary as _boundary
 from raft_tpu.integrity import canary as _canary
 from raft_tpu.distance.types import DistanceType
+from raft_tpu.filters import bitset as _fbits
 from raft_tpu.matrix import ops as matrix_ops
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.utils.precision import get_matmul_precision
@@ -1707,7 +1708,7 @@ def _search_impl_walk(dataset, table, entry_proj, entry_sq, entry_ids,
                       proj, queries, k, itopk, search_width,
                       max_iterations, metric, rerank, deg, quant=False,
                       scales=None, fused_hop=False, merge_window=0,
-                      pallas_interpret=False):
+                      pallas_interpret=False, filter_words=None):
     """Greedy walk over the packed neighborhood table.
 
     Walk distances are approximate (exact ||x||², PCA-projected bf16
@@ -1754,6 +1755,11 @@ def _search_impl_walk(dataset, table, entry_proj, entry_sq, entry_ids,
         d_e = q_sq[:, None] + entry_sq[None, :] - 2.0 * ip_e
     S = d_e.shape[1]
     ids_e = jnp.broadcast_to(entry_ids[None, :], (nq, S))
+    if filter_words is not None:
+        # inadmissible entry points must not seed the buffer: they could
+        # otherwise survive to the re-rank and be returned
+        adm_e = _fbits.query_bits(filter_words, jnp.arange(nq), ids_e)
+        d_e = jnp.where(adm_e > 0, d_e, worst)
     if S < itopk:
         pad = itopk - S
         d_e = jnp.concatenate(
@@ -1783,6 +1789,18 @@ def _search_impl_walk(dataset, table, entry_proj, entry_sq, entry_ids,
         nb_p, nb_sq, nb_id = _decode_neighborhood(rows, pdim, deg, quant,
                                                   scales)
         nb_id = jnp.where(parent_ok[:, :, None], nb_id, -1)
+        adm_words = None
+        if filter_words is not None:
+            # per-hop admission over this hop's wd candidates: rejected
+            # ids never enter the buffer, so they are neither returned
+            # nor expanded — under selective filters raise itopk /
+            # search_width to keep the walk connected
+            adm = _fbits.query_bits(filter_words, jnp.arange(nq),
+                                    nb_id.reshape(nq, wd))
+            if fused_hop:
+                adm_words = _fbits.pack_mask(adm > 0)
+            else:
+                nb_id = jnp.where(adm.reshape(nb_id.shape) > 0, nb_id, -1)
 
         if fused_hop:
             from raft_tpu.ops import cagra_hop_pallas as chp
@@ -1790,7 +1808,8 @@ def _search_impl_walk(dataset, table, entry_proj, entry_sq, entry_ids,
                 qp_t, q_sq, nb_p.reshape(nq, wd, pdim),
                 nb_sq.reshape(nq, wd), nb_id.reshape(nq, wd),
                 buf_d, buf_i, visited, itopk=itopk, ip_metric=ip_metric,
-                interpret=pallas_interpret, merge_window=merge_window)
+                interpret=pallas_interpret, merge_window=merge_window,
+                adm_words=adm_words)
             return buf_d, buf_i, visited, it + 1
 
         ipx = jnp.einsum("qp,qwdp->qwd", qp_t, nb_p,
@@ -1836,7 +1855,7 @@ def _search_impl_walk(dataset, table, entry_proj, entry_sq, entry_ids,
 @functools.partial(jax.jit, static_argnames=(
     "k", "itopk", "search_width", "max_iterations", "metric"))
 def _search_impl(dataset, graph, queries, seed_ids, k, itopk, search_width,
-                 max_iterations, metric):
+                 max_iterations, metric, filter_words=None):
     nq = queries.shape[0]
     n, dim = dataset.shape
     degree = graph.shape[1]
@@ -1869,6 +1888,9 @@ def _search_impl(dataset, graph, queries, seed_ids, k, itopk, search_width,
     rank = jnp.argsort(jnp.argsort(seed_ids, axis=1), axis=1)
     seed_dup = jnp.take_along_axis(dup_sorted, rank, axis=1)
     seed_d = jnp.where(seed_dup, worst, seed_d)
+    if filter_words is not None:
+        adm_s = _fbits.query_bits(filter_words, jnp.arange(nq), seed_ids)
+        seed_d = jnp.where(adm_s > 0, seed_d, worst)
     buf_d, pos = jax.lax.top_k(-seed_d, itopk)
     buf_d = -buf_d                     # sorted ascending key
     buf_i = jnp.take_along_axis(seed_ids, pos, axis=1)
@@ -1889,6 +1911,9 @@ def _search_impl(dataset, graph, queries, seed_ids, k, itopk, search_width,
         nbrs = graph[jnp.where(parent_ok, sel_ids, 0)]     # (q, w, degree)
         nbrs = nbrs.reshape(nq, search_width * degree)
         nbrs = jnp.where(jnp.repeat(parent_ok, degree, axis=1), nbrs, -1)
+        if filter_words is not None:
+            adm = _fbits.query_bits(filter_words, jnp.arange(nq), nbrs)
+            nbrs = jnp.where(adm > 0, nbrs, -1)
         nd = dists_to(jnp.where(nbrs >= 0, nbrs, 0))
         nd = jnp.where(nbrs < 0, worst, nd)
 
@@ -1912,8 +1937,8 @@ _WALK_TABLE_MAX_BYTES = 6 << 30
 
 
 @auto_convert_output
-def search(res, params: SearchParams, index: Index, queries, k: int
-           ) -> Tuple[jax.Array, jax.Array]:
+def search(res, params: SearchParams, index: Index, queries, k: int,
+           *, filter=None) -> Tuple[jax.Array, jax.Array]:
     """Greedy graph-walk search (reference: cagra.cuh:205).
 
     .. note:: the first search builds and attaches the packed
@@ -1925,6 +1950,13 @@ def search(res, params: SearchParams, index: Index, queries, k: int
     :mod:`raft_tpu.integrity.boundary`): under policy ``mask``,
     non-finite query rows return id -1 / worst distance instead of
     poisoning the batch.
+
+    ``filter`` (a :class:`raft_tpu.filters.SampleFilter` or (q, n) bool
+    mask) restricts admission: rejected candidates never enter the walk
+    buffer, so they are neither returned nor expanded as parents.
+    Unlike the exhaustive scans, the walk is approximate — filtered
+    recall is NOT guaranteed to match a post-hoc-filtered exact scan;
+    raise ``itopk_size``/``search_width`` under selective filters.
     """
     queries = ensure_array(queries, "queries")
     queries, ok_rows = _boundary.check_matrix(
@@ -1932,7 +1964,8 @@ def search(res, params: SearchParams, index: Index, queries, k: int
     # legacy shape guard: still fires when the validator policy is "off"
     expects(queries.ndim == 2 and queries.shape[1] == index.dim,
             "cagra.search: query dim mismatch")
-    dist, ids = _search_checked(res, params, index, queries, k)
+    dist, ids = _search_checked(res, params, index, queries, k,
+                                filter=filter)
     if ok_rows is not None:
         dist, ids = _boundary.mask_search_outputs(
             dist, ids, ok_rows,
@@ -1941,8 +1974,12 @@ def search(res, params: SearchParams, index: Index, queries, k: int
 
 
 def _search_checked(res, params: SearchParams, index: Index, queries,
-                    k: int) -> Tuple[jax.Array, jax.Array]:
+                    k: int, filter=None) -> Tuple[jax.Array, jax.Array]:
     with named_range("cagra::search"):
+        fw = _fbits.query_filter_words(filter, queries.shape[0],
+                                       "cagra.search")
+        if fw is not None and obs.enabled():
+            obs.registry().counter("cagra.search.filtered").inc()
         itopk = max(params.itopk_size, k)
         max_iter = params.max_iterations or (
             10 + itopk // max(params.search_width, 1))
@@ -1987,7 +2024,7 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
                     k, itopk, params.search_width, max_iter, index.metric,
                     rerank, index.graph_degree, quant=cache.quant,
                     scales=cache.scales, fused_hop=fused,
-                    merge_window=mw if fused else 0)
+                    merge_window=mw if fused else 0, filter_words=fw)
                 st.fence(out)
             return _mask_deleted(index, *out)
 
@@ -2004,7 +2041,7 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
         with obs.stage("cagra.search.walk") as st:
             out = _search_impl(index.dataset, index.graph, queries,
                                seed_ids, k, itopk, params.search_width,
-                               max_iter, index.metric)
+                               max_iter, index.metric, filter_words=fw)
             st.fence(out)
         return _mask_deleted(index, *out)
 

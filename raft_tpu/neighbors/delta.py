@@ -86,12 +86,15 @@ class Record:
     vectors: Optional[np.ndarray] = None
 
 
-def _scan_body(data, ids, queries, k: int, metric):
+def _scan_body(data, ids, queries, k: int, metric, filter_words=None):
     """Brute-force delta scan producing PUBLIC-form distances (sqrt
     applied for the sqrt-L2 metrics) so they merge against the main
     index's output without rescaling.  Empty slots (id -1) ride the
     worst-distance sentinel, the same convention every kernel's
-    tombstone mask uses."""
+    tombstone mask uses.  ``filter_words`` (nq, n_words) int32 packed
+    admission bits fold inadmissible memtable rows through the same
+    seam — the delta tier honors per-query filters like every other
+    scan path."""
     nq = queries.shape[0]
     cap = data.shape[0]
     f32q = queries.astype(jnp.float32)
@@ -107,6 +110,11 @@ def _scan_body(data, ids, queries, k: int, metric):
         select_min = True
     d = jnp.where(ids[None, :] < 0, worst, d)
     bids = jnp.broadcast_to(ids[None, :], (nq, cap))
+    if filter_words is not None:
+        from raft_tpu.filters import bitset as _fbits
+        adm = _fbits.query_bits(filter_words, jnp.arange(nq), bids)
+        d = jnp.where(adm > 0, d, worst)
+        bids = jnp.where(adm > 0, bids, -1)
     kf = min(k, cap)
     best_d, best_i = select_k(d, kf, in_idx=bids, select_min=select_min)
     if kf < k:
@@ -128,16 +136,19 @@ def _delta_scan(data, ids, queries, k: int, metric):
 
 @functools.partial(jax.jit, static_argnames=("k", "metric"))
 def _merge_with_main(main_d, main_i, queries, data, ids, tombs,
-                     k: int, metric):
+                     k: int, metric, filter_words=None):
     """Delta-as-extra-shard merge: scan the memtable, mask tombstoned
     main-index hits to the worst/-1 sentinel (the id<0 seam), then run
     the shared :func:`grouped.finalize_topk` epilogue over the
     concatenated (nq, 2k) candidates — exactly the PR 8 k-bounded
-    routed-shard merge shape with the delta as one more shard."""
+    routed-shard merge shape with the delta as one more shard.
+    ``filter_words`` applies the caller's admission bitset to the delta
+    scan (the main results are assumed already filtered)."""
     nq = main_d.shape[0]
     select_min = metric != DistanceType.InnerProduct
     worst = jnp.inf if select_min else -jnp.inf
-    dd, di = _scan_body(data, ids, queries, k, metric)
+    dd, di = _scan_body(data, ids, queries, k, metric,
+                        filter_words=filter_words)
     hit = (main_i >= 0) & jnp.isin(main_i, tombs)
     md = jnp.where(hit, worst, main_d)
     mi = jnp.where(hit, -1, main_i)
@@ -150,15 +161,17 @@ def _merge_with_main(main_d, main_i, queries, data, ids, tombs,
 
 
 def merge_with_main(main_d, main_i, queries, data, ids, tombs, *,
-                    k: int, metric) -> Tuple[jax.Array, jax.Array]:
+                    k: int, metric, filter_words=None
+                    ) -> Tuple[jax.Array, jax.Array]:
     """Public wrapper over the jitted merge (static ``k`` / ``metric``)."""
     return _merge_with_main(main_d, main_i, queries, data, ids, tombs,
-                            k=int(k), metric=DistanceType(metric))
+                            k=int(k), metric=DistanceType(metric),
+                            filter_words=filter_words)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "metric"))
 def _merge_with_main_multi(main_d, main_i, queries, datas, idss, tombs,
-                           k: int, metric):
+                           k: int, metric, filter_words=None):
     """Multi-shard delta merge (round 19, distributed ingest): every
     per-shard memtable joins the :func:`grouped.finalize_topk` merge as
     one more shard.  Two things the single-delta merge never needed:
@@ -183,7 +196,8 @@ def _merge_with_main_multi(main_d, main_i, queries, datas, idss, tombs,
     ds = [jnp.where(hit, worst, main_d)]
     is_ = [jnp.where(hit, -1, main_i)]
     for data, ids in zip(datas, idss):
-        dd, di = _scan_body(data, ids, queries, k, metric)
+        dd, di = _scan_body(data, ids, queries, k, metric,
+                            filter_words=filter_words)
         ds.append(dd)
         is_.append(di)
     alld = jnp.concatenate(ds, axis=1)
@@ -208,7 +222,7 @@ def _merge_with_main_multi(main_d, main_i, queries, datas, idss, tombs,
 
 
 def merge_with_main_multi(main_d, main_i, queries, deltas, tombs, *,
-                          k: int, metric
+                          k: int, metric, filter_words=None
                           ) -> Tuple[jax.Array, jax.Array]:
     """Merge the main-index top-k with EVERY shard memtable's delta scan
     (``deltas`` is a sequence of ``(data, ids)`` device views, ``tombs``
@@ -219,7 +233,8 @@ def merge_with_main_multi(main_d, main_i, queries, deltas, tombs, *,
     idss = tuple(i for _, i in deltas)
     return _merge_with_main_multi(main_d, main_i, queries, datas, idss,
                                   tuple(tombs), k=int(k),
-                                  metric=DistanceType(metric))
+                                  metric=DistanceType(metric),
+                                  filter_words=filter_words)
 
 
 class Memtable:

@@ -258,8 +258,11 @@ def finalize_topk(outd: jax.Array, outi: jax.Array, nq: int, k: int,
     # distances through every scan, but when k exceeds the valid
     # candidate count their ENCODED ids can survive the select — clamp
     # every negative id to the public -1 sentinel here, the one epilogue
-    # all probe-order and grouped scans share
-    best_i = jnp.maximum(best_i, -1)
+    # all probe-order and grouped scans share.  Filter-rejected rows
+    # (filters.SampleFilter) fold to the worst distance with their REAL
+    # id still attached; map any worst-distance survivor to -1 so every
+    # scan path shares the fused epilogue's (worst, -1) contract.
+    best_i = jnp.where(best_d == worst, -1, jnp.maximum(best_i, -1))
     if sqrt:
         best_d = jnp.sqrt(jnp.maximum(best_d, 0.0))
     return best_d, best_i

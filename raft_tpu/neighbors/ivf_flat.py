@@ -41,6 +41,7 @@ from raft_tpu.integrity import boundary as _boundary
 from raft_tpu.integrity import canary as _canary
 from raft_tpu.neighbors import mutate as _mutate
 from raft_tpu.distance.types import DistanceType
+from raft_tpu.filters import bitset as _fbits
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.utils.precision import get_matmul_precision
 from raft_tpu.core.outputs import auto_convert_output
@@ -499,7 +500,8 @@ def compact(res, index: Index) -> Index:
 @functools.partial(jax.jit, static_argnames=("k", "n_probes", "metric",
                                              "recall_target", "exact"))
 def _search_impl(centers, list_data, list_indices, queries, k, n_probes,
-                 metric, recall_target=0.95, exact=False):
+                 metric, recall_target=0.95, exact=False,
+                 filter_words=None):
     nq = queries.shape[0]
     qf = queries.astype(jnp.float32)
     cf = centers.astype(jnp.float32)
@@ -532,6 +534,11 @@ def _search_impl(centers, list_data, list_indices, queries, k, n_probes,
             d_sq = jnp.sum(data * data, axis=-1)
             d = jnp.maximum(q_sq[:, None] + d_sq - 2.0 * ip, 0.0)
             d = jnp.where(ids >= 0, d, worst)
+        if filter_words is not None:
+            # admission fold through the tombstone seam: rejected rows
+            # are worst before the per-probe top-kt
+            adm = _fbits.query_bits(filter_words, jnp.arange(nq), ids)
+            d = jnp.where(adm > 0, d, worst)
         td, ti = select_k(d, kt, in_idx=ids, select_min=not ip_metric)
         alld = jax.lax.dynamic_update_slice(alld, td, (0, p * kt))
         alli = jax.lax.dynamic_update_slice(alli, ti, (0, p * kt))
@@ -604,7 +611,8 @@ def _select_clusters(centers, queries, n_probes, metric,
                                              "pallas_interpret"))
 def _search_impl_grouped(centers, list_data, list_indices, queries, probes,
                          k, metric, n_groups, block, list_data_sq=None,
-                         use_pallas=False, pallas_interpret=False):
+                         use_pallas=False, pallas_interpret=False,
+                         filter_words=None):
     """List-centric scan over fixed-size pair groups: each group is GROUP
     (query, probe) pairs of one list, so list vectors are read ~once and
     the distance block is a full batched MXU GEMM.  See
@@ -627,6 +635,14 @@ def _search_impl_grouped(centers, list_data, list_indices, queries, probes,
     q_sq = jnp.sum(qf * qf, axis=1)
 
     group_list, slot_pairs = grouped.build_groups(probes, n_lists, n_groups)
+    # per-(slot, candidate) admission words in list-slot order — the
+    # layout the kernel streams through VMEM; note list_indices here may
+    # be the SUPER-TILED view (F*cap wide), which is exactly the layout
+    # the kernel iterates, so the packing follows it for free
+    adm_words = None
+    if filter_words is not None:
+        adm_words = _fbits.group_admission_words(
+            filter_words, group_list, slot_pairs, list_indices, n_probes, P)
 
     kt = min(k, cap)
     if use_pallas:
@@ -639,7 +655,8 @@ def _search_impl_grouped(centers, list_data, list_indices, queries, probes,
                                  axis=-1))
             vals, ti = pqp.grouped_flat_l2_scan(
                 group_list, slot_pairs, qf, list_data, d_sq,
-                list_indices, kt, n_probes, interpret=pallas_interpret)
+                list_indices, kt, n_probes, interpret=pallas_interpret,
+                adm_words=adm_words)
             outd, outi = grouped.scatter_packed(vals, ti, slot_pairs, P,
                                                 not ip_metric)
             return grouped.finalize_topk(
@@ -660,7 +677,13 @@ def _search_impl_grouped(centers, list_data, list_indices, queries, probes,
             d_sq = jnp.sum(data * data, axis=-1)         # (B, cap)
             d = jnp.maximum(q_sq[qid][:, :, None]
                             + d_sq[:, None, :] - 2.0 * ip, 0.0)
-        return jnp.where(ids[:, None, :] >= 0, d, worst), ids
+        d = jnp.where(ids[:, None, :] >= 0, d, worst)
+        if filter_words is not None:
+            adm = _fbits.query_bits(
+                filter_words, qid, jnp.broadcast_to(ids[:, None, :],
+                                                    d.shape))
+            d = jnp.where(adm > 0, d, worst)
+        return d, ids
 
     outd, outi = grouped.scan_and_scatter(
         group_list, slot_pairs, P, cap, k, not ip_metric, block,
@@ -672,13 +695,18 @@ def _search_impl_grouped(centers, list_data, list_indices, queries, probes,
 
 
 @auto_convert_output
-def search(res, params: SearchParams, index: Index, queries, k: int
-           ) -> Tuple[jax.Array, jax.Array]:
+def search(res, params: SearchParams, index: Index, queries, k: int, *,
+           filter=None) -> Tuple[jax.Array, jax.Array]:
     """Search the index (reference: ivf_flat.cuh:389).
 
     Returns ``(distances (q, k), indices (q, k) int32)``; unfilled slots
     (fewer than k valid candidates in the probed lists) carry id -1 and
     +inf / -inf distance, matching the reference's sentinel behavior.
+
+    ``filter`` (a :class:`~raft_tpu.filters.SampleFilter` or an
+    (nq, n_rows) bool mask) restricts each query's candidate set by
+    source id; rejected rows fold to the worst-distance sentinel before
+    every top-k (see docs/api.md, "Filtered search & tenancy").
 
     .. note:: the first TPU search mutates ``index`` in place, lazily
        attaching derived caches (``list_data_sq`` row norms, the group
@@ -699,7 +727,8 @@ def search(res, params: SearchParams, index: Index, queries, k: int
     # legacy shape guard: still fires when the validator policy is "off"
     expects(queries.ndim == 2 and queries.shape[1] == index.dim,
             "ivf_flat.search: query dim mismatch")
-    dist, ids = _search_checked(res, params, index, queries, k)
+    dist, ids = _search_checked(res, params, index, queries, k,
+                                filter=filter)
     if ok_rows is not None:
         dist, ids = _boundary.mask_search_outputs(
             dist, ids, ok_rows,
@@ -708,10 +737,14 @@ def search(res, params: SearchParams, index: Index, queries, k: int
 
 
 def _search_checked(res, params: SearchParams, index: Index, queries,
-                    k: int) -> Tuple[jax.Array, jax.Array]:
+                    k: int, filter=None) -> Tuple[jax.Array, jax.Array]:
     with named_range("ivf_flat::search"):
         from raft_tpu.neighbors import grouped
 
+        fw = _fbits.query_filter_words(filter, queries.shape[0],
+                                       "ivf_flat.search")
+        if fw is not None and obs.enabled():
+            obs.registry().counter("ivf_flat.search.filtered").inc()
         n_probes = min(params.n_probes, index.n_lists)
         coarse_rt = getattr(params, "coarse_recall_target", 0.95)
         exact_coarse = getattr(params, "exact_coarse", False)
@@ -722,7 +755,7 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
             return _search_impl(index.centers, index.list_data,
                                 index.list_indices, queries, k, n_probes,
                                 index.metric, recall_target=coarse_rt,
-                                exact=exact_coarse)
+                                exact=exact_coarse, filter_words=fw)
         with obs.stage("ivf_flat.search.coarse") as st:
             probes = _select_clusters(index.centers, queries, n_probes,
                                       index.metric, recall_target=coarse_rt,
@@ -781,7 +814,8 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
                                        ids_eff, queries, probes_eff,
                                        k, index.metric, n_groups, block,
                                        list_data_sq=dsq_eff,
-                                       use_pallas=use_pallas)
+                                       use_pallas=use_pallas,
+                                       filter_words=fw)
             st.fence(out)
         return out
 

@@ -53,6 +53,7 @@ from raft_tpu import observability as obs
 from raft_tpu.integrity import boundary as _boundary
 from raft_tpu.integrity import canary as _canary
 from raft_tpu.distance.types import DistanceType
+from raft_tpu.filters import bitset as _fbits
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.neighbors import mutate as _mutate
 from raft_tpu.neighbors.ivf_flat import (_append_lists_multi, _pack_lists,
@@ -1046,7 +1047,8 @@ def _with_recon8(index: Index) -> Index:
 
 @functools.partial(jax.jit, static_argnames=("k", "n_probes", "metric"))
 def _search_impl_recon(centers, list_recon, list_indices, rotation, queries,
-                       k, n_probes, metric, probes=None, list_recon_sq=None):
+                       k, n_probes, metric, probes=None, list_recon_sq=None,
+                       filter_words=None):
     """MXU scan over cached bf16 reconstructions — same quantized distance
     as the LUT path (||q_rot - recon||^2), structured like the IVF-Flat
     interleaved scan instead of the GPU's shared-memory LUT kernel.
@@ -1089,7 +1091,14 @@ def _search_impl_recon(centers, list_recon, list_indices, rotation, queries,
                             preferred_element_type=jnp.float32)
             d = jnp.maximum(jnp.sum(sub * sub, axis=1)[:, None]
                             + rec_sq[lists] - 2.0 * ip, 0.0)
-        return jnp.where(ids >= 0, d, worst), ids
+        d = jnp.where(ids >= 0, d, worst)
+        if filter_words is not None:
+            # admission fold through the same seam as tombstones: a
+            # rejected row is worst BEFORE the per-probe top-kt, so the
+            # select never spends a slot on it
+            adm = _fbits.query_bits(filter_words, jnp.arange(nq), ids)
+            d = jnp.where(adm > 0, d, worst)
+        return d, ids
 
     # Hierarchical select (exact): every probe keeps its local top-k inside
     # the scan — any global top-k candidate is necessarily in its own
@@ -1141,7 +1150,7 @@ def _search_impl_recon_grouped(centers, list_recon, list_recon_sq,
                                list_indices, rotation, queries, probes, k,
                                metric, n_groups, block, use_pallas=False,
                                pallas_interpret=False, kt=0,
-                               merge_window=0):
+                               merge_window=0, filter_words=None):
     """List-centric recon scan over fixed-size pair groups.
 
     See :mod:`raft_tpu.neighbors.grouped` for the design (and the measured
@@ -1165,6 +1174,12 @@ def _search_impl_recon_grouped(centers, list_recon, list_recon_sq,
     cf = centers.astype(jnp.float32)
 
     group_list, slot_pairs = grouped.build_groups(probes, n_lists, n_groups)
+    # per-(slot, candidate) admission words in list-slot order — shared
+    # by the Pallas kernel (streamed through VMEM) and derived once here
+    adm_words = None
+    if filter_words is not None:
+        adm_words = _fbits.group_admission_words(
+            filter_words, group_list, slot_pairs, list_indices, n_probes, P)
 
     # kt < k (SearchParams.per_probe_topk) narrows the per-pair keep-set:
     # the extraction-bound kernel speeds up near-linearly, at the cost of
@@ -1180,7 +1195,7 @@ def _search_impl_recon_grouped(centers, list_recon, list_recon_sq,
             vals, ti = pqp.grouped_l2_scan(
                 group_list, slot_pairs, qrot, cf, list_recon,
                 list_recon_sq, list_indices, kt, n_probes,
-                interpret=pallas_interpret)
+                interpret=pallas_interpret, adm_words=adm_words)
             outd, outi = grouped.scatter_packed(vals, ti, slot_pairs, P,
                                                 not ip_metric)
             return grouped.finalize_topk(
@@ -1207,7 +1222,14 @@ def _search_impl_recon_grouped(centers, list_recon, list_recon_sq,
                             preferred_element_type=jnp.float32)
             d = jnp.maximum(jnp.sum(sub * sub, axis=-1)[:, :, None]
                             + rsq[:, None, :] - 2.0 * ip, 0.0)
-        return jnp.where(ids[:, None, :] >= 0, d, worst), ids
+        d = jnp.where(ids[:, None, :] >= 0, d, worst)
+        if filter_words is not None:
+            qid = jnp.where(slot < P, slot // n_probes, 0)
+            adm = _fbits.query_bits(
+                filter_words, qid,
+                jnp.broadcast_to(ids[:, None, :], d.shape))
+            d = jnp.where(adm > 0, d, worst)
+        return d, ids
 
     outd, outi = grouped.scan_and_scatter(
         group_list, slot_pairs, P, cap, k, not ip_metric, block,
@@ -1225,7 +1247,7 @@ def _search_impl_codes_grouped(centers, codebooks, list_code_lanes,
                                list_code_rsq, list_indices, rotation,
                                queries, probes, k, kt, metric, n_groups,
                                pq_bits, packed=False,
-                               pallas_interpret=False):
+                               pallas_interpret=False, filter_words=None):
     """Grouped COMPACT-CODE scan: the Pallas kernel streams lane-major
     packed codes (~pq_bits/8 bytes per subspace per row — the recon path
     reads 2*pq_len) and decodes them in-register against the
@@ -1246,11 +1268,15 @@ def _search_impl_codes_grouped(centers, codebooks, list_code_lanes,
     cf = centers.astype(jnp.float32)
 
     group_list, slot_pairs = grouped.build_groups(probes, n_lists, n_groups)
+    adm_words = None
+    if filter_words is not None:
+        adm_words = _fbits.group_admission_words(
+            filter_words, group_list, slot_pairs, list_indices, n_probes, P)
     kt = min(kt or k, cap)
     vals, ti = pcs.grouped_code_scan(
         group_list, slot_pairs, qrot, cf, list_code_lanes, codebooks,
         list_code_rsq, list_indices, kt, n_probes, pq_bits, packed=packed,
-        interpret=pallas_interpret)
+        interpret=pallas_interpret, adm_words=adm_words)
     outd, outi = grouped.scatter_packed(vals, ti, slot_pairs, P, True)
     return grouped.finalize_topk(
         outd, outi, nq, k, True,
@@ -1265,7 +1291,7 @@ def _search_impl_recon8_grouped(centers, list_recon_i8, list_recon_scale,
                                 list_recon_i8_sq, list_indices, rotation,
                                 queries, probes, k, kt, metric, n_groups,
                                 block, use_pallas=False, packed=False,
-                                pallas_interpret=False):
+                                pallas_interpret=False, filter_words=None):
     """Grouped scan over the int8-quantized recon cache (1 byte/dim/row):
     the Pallas kernel dequantizes in-register with the per-list scale —
     ``d = ||sub||^2 + rsq8 - 2*scale*(sub . q8)``.  The XLA fallback
@@ -1283,6 +1309,10 @@ def _search_impl_recon8_grouped(centers, list_recon_i8, list_recon_scale,
     cf = centers.astype(jnp.float32)
 
     group_list, slot_pairs = grouped.build_groups(probes, n_lists, n_groups)
+    adm_words = None
+    if filter_words is not None:
+        adm_words = _fbits.group_admission_words(
+            filter_words, group_list, slot_pairs, list_indices, n_probes, P)
     kt = min(kt or k, cap)
     if use_pallas:
         from raft_tpu.ops import pq_code_scan_pallas as pcs
@@ -1290,7 +1320,7 @@ def _search_impl_recon8_grouped(centers, list_recon_i8, list_recon_scale,
         vals, ti = pcs.grouped_recon8_scan(
             group_list, slot_pairs, qrot, cf, list_recon_i8,
             list_recon_scale, list_recon_i8_sq, list_indices, kt, n_probes,
-            packed=packed, interpret=pallas_interpret)
+            packed=packed, interpret=pallas_interpret, adm_words=adm_words)
         outd, outi = grouped.scatter_packed(vals, ti, slot_pairs, P, True)
         return grouped.finalize_topk(
             outd, outi, nq, k, True,
@@ -1315,7 +1345,14 @@ def _search_impl_recon8_grouped(centers, list_recon_i8, list_recon_scale,
         d = jnp.maximum(jnp.sum(sub * sub, axis=-1)[:, :, None]
                         + rsq[:, None, :]
                         - 2.0 * sc[:, None, None] * ip, 0.0)
-        return jnp.where(ids[:, None, :] >= 0, d, jnp.inf), ids
+        d = jnp.where(ids[:, None, :] >= 0, d, jnp.inf)
+        if filter_words is not None:
+            qid = jnp.where(slot < P, slot // n_probes, 0)
+            adm = _fbits.query_bits(
+                filter_words, qid,
+                jnp.broadcast_to(ids[:, None, :], d.shape))
+            d = jnp.where(adm > 0, d, jnp.inf)
+        return d, ids
 
     outd, outi = grouped.scan_and_scatter(
         group_list, slot_pairs, P, cap, k, True, block,
@@ -1353,7 +1390,8 @@ def _search_impl_fused_codes_grouped(centers, codebooks, list_code_lanes,
                                      list_code_rsq, list_indices, rotation,
                                      queries, probes, k, kt, metric,
                                      n_groups, pq_bits, merge_window=1,
-                                     pallas_interpret=False):
+                                     pallas_interpret=False,
+                                     filter_words=None):
     """Fused compact-code scan: the grouped code scan with the per-query
     top-k folded INTO the kernel (pq_code_scan_pallas
     ``grouped_code_scan_fused``) — per-pair candidates never reach HBM,
@@ -1373,11 +1411,19 @@ def _search_impl_fused_codes_grouped(centers, codebooks, list_code_lanes,
     qorder = grouped.probe_overlap_order(probes, n_lists)
     group_list, slot_pairs = grouped.build_groups(probes[qorder], n_lists,
                                                   n_groups)
+    adm_words = None
+    if filter_words is not None:
+        # slot pairs decode to PERMUTED query ids — permute the filter
+        # rows identically or every query consults its neighbor's bits
+        adm_words = _fbits.group_admission_words(
+            filter_words[qorder], group_list, slot_pairs, list_indices,
+            n_probes, nq * n_probes)
     kt = min(kt or k, cap)
     vals, ids = pcs.grouped_code_scan_fused(
         group_list, slot_pairs, qrot[qorder], cf, list_code_lanes,
         codebooks, list_code_rsq, list_indices, kt, k, n_probes, pq_bits,
-        interpret=pallas_interpret, merge_window=merge_window)
+        interpret=pallas_interpret, merge_window=merge_window,
+        adm_words=adm_words)
     return _fused_epilogue(vals, ids, qorder, nq, k, metric)
 
 
@@ -1388,7 +1434,8 @@ def _search_impl_fused_recon_grouped(centers, list_recon, list_recon_sq,
                                      list_indices, rotation, queries,
                                      probes, k, kt, metric, n_groups,
                                      merge_window=1,
-                                     pallas_interpret=False):
+                                     pallas_interpret=False,
+                                     filter_words=None):
     """Fused recon scan: :func:`_search_impl_recon_grouped`'s Pallas
     path with the per-query top-k folded into the kernel
     (pq_group_scan_pallas ``grouped_l2_scan_fused``) — same quantized
@@ -1404,11 +1451,17 @@ def _search_impl_fused_recon_grouped(centers, list_recon, list_recon_sq,
     qorder = grouped.probe_overlap_order(probes, n_lists)
     group_list, slot_pairs = grouped.build_groups(probes[qorder], n_lists,
                                                   n_groups)
+    adm_words = None
+    if filter_words is not None:
+        adm_words = _fbits.group_admission_words(
+            filter_words[qorder], group_list, slot_pairs, list_indices,
+            n_probes, nq * n_probes)
     kt = min(kt or k, cap)
     vals, ids = pqp.grouped_l2_scan_fused(
         group_list, slot_pairs, qrot[qorder], cf, list_recon,
         list_recon_sq, list_indices, kt, k, n_probes,
-        interpret=pallas_interpret, merge_window=merge_window)
+        interpret=pallas_interpret, merge_window=merge_window,
+        adm_words=adm_words)
     return _fused_epilogue(vals, ids, qorder, nq, k, metric)
 
 
@@ -1421,7 +1474,8 @@ def _search_impl_fused_recon_grouped(centers, list_recon, list_recon_sq,
     "coarse_recall_target", "exact_coarse"))
 def _search_impl(centers, codebooks, list_codes, list_indices, rotation,
                  queries, k, n_probes, metric, codebook_kind, lut_dtype,
-                 pq_bits=8, coarse_recall_target=0.95, exact_coarse=False):
+                 pq_bits=8, coarse_recall_target=0.95, exact_coarse=False,
+                 filter_words=None):
     nq = queries.shape[0]
     qrot = queries.astype(jnp.float32) @ rotation       # (q, rot_dim)
     cf = centers.astype(jnp.float32)
@@ -1484,6 +1538,9 @@ def _search_impl(centers, codebooks, list_codes, list_indices, rotation,
             # comparability in the merged top-k
             d = d + jnp.sum(sub * sub, axis=(1, 2))[:, None]
         d = jnp.where(ids >= 0, d, worst)
+        if filter_words is not None:
+            adm = _fbits.query_bits(filter_words, jnp.arange(nq), ids)
+            d = jnp.where(adm > 0, d, worst)
         td, ti = select_k(d, kt, in_idx=ids, select_min=not ip_metric)
         alld = jax.lax.dynamic_update_slice(alld, td, (0, p * kt))
         alli = jax.lax.dynamic_update_slice(alli, ti, (0, p * kt))
@@ -1518,14 +1575,23 @@ def _codes_mode_eligible(index: Index) -> bool:
 
 
 @auto_convert_output
-def search(res, params: SearchParams, index: Index, queries, k: int
-           ) -> Tuple[jax.Array, jax.Array]:
+def search(res, params: SearchParams, index: Index, queries, k: int, *,
+           filter=None) -> Tuple[jax.Array, jax.Array]:
     """Search (reference: ivf_pq.cuh:342).  Returns (distances, indices).
 
     ``params.scan_mode`` picks the list-scan formulation (see
     :class:`SearchParams`); "codes" and "recon8" silently fall back to
     the LUT / XLA formulations off-TPU or for unsupported shapes, so the
     same call works on every backend.
+
+    ``filter`` (a :class:`~raft_tpu.filters.SampleFilter` or an
+    (nq, n_rows) bool mask — see docs/api.md, "Filtered search &
+    tenancy") restricts each query's candidate set by source id: a
+    rejected row folds to the worst-distance sentinel *before* every
+    top-k, on every scan mode, so filtered results are bit-identical to
+    a post-hoc filtered exact scan at full probe.  Rejected slots
+    surface as (+inf/-inf, -1) like tombstones.  Filters are data, not
+    shape — varying filters re-enter the same compiled executable.
 
     Queries pass through the boundary validator (see
     :mod:`raft_tpu.integrity.boundary`): under policy ``mask``,
@@ -1545,7 +1611,8 @@ def search(res, params: SearchParams, index: Index, queries, k: int
     # legacy shape guard: still fires when the validator policy is "off"
     expects(queries.ndim == 2 and queries.shape[1] == index.dim,
             "ivf_pq.search: query dim mismatch")
-    dist, ids = _search_checked(res, params, index, queries, k)
+    dist, ids = _search_checked(res, params, index, queries, k,
+                                filter=filter)
     if ok_rows is not None:
         dist, ids = _boundary.mask_search_outputs(
             dist, ids, ok_rows,
@@ -1554,8 +1621,12 @@ def search(res, params: SearchParams, index: Index, queries, k: int
 
 
 def _search_checked(res, params: SearchParams, index: Index, queries,
-                    k: int) -> Tuple[jax.Array, jax.Array]:
+                    k: int, filter=None) -> Tuple[jax.Array, jax.Array]:
     with named_range("ivf_pq::search"):
+        fw = _fbits.query_filter_words(filter, queries.shape[0],
+                                       "ivf_pq.search")
+        if fw is not None and obs.enabled():
+            obs.registry().counter("ivf_pq.search.filtered").inc()
         n_probes = min(params.n_probes, index.n_lists)
         coarse_rt = getattr(params, "coarse_recall_target", 0.95)
         exact_coarse = getattr(params, "exact_coarse", False)
@@ -1620,7 +1691,7 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
                 return _search_impl_recon(
                     index.centers, index.list_recon, index.list_indices,
                     index.rotation, queries, k, n_probes, index.metric,
-                    list_recon_sq=index.list_recon_sq)
+                    list_recon_sq=index.list_recon_sq, filter_words=fw)
             return _search_impl(index.centers, index.codebooks,
                                 index.list_codes, index.list_indices,
                                 index.rotation, queries, k, n_probes,
@@ -1628,7 +1699,8 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
                                 jnp.dtype(params.lut_dtype).name,
                                 pq_bits=index.pq_bits,
                                 coarse_recall_target=coarse_rt,
-                                exact_coarse=exact_coarse)
+                                exact_coarse=exact_coarse,
+                                filter_words=fw)
 
         def lut_scan():
             with obs.stage("ivf_pq.search.lut") as st:
@@ -1639,7 +1711,8 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
                                    jnp.dtype(params.lut_dtype).name,
                                    pq_bits=index.pq_bits,
                                    coarse_recall_target=coarse_rt,
-                                   exact_coarse=exact_coarse)
+                                   exact_coarse=exact_coarse,
+                                   filter_words=fw)
                 st.fence(out)
             return out
 
@@ -1769,7 +1842,8 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
                             index.list_code_lanes, index.list_code_rsq,
                             index.list_indices, index.rotation, queries,
                             probes, k, kt, index.metric, ng,
-                            index.pq_bits, merge_window=mw))
+                            index.pq_bits, merge_window=mw,
+                            filter_words=fw))
                 note_fused_fallback(pcs.fused_codes_reject_reason(
                     True, True, cap, rot, kt, k, nq, index.pq_dim,
                     index.pq_bits, merge_window=mw_req)
@@ -1780,7 +1854,7 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
                     index.centers, index.codebooks, index.list_code_lanes,
                     index.list_code_rsq, index.list_indices, index.rotation,
                     queries, probes, k, kt, index.metric, ng,
-                    index.pq_bits, packed=packed))
+                    index.pq_bits, packed=packed, filter_words=fw))
 
         if mode == "recon8":
             rot_pad = index.list_recon_i8.shape[2]
@@ -1799,7 +1873,7 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
                     index.list_recon_scale, index.list_recon_i8_sq,
                     index.list_indices, index.rotation, queries, probes, k,
                     kt, index.metric, ng, block, use_pallas=use_pallas,
-                    packed=packed)
+                    packed=packed, filter_words=fw)
 
             return run_grouped("ivf_pq.search.recon8_scan", dispatch8)
 
@@ -1819,7 +1893,8 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
                         index.centers, index.list_recon,
                         index.list_recon_sq, index.list_indices,
                         index.rotation, queries, probes, k, kt,
-                        index.metric, ng, merge_window=mw))
+                        index.metric, ng, merge_window=mw,
+                        filter_words=fw))
             note_fused_fallback(
                 "backend" if not use_pallas else
                 pqp.fused_reject_reason(index.metric in _L2_METRICS, cap,
@@ -1836,7 +1911,8 @@ def _search_checked(res, params: SearchParams, index: Index, queries,
             return _search_impl_recon_grouped(
                 index.centers, index.list_recon, index.list_recon_sq,
                 index.list_indices, index.rotation, queries, probes, k,
-                index.metric, ng, block, use_pallas=use_pallas, kt=kt)
+                index.metric, ng, block, use_pallas=use_pallas, kt=kt,
+                filter_words=fw)
 
         return run_grouped("ivf_pq.search.scan", dispatch)
 

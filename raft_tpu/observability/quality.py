@@ -408,7 +408,10 @@ class OpPoint:
 
     ``knobs`` keys (the serving executor's closed-shape coordinates):
     ``kind / scan_mode / n_probes / kt / merge_window / bucket / rung /
-    k``.  ``measured`` keys: the recall estimate (``recall / lo / hi /
+    k / filtered`` (``filtered`` — round 20 — marks a filter-configured
+    executor: recall under admission predicates is a different operating
+    regime than unfiltered recall, so the calibrator must not mix the
+    two).  ``measured`` keys: the recall estimate (``recall / lo / hi /
     hits / total / rows``), window latency quantiles (``p50 / p95 /
     p99`` seconds), and whatever scan-traffic numbers were available
     (``scan_rows``).  The calibrator treats both as open dicts."""

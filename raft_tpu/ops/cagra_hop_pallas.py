@@ -89,10 +89,24 @@ def hop_reject_reason(nq: int, itopk: int, wd: int, pdim: int,
     return "bucket-too-wide"
 
 
+def _unpack_hop_admission(adm_ref, wd):
+    """Unpack the (W32, LANES) packed admission words — bit b of word w
+    admitting candidate ``32*w + b`` of each lane's query — to a
+    (wd, LANES) 0/1 block.  Sublane-axis shift/mask, no gather."""
+    aw = adm_ref[:]                                    # (W32, LANES) int32
+    shifts = jax.lax.broadcasted_iota(
+        jnp.int32, (aw.shape[0], 32, aw.shape[1]), 1)
+    bits = (aw[:, None, :] >> shifts) & 1
+    return bits.reshape(aw.shape[0] * 32, aw.shape[1])[:wd]
+
+
 def _hop_scores(qpT_ref, qsq_ref, nbp_ref, nbsq_ref, nbid_ref, wd, pdim,
-                ip_metric):
+                ip_metric, adm=None):
     """Shared score block: wd unrolled VPU rows — the (wd, nq) distance
-    KEYS and f32 candidate ids, masked parents at (+inf, -1)."""
+    KEYS and f32 candidate ids, masked parents at (+inf, -1).  ``adm``
+    (wd, nq) 0/1 admission bits fold rejected candidates through the
+    SAME (+inf, -1) seam as masked parents — a filtered node never
+    enters the buffer, so the walk does not traverse it."""
     qpT = qpT_ref[:]                                   # (pdim, nq)
     ip_rows = []
     for j in range(wd):
@@ -105,19 +119,24 @@ def _hop_scores(qpT_ref, qsq_ref, nbp_ref, nbsq_ref, nbid_ref, wd, pdim,
         d = qsq_ref[:] + nbsq_ref[:] - 2.0 * ip
     cid = nbid_ref[:]                                  # (wd, nq) f32 ids
     ok = cid >= 0.0
+    if adm is not None:
+        ok = ok & (adm > 0)
     d = jnp.where(ok, d, jnp.inf)
     cid = jnp.where(ok, cid, -1.0)
     return d, cid
 
 
 def _kernel_hop(qpT_ref, qsq_ref, nbp_ref, nbsq_ref, nbid_ref,
-                bufd_ref, bufi_ref, vis_ref,
-                od_ref, oi_ref, ov_ref, *,
-                itopk: int, wd: int, pdim: int, ip_metric: bool):
+                bufd_ref, bufi_ref, vis_ref, *rest,
+                itopk: int, wd: int, pdim: int, ip_metric: bool,
+                has_adm: bool = False):
+    adm_ref, rest = (rest[0], rest[1:]) if has_adm else (None, rest)
+    od_ref, oi_ref, ov_ref = rest
     nq = qpT_ref.shape[1]
 
+    adm = _unpack_hop_admission(adm_ref, wd) if has_adm else None
     d, cid = _hop_scores(qpT_ref, qsq_ref, nbp_ref, nbsq_ref, nbid_ref,
-                         wd, pdim, ip_metric)
+                         wd, pdim, ip_metric, adm=adm)
 
     # ---- merge with in-pass dedupe -------------------------------------
     cat_v = jnp.concatenate([bufd_ref[:], d], axis=0)  # (rows, nq)
@@ -146,9 +165,9 @@ def _kernel_hop(qpT_ref, qsq_ref, nbp_ref, nbsq_ref, nbid_ref,
 
 
 def _kernel_hop_staged(qpT_ref, qsq_ref, nbp_ref, nbsq_ref, nbid_ref,
-                       bufd_ref, bufi_ref, vis_ref,
-                       od_ref, oi_ref, ov_ref, stg_d, stg_i, *,
-                       itopk: int, wd: int, pdim: int, ip_metric: bool):
+                       bufd_ref, bufi_ref, vis_ref, *rest,
+                       itopk: int, wd: int, pdim: int, ip_metric: bool,
+                       has_adm: bool = False):
     """Staged hop variant (merge window 2): instead of itopk
     min-extraction rounds over ALL itopk+wd rows, candidates are
     deduped, extracted SORTED into the (t, nq) staging block
@@ -159,10 +178,13 @@ def _kernel_hop_staged(qpT_ref, qsq_ref, nbp_ref, nbsq_ref, nbid_ref,
     keep tie order), so outputs match the XLA twin.  This lifts the
     itopk gate from 32 to 64: extraction passes shrink from
     itopk*(itopk+wd) to t*wd row-ops plus a log2-depth merge."""
+    adm_ref, rest = (rest[0], rest[1:]) if has_adm else (None, rest)
+    od_ref, oi_ref, ov_ref, stg_d, stg_i = rest
     nq = qpT_ref.shape[1]
 
+    adm = _unpack_hop_admission(adm_ref, wd) if has_adm else None
     d, cid = _hop_scores(qpT_ref, qsq_ref, nbp_ref, nbsq_ref, nbid_ref,
-                         wd, pdim, ip_metric)
+                         wd, pdim, ip_metric, adm=adm)
 
     # ---- candidate-vs-buffer dedupe: membership kill against every
     # buffer row (duplicate ids carry bitwise-identical keys, so the
@@ -234,7 +256,7 @@ def _kernel_hop_staged(qpT_ref, qsq_ref, nbp_ref, nbsq_ref, nbid_ref,
                                     "merge_window"))
 def fused_hop(qp_t, q_sq, nb_p, nb_sq, nb_id, buf_d, buf_i, visited, *,
               itopk: int, ip_metric: bool, interpret: bool = False,
-              merge_window: int = 0
+              merge_window: int = 0, adm_words=None
               ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One fused graph-walk hop.
 
@@ -253,6 +275,11 @@ def fused_hop(qp_t, q_sq, nb_p, nb_sq, nb_id, buf_d, buf_i, visited, *,
     ``merge_window`` selects the variant (0 auto): 1 = legacy in-pass
     merge (itopk <= 32), 2 = staged extraction + in-kernel bitonic
     merge (itopk to 64) — see :func:`hop_merge_window`.
+
+    ``adm_words`` (nq, ceil(wd/32)) int32, optional: packed
+    per-(query, candidate) admission bits over this hop's ``wd``
+    neighbors (bit j of a query's stream admits its candidate j);
+    rejected candidates fold like masked parents.
     """
     nq, wd, pdim = nb_p.shape
     if merge_window > 0:
@@ -276,13 +303,21 @@ def fused_hop(qp_t, q_sq, nb_p, nb_sq, nb_id, buf_d, buf_i, visited, *,
     bufi = col(buf_i, -1.0)
     vis = col(visited, 1.0)
 
+    has_adm = adm_words is not None
+    args = [qpT, qsq, nbp, nbsq, nbid, bufd, bufi, vis]
+    if has_adm:
+        # packed words ride sublanes (W32, LANES); padded lanes get 0
+        # words (inadmissible) and are sliced away with the other pads
+        args.append(jnp.pad(adm_words.astype(jnp.int32).T,
+                            ((0, 0), (0, pad))))
+
     kern = _kernel_hop if mw <= 1 else _kernel_hop_staged
     out = pl.pallas_call(
         functools.partial(kern, itopk=itopk, wd=wd, pdim=pdim,
-                          ip_metric=ip_metric),
+                          ip_metric=ip_metric, has_adm=has_adm),
         out_shape=[jax.ShapeDtypeStruct((itopk, _LANES), jnp.float32)] * 3,
         scratch_shapes=vb.hop_scratch(itopk, wd, mw, _LANES),
         interpret=interpret,
-    )(qpT, qsq, nbp, nbsq, nbid, bufd, bufi, vis)
+    )(*args)
     od, oi, ov = (o[:, :nq].T for o in out)
     return od, oi.astype(jnp.int32), ov > 0.5

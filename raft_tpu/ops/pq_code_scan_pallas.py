@@ -56,7 +56,8 @@ from raft_tpu.ops.pq_group_scan_pallas import (_KT_MAX, _KT_UNROLL,
                                                _fused_step,
                                                _gather_queries,
                                                _gather_queries_masked,
-                                               _scratch_shapes)
+                                               _scratch_shapes,
+                                               _unpack_admission)
 from raft_tpu.ops.pq_group_scan_pallas import _ACC_WORST  # noqa: F401 (re-export)
 
 _VMEM_BUDGET = 10 << 20
@@ -139,7 +140,7 @@ def _decode_reconT(codes_ref, cb_ref, pq_dim, pq_bits, rot_pad, cap):
 
 
 def _extract_topk_packed(d, ids_row, vals_ref, ids_out_ref, vscratch,
-                         pscratch, kt, cap_bits):
+                         pscratch, kt, cap_bits, adm=None):
     """Packed-key top-kt: ONE cross-lane reduce per selection pass.
 
     L2 distances are >= 0, so their f32 bit patterns order like ints;
@@ -156,6 +157,10 @@ def _extract_topk_packed(d, ids_row, vals_ref, ids_out_ref, vscratch,
     int_max = jnp.int32(2**31 - 1)
     col = jax.lax.broadcasted_iota(jnp.int32, d.shape, 1)
     invalid = (ids_row < 0)[None, :]
+    if adm is not None:
+        # admission folds through the same INT32_MAX key sentinel as a
+        # tombstone — rejected before any selection pass
+        invalid = invalid | (adm == 0)
     bits = jax.lax.bitcast_convert_type(d, jnp.int32)
     key = jnp.where(invalid, int_max, (bits & ~col_mask) | col)
     ids_f = ids_row.astype(jnp.float32)
@@ -177,20 +182,21 @@ def _extract_topk_packed(d, ids_row, vals_ref, ids_out_ref, vscratch,
 
 
 def _extract(d, ids_ref, vals_ref, ids_out_ref, vscratch, pscratch, kt,
-             packed, cap_bits):
+             packed, cap_bits, adm=None):
     ids_row = ids_ref[0, 0]                              # (cap,) int32
     if packed:
         _extract_topk_packed(d, ids_row, vals_ref, ids_out_ref, vscratch,
-                             pscratch, kt, cap_bits)
+                             pscratch, kt, cap_bits, adm=adm)
     else:
         _extract_topk(d, ids_row, vals_ref, ids_out_ref, vscratch,
-                      pscratch, kt)
+                      pscratch, kt, adm=adm)
 
 
 def _kernel_codes(gl_ref, slot_ref, qrot_ref, cf_ref, codes_ref, cb_ref,
-                  rsq_ref, ids_ref, vals_ref, ids_out_ref, vscratch,
-                  pscratch, *, kt, n_probes, P, pq_dim, pq_bits, packed,
-                  cap_bits):
+                  rsq_ref, ids_ref, *rest, kt, n_probes, P, pq_dim,
+                  pq_bits, packed, cap_bits, has_adm=False):
+    adm_ref, rest = (rest[0], rest[1:]) if has_adm else (None, rest)
+    vals_ref, ids_out_ref, vscratch, pscratch = rest
     qv = _gather_queries(slot_ref, qrot_ref, n_probes, P)
     sub = qv - cf_ref[0, 0][None, :]                     # (G, rot_pad) f32
     sub_sq = jnp.sum(sub * sub, axis=1)                  # (G,)
@@ -202,13 +208,16 @@ def _kernel_codes(gl_ref, slot_ref, qrot_ref, cf_ref, codes_ref, cb_ref,
                              preferred_element_type=jnp.float32)
     d = sub_sq[:, None] + rsq_ref[0, 0][None, :] - 2.0 * ip
     d = jnp.maximum(d, 0.0)
+    adm = _unpack_admission(adm_ref, cap) if has_adm else None
     _extract(d, ids_ref, vals_ref, ids_out_ref, vscratch, pscratch, kt,
-             packed, cap_bits)
+             packed, cap_bits, adm=adm)
 
 
 def _kernel_recon8(gl_ref, slot_ref, qrot_ref, cf_ref, data_ref, scale_ref,
-                   rsq_ref, ids_ref, vals_ref, ids_out_ref, vscratch,
-                   pscratch, *, kt, n_probes, P, packed, cap_bits):
+                   rsq_ref, ids_ref, *rest, kt, n_probes, P, packed,
+                   cap_bits, has_adm=False):
+    adm_ref, rest = (rest[0], rest[1:]) if has_adm else (None, rest)
+    vals_ref, ids_out_ref, vscratch, pscratch = rest
     qv = _gather_queries(slot_ref, qrot_ref, n_probes, P)
     sub = qv - cf_ref[0, 0][None, :]                     # (G, rot_pad) f32
     sub_sq = jnp.sum(sub * sub, axis=1)                  # (G,)
@@ -219,20 +228,23 @@ def _kernel_recon8(gl_ref, slot_ref, qrot_ref, cf_ref, data_ref, scale_ref,
                              preferred_element_type=jnp.float32)
     d = sub_sq[:, None] + rsq_ref[0, 0][None, :] - 2.0 * scale * ip
     d = jnp.maximum(d, 0.0)
+    adm = _unpack_admission(adm_ref, d.shape[1]) if has_adm else None
     _extract(d, ids_ref, vals_ref, ids_out_ref, vscratch, pscratch, kt,
-             packed, cap_bits)
+             packed, cap_bits, adm=adm)
 
 
 def _kernel_codes_fused(gl_ref, slot_ref, qrot_ref, cf_ref, codes_ref,
-                        cb_ref, rsq_ref, ids_ref, vals_ref, ids_out_ref,
-                        acc_v, acc_i, *stg, kt, k, n_probes, P, pq_dim,
-                        pq_bits, n_groups, merge_window):
+                        cb_ref, rsq_ref, ids_ref, *rest, kt, k, n_probes,
+                        P, pq_dim, pq_bits, n_groups, merge_window,
+                        has_adm=False):
     """Fused compact-code scan: the ``_kernel_codes`` decode + distance
     block feeding the in-kernel per-query accumulator
     (pq_group_scan_pallas._fused_step — per-step merge at W=1, staged
     ring + windowed merge at W>1) instead of per-pair output rows —
     candidates never reach HBM; the final (k, nq_pad) answers flush
     once on the last grid step."""
+    adm_ref, rest = (rest[0], rest[1:]) if has_adm else (None, rest)
+    vals_ref, ids_out_ref, acc_v, acc_i, *stg = rest
     g = pl.program_id(0)
 
     @pl.when(g == 0)
@@ -254,8 +266,9 @@ def _kernel_codes_fused(gl_ref, slot_ref, qrot_ref, cf_ref, codes_ref,
                              preferred_element_type=jnp.float32)
     d = sub_sq[:, None] + rsq_ref[0, 0][None, :] - 2.0 * ip
     d = jnp.maximum(d, 0.0)
+    adm = _unpack_admission(adm_ref, cap) if has_adm else None
     _fused_step(g, oh, d, ids_ref[0, 0], acc_v, acc_i, stg, kt=kt,
-                merge_window=merge_window, n_groups=n_groups)
+                merge_window=merge_window, n_groups=n_groups, adm=adm)
 
     @pl.when(g == n_groups - 1)
     def _flush():
@@ -269,13 +282,15 @@ def _kernel_codes_fused(gl_ref, slot_ref, qrot_ref, cf_ref, codes_ref,
 def grouped_code_scan_fused(group_list, slot_pairs, qrot, centers_f32,
                             codes_lanes, codebooks, rsq, list_indices, kt,
                             k, n_probes, pq_bits, interpret=False,
-                            merge_window=1):
+                            merge_window=1, adm_words=None):
     """Fused compact-code scan with IN-KERNEL per-query top-k.
 
     Inputs as :func:`grouped_code_scan`; output contract as
     ``pq_group_scan_pallas.grouped_l2_scan_fused`` — the batch's final
     ``(vals (k, nq_pad) f32, ids (k, nq_pad) int32)``, ascending per
     column, exhausted ranks at the finite ``_ACC_WORST`` sentinel.
+    ``adm_words`` (n_groups, GROUP, ceil(cap/32)) int32 streams packed
+    per-(slot, candidate) admission bits (filtered search).
     """
     n_groups = group_list.shape[0]
     nq, rot = qrot.shape
@@ -291,18 +306,29 @@ def grouped_code_scan_fused(group_list, slot_pairs, qrot, centers_f32,
     cf_pad = _pad_lanes(centers_f32, rot_pad)
     cbT = jnp.swapaxes(codebooks.astype(jnp.float32), 1, 2)
 
+    has_adm = adm_words is not None
+    in_specs = [
+        pl.BlockSpec((1, 1, GROUP), lambda g, gl: (g, 0, 0)),
+        pl.BlockSpec((nq_pad, rot_pad), lambda g, gl: (0, 0)),
+        pl.BlockSpec((1, 1, rot_pad), lambda g, gl: (gl[g], 0, 0)),
+        pl.BlockSpec((1, Wi, cap), lambda g, gl: (gl[g], 0, 0)),
+        pl.BlockSpec((pq_dim, pq_len, book), lambda g, gl: (0, 0, 0)),
+        pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
+        pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
+    ]
+    inputs = [group_list, slot_pairs[:, None, :], qrot_pad,
+              cf_pad[:, None, :], codes_lanes, cbT, rsq[:, None, :],
+              list_indices[:, None, :]]
+    if has_adm:
+        wc = adm_words.shape[2]
+        in_specs.append(pl.BlockSpec((1, GROUP, wc),
+                                     lambda g, gl: (g, 0, 0)))
+        inputs.append(adm_words)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_groups,),
-        in_specs=[
-            pl.BlockSpec((1, 1, GROUP), lambda g, gl: (g, 0, 0)),
-            pl.BlockSpec((nq_pad, rot_pad), lambda g, gl: (0, 0)),
-            pl.BlockSpec((1, 1, rot_pad), lambda g, gl: (gl[g], 0, 0)),
-            pl.BlockSpec((1, Wi, cap), lambda g, gl: (gl[g], 0, 0)),
-            pl.BlockSpec((pq_dim, pq_len, book), lambda g, gl: (0, 0, 0)),
-            pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
-            pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((k, nq_pad), lambda g, gl: (0, 0)),
             pl.BlockSpec((k, nq_pad), lambda g, gl: (0, 0)),
@@ -313,15 +339,14 @@ def grouped_code_scan_fused(group_list, slot_pairs, qrot, centers_f32,
         functools.partial(_kernel_codes_fused, kt=kt, k=k,
                           n_probes=n_probes, P=P, pq_dim=pq_dim,
                           pq_bits=pq_bits, n_groups=n_groups,
-                          merge_window=merge_window),
+                          merge_window=merge_window, has_adm=has_adm),
         out_shape=[
             jax.ShapeDtypeStruct((k, nq_pad), jnp.float32),
             jax.ShapeDtypeStruct((k, nq_pad), jnp.int32),
         ],
         grid_spec=grid_spec,
         interpret=interpret,
-    )(group_list, slot_pairs[:, None, :], qrot_pad, cf_pad[:, None, :],
-      codes_lanes, cbT, rsq[:, None, :], list_indices[:, None, :])
+    )(*inputs)
     return vals, gids
 
 
@@ -341,7 +366,8 @@ def _cap_bits(cap: int) -> int:
                                              "packed", "interpret"))
 def grouped_code_scan(group_list, slot_pairs, qrot, centers_f32,
                       codes_lanes, codebooks, rsq, list_indices, kt,
-                      n_probes, pq_bits, packed=False, interpret=False):
+                      n_probes, pq_bits, packed=False, interpret=False,
+                      adm_words=None):
     """Fused grouped scan over packed PQ codes + local top-kt.
 
     Same contract as ``pq_group_scan_pallas.grouped_l2_scan`` with the
@@ -367,18 +393,29 @@ def grouped_code_scan(group_list, slot_pairs, qrot, centers_f32,
     # orientation would lane-pad pq_len (2 at bench shape) to 128
     cbT = jnp.swapaxes(codebooks.astype(jnp.float32), 1, 2)
 
+    has_adm = adm_words is not None
+    in_specs = [
+        pl.BlockSpec((1, 1, GROUP), lambda g, gl: (g, 0, 0)),
+        pl.BlockSpec((nq_pad, rot_pad), lambda g, gl: (0, 0)),
+        pl.BlockSpec((1, 1, rot_pad), lambda g, gl: (gl[g], 0, 0)),
+        pl.BlockSpec((1, Wi, cap), lambda g, gl: (gl[g], 0, 0)),
+        pl.BlockSpec((pq_dim, pq_len, book), lambda g, gl: (0, 0, 0)),
+        pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
+        pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
+    ]
+    inputs = [group_list, slot_pairs[:, None, :], qrot_pad,
+              cf_pad[:, None, :], codes_lanes, cbT, rsq[:, None, :],
+              list_indices[:, None, :]]
+    if has_adm:
+        wc = adm_words.shape[2]
+        in_specs.append(pl.BlockSpec((1, GROUP, wc),
+                                     lambda g, gl: (g, 0, 0)))
+        inputs.append(adm_words)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_groups,),
-        in_specs=[
-            pl.BlockSpec((1, 1, GROUP), lambda g, gl: (g, 0, 0)),
-            pl.BlockSpec((nq_pad, rot_pad), lambda g, gl: (0, 0)),
-            pl.BlockSpec((1, 1, rot_pad), lambda g, gl: (gl[g], 0, 0)),
-            pl.BlockSpec((1, Wi, cap), lambda g, gl: (gl[g], 0, 0)),
-            pl.BlockSpec((pq_dim, pq_len, book), lambda g, gl: (0, 0, 0)),
-            pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
-            pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, GROUP, kt), lambda g, gl: (g, 0, 0)),
             pl.BlockSpec((1, GROUP, kt), lambda g, gl: (g, 0, 0)),
@@ -388,15 +425,14 @@ def grouped_code_scan(group_list, slot_pairs, qrot, centers_f32,
     vals, gids = pl.pallas_call(
         functools.partial(_kernel_codes, kt=kt, n_probes=n_probes, P=P,
                           pq_dim=pq_dim, pq_bits=pq_bits, packed=packed,
-                          cap_bits=_cap_bits(cap)),
+                          cap_bits=_cap_bits(cap), has_adm=has_adm),
         out_shape=[
             jax.ShapeDtypeStruct((n_groups, GROUP, kt), jnp.float32),
             jax.ShapeDtypeStruct((n_groups, GROUP, kt), jnp.int32),
         ],
         grid_spec=grid_spec,
         interpret=interpret,
-    )(group_list, slot_pairs[:, None, :], qrot_pad, cf_pad[:, None, :],
-      codes_lanes, cbT, rsq[:, None, :], list_indices[:, None, :])
+    )(*inputs)
     return vals, gids
 
 
@@ -404,7 +440,7 @@ def grouped_code_scan(group_list, slot_pairs, qrot, centers_f32,
                                              "interpret"))
 def grouped_recon8_scan(group_list, slot_pairs, qrot, centers_f32,
                         recon_i8, scales, rsq8, list_indices, kt, n_probes,
-                        packed=False, interpret=False):
+                        packed=False, interpret=False, adm_words=None):
     """Fused grouped scan over the int8-quantized recon cache.
 
     ``recon_i8`` (n_lists, cap, rot_pad) int8 with lanes already
@@ -423,18 +459,30 @@ def grouped_recon8_scan(group_list, slot_pairs, qrot, centers_f32,
     qrot_pad = qrot_pad.at[:nq, :rot].set(qrot.astype(jnp.float32))
     cf_pad = _pad_lanes(centers_f32, rot_pad)
 
+    has_adm = adm_words is not None
+    in_specs = [
+        pl.BlockSpec((1, 1, GROUP), lambda g, gl: (g, 0, 0)),
+        pl.BlockSpec((nq_pad, rot_pad), lambda g, gl: (0, 0)),
+        pl.BlockSpec((1, 1, rot_pad), lambda g, gl: (gl[g], 0, 0)),
+        pl.BlockSpec((1, cap, rot_pad), lambda g, gl: (gl[g], 0, 0)),
+        pl.BlockSpec((1, 1, 1), lambda g, gl: (gl[g], 0, 0)),
+        pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
+        pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
+    ]
+    inputs = [group_list, slot_pairs[:, None, :], qrot_pad,
+              cf_pad[:, None, :], recon_i8,
+              scales.astype(jnp.float32)[:, None, None],
+              rsq8[:, None, :], list_indices[:, None, :]]
+    if has_adm:
+        wc = adm_words.shape[2]
+        in_specs.append(pl.BlockSpec((1, GROUP, wc),
+                                     lambda g, gl: (g, 0, 0)))
+        inputs.append(adm_words)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_groups,),
-        in_specs=[
-            pl.BlockSpec((1, 1, GROUP), lambda g, gl: (g, 0, 0)),
-            pl.BlockSpec((nq_pad, rot_pad), lambda g, gl: (0, 0)),
-            pl.BlockSpec((1, 1, rot_pad), lambda g, gl: (gl[g], 0, 0)),
-            pl.BlockSpec((1, cap, rot_pad), lambda g, gl: (gl[g], 0, 0)),
-            pl.BlockSpec((1, 1, 1), lambda g, gl: (gl[g], 0, 0)),
-            pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
-            pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, GROUP, kt), lambda g, gl: (g, 0, 0)),
             pl.BlockSpec((1, GROUP, kt), lambda g, gl: (g, 0, 0)),
@@ -443,16 +491,15 @@ def grouped_recon8_scan(group_list, slot_pairs, qrot, centers_f32,
     )
     vals, gids = pl.pallas_call(
         functools.partial(_kernel_recon8, kt=kt, n_probes=n_probes, P=P,
-                          packed=packed, cap_bits=_cap_bits(cap)),
+                          packed=packed, cap_bits=_cap_bits(cap),
+                          has_adm=has_adm),
         out_shape=[
             jax.ShapeDtypeStruct((n_groups, GROUP, kt), jnp.float32),
             jax.ShapeDtypeStruct((n_groups, GROUP, kt), jnp.int32),
         ],
         grid_spec=grid_spec,
         interpret=interpret,
-    )(group_list, slot_pairs[:, None, :], qrot_pad, cf_pad[:, None, :],
-      recon_i8, scales.astype(jnp.float32)[:, None, None],
-      rsq8[:, None, :], list_indices[:, None, :])
+    )(*inputs)
     return vals, gids
 
 

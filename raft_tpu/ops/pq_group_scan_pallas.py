@@ -81,8 +81,23 @@ def _gather_queries(slot_ref, q_ref, n_probes, P):
                                preferred_element_type=jnp.float32)  # (G, d)
 
 
+def _unpack_admission(adm_ref, cap):
+    """In-kernel unpack of the packed per-(slot, candidate) admission
+    words — (1, GROUP, Wc) int32, bit b of word w admitting candidate
+    ``32*w + b`` (the layout :func:`raft_tpu.filters.bitset.pack_mask`
+    writes, built per group by ``group_admission_words``) — to a
+    (GROUP, cap) 0/1 block.  One shift/mask per word: admission costs
+    ~1 bit of VMEM streaming per candidate."""
+    aw = adm_ref[0]                                    # (GROUP, Wc) int32
+    shifts = jax.lax.broadcasted_iota(jnp.int32, aw.shape + (32,), 2)
+    bits = (aw[:, :, None] >> shifts) & 1
+    return bits.reshape(aw.shape[0], -1)[:, :cap]
+
+
 def _kernel(gl_ref, slot_ref, qrot_ref, cf_ref, data_ref, rsq_ref, ids_ref,
-            vals_ref, ids_out_ref, vscratch, pscratch, *, kt, n_probes, P):
+            *rest, kt, n_probes, P, has_adm=False):
+    adm_ref, rest = (rest[0], rest[1:]) if has_adm else (None, rest)
+    vals_ref, ids_out_ref, vscratch, pscratch = rest
     qv = _gather_queries(slot_ref, qrot_ref, n_probes, P)
     sub = qv - cf_ref[0, 0][None, :]                   # (G, rot) f32
     sub_sq = jnp.sum(sub * sub, axis=1)                # (G,)
@@ -93,12 +108,13 @@ def _kernel(gl_ref, slot_ref, qrot_ref, cf_ref, data_ref, rsq_ref, ids_ref,
     d = sub_sq[:, None] + rsq_ref[0, 0][None, :] - 2.0 * ip
     d = jnp.maximum(d, 0.0)
     ids_row = ids_ref[0, 0]                            # (cap,) int32
+    adm = _unpack_admission(adm_ref, d.shape[1]) if has_adm else None
     _extract_topk(d, ids_row, vals_ref, ids_out_ref, vscratch, pscratch,
-                  kt)
+                  kt, adm=adm)
 
 
 def _extract_topk(d, ids_row, vals_ref, ids_out_ref, vscratch, pscratch,
-                  kt):
+                  kt, adm=None):
     """Shared in-VMEM top-kt extraction + position -> global-id mapping.
 
     kt passes of max / where-iota argmin / mask over the (G, cap) block;
@@ -112,6 +128,11 @@ def _extract_topk(d, ids_row, vals_ref, ids_out_ref, vscratch, pscratch,
     dim are Mosaic-hostile, on the sublane dim they are cheap — then one
     in-VMEM transpose on the way out."""
     invalid = (ids_row < 0)[None, :]
+    if adm is not None:
+        # per-(slot, candidate) admission bit: a rejected candidate
+        # folds exactly like a tombstone — excluded before any
+        # selection pass, through the same finite-sentinel seam
+        invalid = invalid | (adm == 0)
     neg = jnp.where(invalid, -jnp.inf, -d)             # select-min as max
 
     cap = neg.shape[1]
@@ -188,13 +209,18 @@ def _gather_queries_masked(slot_ref, q_ref, n_probes, P):
     return qv, oh
 
 
-def _topk_rows(d, ids_row, kt):
+def _topk_rows(d, ids_row, kt, adm=None):
     """Local top-kt of a (G, cap) distance block as sublane-stacked
     (kt, G) value/id rows — the fused twin of :func:`_extract_topk`
     (same max / where-iota argmin / masked-id-reduce passes), except
     results stay in registers for the in-kernel merge and exhausted
-    slots carry the finite ``_ACC_WORST`` instead of +inf."""
+    slots carry the finite ``_ACC_WORST`` instead of +inf.  ``adm``
+    folds per-(slot, candidate) admission bits through the same seam
+    BEFORE any value reaches the staging ring or the accumulator's
+    one-hot products (only finite sentinels ever meet a product)."""
     invalid = (ids_row < 0)[None, :]
+    if adm is not None:
+        invalid = invalid | (adm == 0)
     neg = jnp.where(invalid, -jnp.inf, -d)
     cap = neg.shape[1]
     col = jax.lax.broadcasted_iota(jnp.int32, neg.shape, 1)
@@ -233,14 +259,14 @@ def _merge_topk(cat_v, cat_i, k):
     return jnp.concatenate(out_v, 0), jnp.concatenate(out_i, 0)  # (k, G)
 
 
-def _fused_accumulate(oh, d, ids_row, acc_v, acc_i, kt):
+def _fused_accumulate(oh, d, ids_row, acc_v, acc_i, kt, adm=None):
     """Merge one group's (G, cap) distances into the per-query
     accumulator: local top-kt, gather the slots' accumulator rows via
     the one-hot, merge sorted k+kt candidates per slot, write back.
     The one-hot write-back is exact (each real row is covered by at
     most one slot; sentinel slots have all-zero one-hot rows)."""
     k = acc_v.shape[0]
-    new_v, new_i = _topk_rows(d, ids_row, kt)          # (kt, G)
+    new_v, new_i = _topk_rows(d, ids_row, kt, adm=adm)  # (kt, G)
     old_v = jax.lax.dot_general(acc_v[:], oh, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
     old_i = jax.lax.dot_general(acc_i[:], oh, (((1,), (1,)), ((), ())),
@@ -306,7 +332,7 @@ def _merge_cols(acc_v, acc_i, stg_v, stg_i, k):
 
 
 def _fused_step(g, oh, d, ids_row, acc_v, acc_i, stg, *, kt,
-                merge_window, n_groups):
+                merge_window, n_groups, adm=None):
     """One grid step of the fused accumulator, windowed.
 
     W <= 1 is the original per-step path (:func:`_fused_accumulate` —
@@ -320,10 +346,10 @@ def _fused_step(g, oh, d, ids_row, acc_v, acc_i, stg, *, kt,
     merge so stale slots of a partial final window merge as no-ops.
     """
     if merge_window <= 1:
-        _fused_accumulate(oh, d, ids_row, acc_v, acc_i, kt)
+        _fused_accumulate(oh, d, ids_row, acc_v, acc_i, kt, adm=adm)
         return
     stg_v, stg_i = stg
-    new_v, new_i = _topk_rows(d, ids_row, kt)          # (kt, G), finite
+    new_v, new_i = _topk_rows(d, ids_row, kt, adm=adm)  # (kt, G), finite
     cover = jnp.sum(oh, axis=0)                        # (nq_pad,) 0/1
     fill = (1.0 - cover)[None, :]
     row0 = (g % merge_window) * kt
@@ -342,12 +368,14 @@ def _fused_step(g, oh, d, ids_row, acc_v, acc_i, stg, *, kt,
 
 
 def _kernel_fused(gl_ref, slot_ref, qrot_ref, cf_ref, data_ref, rsq_ref,
-                  ids_ref, vals_ref, ids_out_ref, acc_v, acc_i, *stg,
-                  kt, k, n_probes, P, n_groups, merge_window):
+                  ids_ref, *rest, kt, k, n_probes, P, n_groups,
+                  merge_window, has_adm=False):
     """Fused recon scan: the non-fused ``_kernel`` distance block plus
     the in-kernel accumulator merge (windowed through the staging ring
     when merge_window > 1); outputs are the FINAL per-query (k, nq_pad)
     answers, flushed once on the last grid step."""
+    adm_ref, rest = (rest[0], rest[1:]) if has_adm else (None, rest)
+    vals_ref, ids_out_ref, acc_v, acc_i, *stg = rest
     g = pl.program_id(0)
 
     @pl.when(g == 0)
@@ -367,8 +395,9 @@ def _kernel_fused(gl_ref, slot_ref, qrot_ref, cf_ref, data_ref, rsq_ref,
                              preferred_element_type=jnp.float32)
     d = sub_sq[:, None] + rsq_ref[0, 0][None, :] - 2.0 * ip
     d = jnp.maximum(d, 0.0)
+    adm = _unpack_admission(adm_ref, d.shape[1]) if has_adm else None
     _fused_step(g, oh, d, ids_ref[0, 0], acc_v, acc_i, stg, kt=kt,
-                merge_window=merge_window, n_groups=n_groups)
+                merge_window=merge_window, n_groups=n_groups, adm=adm)
 
     @pl.when(g == n_groups - 1)
     def _flush():
@@ -380,7 +409,7 @@ def _kernel_fused(gl_ref, slot_ref, qrot_ref, cf_ref, data_ref, rsq_ref,
                                              "interpret", "merge_window"))
 def grouped_l2_scan_fused(group_list, slot_pairs, qrot, centers_f32,
                           list_recon, rec_sq, list_indices, kt, k, n_probes,
-                          interpret=False, merge_window=1):
+                          interpret=False, merge_window=1, adm_words=None):
     """Fused grouped recon scan with IN-KERNEL per-query top-k.
 
     Inputs as :func:`grouped_l2_scan`; instead of per-pair winners the
@@ -398,6 +427,10 @@ def grouped_l2_scan_fused(group_list, slot_pairs, qrot, centers_f32,
     W-th step — bit-identical to W=1 (the merge is order-insensitive
     under the finite sentinel; ring order preserves tie retention).
     Pick W with :func:`fused_merge_window`; k > 64 requires W >= 2.
+
+    ``adm_words`` (n_groups, GROUP, ceil(cap/32)) int32 streams packed
+    per-(slot, candidate) admission bits (filtered search): rejected
+    candidates fold to the finite sentinel before the windowed merge.
     """
     n_groups = group_list.shape[0]
     nq, rot = qrot.shape
@@ -408,17 +441,28 @@ def grouped_l2_scan_fused(group_list, slot_pairs, qrot, centers_f32,
     qrot_pad = jnp.zeros((nq_pad, rot), jnp.float32)
     qrot_pad = qrot_pad.at[:nq].set(qrot.astype(jnp.float32))
 
+    has_adm = adm_words is not None
+    in_specs = [
+        pl.BlockSpec((1, 1, GROUP), lambda g, gl: (g, 0, 0)),
+        pl.BlockSpec((nq_pad, rot), lambda g, gl: (0, 0)),
+        pl.BlockSpec((1, 1, rot), lambda g, gl: (gl[g], 0, 0)),
+        pl.BlockSpec((1, cap, rot), lambda g, gl: (gl[g], 0, 0)),
+        pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
+        pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
+    ]
+    inputs = [group_list, slot_pairs[:, None, :], qrot_pad,
+              centers_f32[:, None, :], list_recon, rec_sq[:, None, :],
+              list_indices[:, None, :]]
+    if has_adm:
+        wc = adm_words.shape[2]
+        in_specs.append(pl.BlockSpec((1, GROUP, wc),
+                                     lambda g, gl: (g, 0, 0)))
+        inputs.append(adm_words)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_groups,),
-        in_specs=[
-            pl.BlockSpec((1, 1, GROUP), lambda g, gl: (g, 0, 0)),
-            pl.BlockSpec((nq_pad, rot), lambda g, gl: (0, 0)),
-            pl.BlockSpec((1, 1, rot), lambda g, gl: (gl[g], 0, 0)),
-            pl.BlockSpec((1, cap, rot), lambda g, gl: (gl[g], 0, 0)),
-            pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
-            pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((k, nq_pad), lambda g, gl: (0, 0)),
             pl.BlockSpec((k, nq_pad), lambda g, gl: (0, 0)),
@@ -428,16 +472,14 @@ def grouped_l2_scan_fused(group_list, slot_pairs, qrot, centers_f32,
     vals, gids = pl.pallas_call(
         functools.partial(_kernel_fused, kt=kt, k=k, n_probes=n_probes,
                           P=P, n_groups=n_groups,
-                          merge_window=merge_window),
+                          merge_window=merge_window, has_adm=has_adm),
         out_shape=[
             jax.ShapeDtypeStruct((k, nq_pad), jnp.float32),
             jax.ShapeDtypeStruct((k, nq_pad), jnp.int32),
         ],
         grid_spec=grid_spec,
         interpret=interpret,
-    )(group_list, slot_pairs[:, None, :], qrot_pad,
-      centers_f32[:, None, :], list_recon, rec_sq[:, None, :],
-      list_indices[:, None, :])
+    )(*inputs)
     return vals, gids
 
 
@@ -502,10 +544,11 @@ def fused_reject_reason(metric_is_l2: bool, cap: int, rot: int, kt: int,
 
 
 def _kernel_flat(gl_ref, slot_ref, q_ref, data_ref, dsq_ref, ids_ref,
-                 vals_ref, ids_out_ref, vscratch, pscratch, *, kt,
-                 n_probes, P):
+                 *rest, kt, n_probes, P, has_adm=False):
     """IVF-Flat variant: exact fp32 distances over raw list vectors
     (d = ||q||^2 + ||x||^2 - 2 q.x), same gather/extraction structure."""
+    adm_ref, rest = (rest[0], rest[1:]) if has_adm else (None, rest)
+    vals_ref, ids_out_ref, vscratch, pscratch = rest
     qv = _gather_queries(slot_ref, q_ref, n_probes, P)
     q_sq = jnp.sum(qv * qv, axis=1)                    # (G,)
     data = data_ref[0]                                 # (cap, d) f32
@@ -514,13 +557,15 @@ def _kernel_flat(gl_ref, slot_ref, q_ref, data_ref, dsq_ref, ids_ref,
                              preferred_element_type=jnp.float32)
     d = jnp.maximum(q_sq[:, None] + dsq_ref[0, 0][None, :] - 2.0 * ip, 0.0)
     ids_row = ids_ref[0, 0]                            # (cap,) int32
+    adm = _unpack_admission(adm_ref, d.shape[1]) if has_adm else None
     _extract_topk(d, ids_row, vals_ref, ids_out_ref, vscratch, pscratch,
-                  kt)
+                  kt, adm=adm)
 
 
 @functools.partial(jax.jit, static_argnames=("kt", "n_probes", "interpret"))
 def grouped_l2_scan(group_list, slot_pairs, qrot, centers_f32, list_recon,
-                    rec_sq, list_indices, kt, n_probes, interpret=False):
+                    rec_sq, list_indices, kt, n_probes, interpret=False,
+                    adm_words=None):
     """Fused query-gather + distance + local top-kt over all pair groups.
 
     ``group_list`` (n_groups,) int32; ``slot_pairs`` (n_groups, GROUP)
@@ -530,6 +575,11 @@ def grouped_l2_scan(group_list, slot_pairs, qrot, centers_f32, list_recon,
     cap) f32; ``list_indices`` (n_lists, cap) int32.  Returns
     ``(vals (n_groups, GROUP, kt) f32, ids ... int32)`` sorted ascending
     (L2); exhausted rows carry +inf values (callers map them to -1 ids).
+
+    ``adm_words`` (n_groups, GROUP, ceil(cap/32)) int32, optional:
+    packed per-(slot, candidate) admission bits in list-slot order
+    (:func:`raft_tpu.filters.bitset.group_admission_words`); rejected
+    candidates fold like tombstones before extraction.
     """
     n_groups = group_list.shape[0]
     nq, rot = qrot.shape
@@ -542,17 +592,28 @@ def grouped_l2_scan(group_list, slot_pairs, qrot, centers_f32, list_recon,
     qrot_pad = jnp.zeros((nq_pad, rot), jnp.float32)
     qrot_pad = qrot_pad.at[:nq].set(qrot.astype(jnp.float32))
 
+    has_adm = adm_words is not None
+    in_specs = [
+        pl.BlockSpec((1, 1, GROUP), lambda g, gl: (g, 0, 0)),
+        pl.BlockSpec((nq_pad, rot), lambda g, gl: (0, 0)),
+        pl.BlockSpec((1, 1, rot), lambda g, gl: (gl[g], 0, 0)),
+        pl.BlockSpec((1, cap, rot), lambda g, gl: (gl[g], 0, 0)),
+        pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
+        pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
+    ]
+    inputs = [group_list, slot_pairs[:, None, :], qrot_pad,
+              centers_f32[:, None, :], list_recon, rec_sq[:, None, :],
+              list_indices[:, None, :]]
+    if has_adm:
+        wc = adm_words.shape[2]
+        in_specs.append(pl.BlockSpec((1, GROUP, wc),
+                                     lambda g, gl: (g, 0, 0)))
+        inputs.append(adm_words)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_groups,),
-        in_specs=[
-            pl.BlockSpec((1, 1, GROUP), lambda g, gl: (g, 0, 0)),
-            pl.BlockSpec((nq_pad, rot), lambda g, gl: (0, 0)),
-            pl.BlockSpec((1, 1, rot), lambda g, gl: (gl[g], 0, 0)),
-            pl.BlockSpec((1, cap, rot), lambda g, gl: (gl[g], 0, 0)),
-            pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
-            pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, GROUP, kt), lambda g, gl: (g, 0, 0)),
             pl.BlockSpec((1, GROUP, kt), lambda g, gl: (g, 0, 0)),
@@ -560,22 +621,22 @@ def grouped_l2_scan(group_list, slot_pairs, qrot, centers_f32, list_recon,
         scratch_shapes=_scratch_shapes(kt),
     )
     vals, gids = pl.pallas_call(
-        functools.partial(_kernel, kt=kt, n_probes=n_probes, P=P),
+        functools.partial(_kernel, kt=kt, n_probes=n_probes, P=P,
+                          has_adm=has_adm),
         out_shape=[
             jax.ShapeDtypeStruct((n_groups, GROUP, kt), jnp.float32),
             jax.ShapeDtypeStruct((n_groups, GROUP, kt), jnp.int32),
         ],
         grid_spec=grid_spec,
         interpret=interpret,
-    )(group_list, slot_pairs[:, None, :], qrot_pad,
-      centers_f32[:, None, :], list_recon, rec_sq[:, None, :],
-      list_indices[:, None, :])
+    )(*inputs)
     return vals, gids
 
 
 @functools.partial(jax.jit, static_argnames=("kt", "n_probes", "interpret"))
 def grouped_flat_l2_scan(group_list, slot_pairs, queries_f32, list_data,
-                         d_sq, list_indices, kt, n_probes, interpret=False):
+                         d_sq, list_indices, kt, n_probes, interpret=False,
+                         adm_words=None):
     """IVF-Flat fused scan: exact fp32 distances over raw list vectors.
     Same contract as :func:`grouped_l2_scan` with ``queries_f32``
     (nq, dim) raw queries, ``list_data`` (n_lists, cap, dim) fp32 and
@@ -589,16 +650,27 @@ def grouped_flat_l2_scan(group_list, slot_pairs, queries_f32, list_data,
     q_pad = jnp.zeros((nq_pad, dim), jnp.float32)
     q_pad = q_pad.at[:nq].set(queries_f32.astype(jnp.float32))
 
+    has_adm = adm_words is not None
+    in_specs = [
+        pl.BlockSpec((1, 1, GROUP), lambda g, gl: (g, 0, 0)),
+        pl.BlockSpec((nq_pad, dim), lambda g, gl: (0, 0)),
+        pl.BlockSpec((1, cap, dim), lambda g, gl: (gl[g], 0, 0)),
+        pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
+        pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
+    ]
+    inputs = [group_list, slot_pairs[:, None, :], q_pad,
+              list_data.astype(jnp.float32), d_sq[:, None, :],
+              list_indices[:, None, :]]
+    if has_adm:
+        wc = adm_words.shape[2]
+        in_specs.append(pl.BlockSpec((1, GROUP, wc),
+                                     lambda g, gl: (g, 0, 0)))
+        inputs.append(adm_words)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_groups,),
-        in_specs=[
-            pl.BlockSpec((1, 1, GROUP), lambda g, gl: (g, 0, 0)),
-            pl.BlockSpec((nq_pad, dim), lambda g, gl: (0, 0)),
-            pl.BlockSpec((1, cap, dim), lambda g, gl: (gl[g], 0, 0)),
-            pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
-            pl.BlockSpec((1, 1, cap), lambda g, gl: (gl[g], 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, GROUP, kt), lambda g, gl: (g, 0, 0)),
             pl.BlockSpec((1, GROUP, kt), lambda g, gl: (g, 0, 0)),
@@ -606,16 +678,15 @@ def grouped_flat_l2_scan(group_list, slot_pairs, queries_f32, list_data,
         scratch_shapes=_scratch_shapes(kt),
     )
     vals, gids = pl.pallas_call(
-        functools.partial(_kernel_flat, kt=kt, n_probes=n_probes, P=P),
+        functools.partial(_kernel_flat, kt=kt, n_probes=n_probes, P=P,
+                          has_adm=has_adm),
         out_shape=[
             jax.ShapeDtypeStruct((n_groups, GROUP, kt), jnp.float32),
             jax.ShapeDtypeStruct((n_groups, GROUP, kt), jnp.int32),
         ],
         grid_spec=grid_spec,
         interpret=interpret,
-    )(group_list, slot_pairs[:, None, :], q_pad,
-      list_data.astype(jnp.float32), d_sq[:, None, :],
-      list_indices[:, None, :])
+    )(*inputs)
     return vals, gids
 
 

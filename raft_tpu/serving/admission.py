@@ -100,6 +100,11 @@ class Request:
     # per-request SpanRecorder minted by Server.submit when tracing is
     # enabled (None otherwise); the batcher closes + flight-records it
     trace: Optional[object] = None
+    # packed (n, n_filter_words) int32 admission bitset over global row
+    # ids — HOST-side numpy (filters are data, not shape: the batcher
+    # copies rows into the bucket's fixed-width filter buffer exactly
+    # like query rows).  None = admit everything for this request.
+    filter_words: Optional[object] = None
 
     @property
     def trace_id(self) -> Optional[int]:

@@ -187,6 +187,20 @@ class DynamicBatcher:
         for r in live:
             buf[off:off + r.n] = np.asarray(r.queries)
             off += r.n
+        # per-query admission bitsets ride the SAME assembly path: a
+        # fixed (bucket, n_words) int32 buffer — data, not shape — with
+        # all-ones rows (admit everything) for unfiltered requests and
+        # padding.  Skipped entirely (None -> the executor's cached
+        # all-ones buffer) when no live request carries a filter.
+        fbuf = None
+        nw = getattr(self.executor, "n_filter_words", 0)
+        if nw and any(r.filter_words is not None for r in live):
+            fbuf = np.full((bucket, nw), -1, dtype=np.int32)
+            off = 0
+            for r in live:
+                if r.filter_words is not None:
+                    fbuf[off:off + r.n] = r.filter_words
+                off += r.n
         t_exec0 = time.monotonic()
         # the generation snapshot this batch serves from — pinned here so
         # the shadow monitor can refuse to compare across a swap
@@ -197,8 +211,13 @@ class DynamicBatcher:
             # demand; inactive it is one None check on the hot path
             _faults.maybe_fail("serving.dispatch")
             with _trace.activating(batch_rec):
-                d, i = self.executor.search_bucket(jnp.asarray(buf), n, k,
-                                                   rung=rung)
+                # kwarg only when a live request carries a filter, so
+                # executors (and test doubles) with the pre-filter
+                # search_bucket signature keep working unfiltered
+                fkw = ({"filter_words": jnp.asarray(fbuf)}
+                       if fbuf is not None else {})
+                d, i = self.executor.search_bucket(
+                    jnp.asarray(buf), n, k, rung=rung, **fkw)
                 # graftlint: disable=host-sync -- THE one readback: results must leave the device to resolve request futures
                 d, i = np.asarray(d), np.asarray(i)
         except BaseException as e:  # noqa: BLE001 - forwarded per request

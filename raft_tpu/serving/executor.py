@@ -49,6 +49,8 @@ from raft_tpu.core.aot import executables as _aot_executables
 from raft_tpu.core.error import expects
 from raft_tpu.observability import flight as _flight
 from raft_tpu.distance.types import DistanceType
+from raft_tpu.filters import SampleFilter
+from raft_tpu.filters import bitset as _fbits
 from raft_tpu.integrity import boundary as _boundary
 from raft_tpu.neighbors import delta as _delta
 from raft_tpu.serving.buckets import bucket_sizes, pad_rows, valid_rows_mask
@@ -75,7 +77,8 @@ class Executor:
 
     def __init__(self, res, kind: str, index, *, ks: Sequence[int] = (10,),
                  max_batch: int = 1024, search_params=None,
-                 ladder: Sequence = (), warm: str = "aot") -> None:
+                 ladder: Sequence = (), warm: str = "aot",
+                 filter_rows: int = 0) -> None:
         expects(kind in _KINDS,
                 f"serving: unknown executor kind {kind!r} (one of {_KINDS})")
         expects(warm in ("aot", "jit"),
@@ -88,6 +91,15 @@ class Executor:
         self.params = search_params
         self._rung_params: Tuple = (search_params, *ladder)
         self.warm = warm
+        # filtered serving (PR 20): filter_rows > 0 declares the id
+        # space admission bitsets cover; every warmed executable then
+        # takes a (bucket, n_filter_words) int32 words input — data, not
+        # shape, so one compiled program serves every predicate
+        # (all-ones words = unfiltered).  0 keeps the one-input shapes.
+        self.filter_rows = int(filter_rows)
+        self.n_filter_words = (_fbits.n_words_for(self.filter_rows)
+                               if self.filter_rows else 0)
+        self._ones_words: Dict[int, jax.Array] = {}
         self.buckets = bucket_sizes(self.max_batch)
         self._fns: Dict[Tuple[int, int, int], Callable] = {}
         self._delta = None
@@ -202,6 +214,11 @@ class Executor:
     def _aot_fn(self, index, bucket: int, k: int, params, rung: int
                 ) -> Callable:
         cache = _aot_executables()
+        # the filter-buffer width joins the export kwargs (and so the
+        # cache key) — its shape depends only on the declared id bound,
+        # never on filter contents, so the key stays bucket-shaped
+        fkw = ({"n_filter_words": self.n_filter_words}
+               if self.n_filter_words else {})
         if self.kind == "ivf_pq":
             from raft_tpu.ops import vmem_budget as vb
             n_probes = min(params.n_probes, index.n_lists)
@@ -218,19 +235,20 @@ class Executor:
                 getattr(params, "merge_window", "auto"))
             return cache.get("ivf_pq", self.res, index, batch=bucket,
                              k=k, n_probes=n_probes, scan_mode=mode,
-                             rung=rung, merge_window=mw)
+                             rung=rung, merge_window=mw, **fkw)
         if self.kind == "ivf_flat":
             n_probes = min(params.n_probes, index.n_lists)
             return cache.get("ivf_flat", self.res, index, batch=bucket,
-                             k=k, n_probes=n_probes, rung=rung)
+                             k=k, n_probes=n_probes, rung=rung, **fkw)
         if self.kind == "brute_force":
             return cache.get("brute_force", self.res, index,
-                             batch=bucket, k=k, rung=rung)
+                             batch=bucket, k=k, rung=rung, **fkw)
         # cagra: export when the packed walk calibrates, else live
         itopk = max(getattr(params, "itopk_size", 64), k)
         width = getattr(params, "search_width", 1)
         return cache.get("cagra", self.res, index, batch=bucket, k=k,
-                         rung=rung, itopk=itopk, search_width=width)
+                         rung=rung, itopk=itopk, search_width=width,
+                         **fkw)
 
     def _live_fn(self, index, k: int, params) -> Callable:
         # live module entry points under validation policy "off": the
@@ -249,10 +267,30 @@ class Executor:
         else:
             from raft_tpu.neighbors import brute_force
 
+            if self.n_filter_words:
+                n_rows = self.filter_rows
+
+                def bf_f(queries, fw):
+                    with config.validation_policy("off"):
+                        return brute_force.knn(
+                            self.res, index, queries, k,
+                            filter=SampleFilter.from_words(fw, n_rows))
+                return bf_f
+
             def bf(queries):
                 with config.validation_policy("off"):
                     return brute_force.knn(self.res, index, queries, k)
             return bf
+
+        if self.n_filter_words:
+            n_rows = self.filter_rows
+
+            def live_f(queries, fw):
+                with config.validation_policy("off"):
+                    return mod.search(
+                        self.res, params, index, queries, k,
+                        filter=SampleFilter.from_words(fw, n_rows))
+            return live_f
 
         def live(queries):
             with config.validation_policy("off"):
@@ -286,7 +324,11 @@ class Executor:
                     fn = self._build_fn(new_index, b, k, r)
                     if self._warmed:
                         zeros = jnp.zeros((b, dim), self.query_dtype)
-                        jax.block_until_ready(fn(zeros))
+                        if self.n_filter_words:
+                            jax.block_until_ready(
+                                fn(zeros, self._all_ones_words(b)))
+                        else:
+                            jax.block_until_ready(fn(zeros))
                     fns[(b, k, r)] = fn
         self.index, self._fns = new_index, fns
         if obs.enabled():
@@ -301,17 +343,36 @@ class Executor:
 
     # ---- the hot path ---------------------------------------------------
 
-    def search_bucket(self, queries, n_valid: int, k: int, rung: int = 0
-                      ) -> Tuple[jax.Array, jax.Array]:
+    def _all_ones_words(self, bucket: int) -> jax.Array:
+        """The cached admit-everything words buffer for ``bucket`` —
+        what an unfiltered dispatch feeds a filter-configured executor
+        so every dispatch shares ONE compiled shape."""
+        w = self._ones_words.get(bucket)
+        if w is None:
+            w = jnp.full((bucket, self.n_filter_words), -1, jnp.int32)
+            self._ones_words[bucket] = w
+        return w
+
+    def search_bucket(self, queries, n_valid: int, k: int, rung: int = 0,
+                      filter_words=None) -> Tuple[jax.Array, jax.Array]:
         """Search a padded bucket batch; rows past ``n_valid`` come back
         masked (id -1 / worst distance) through the integrity mask path.
         ``rung`` selects the degradation-ladder operating point (0 =
         full quality); every rung is warmed, so the selection is a dict
-        lookup, never a compile."""
+        lookup, never a compile.
+
+        ``filter_words`` is the batch's packed admission bitset
+        ``(bucket, n_filter_words)`` int32 — only meaningful on an
+        executor constructed with ``filter_rows > 0`` (None there means
+        admit everything via the cached all-ones buffer; filters are
+        data, so either way it is the same warmed executable)."""
         bucket = queries.shape[0]
         expects(0 <= rung < self.n_rungs,
                 f"serving: rung {rung} outside the declared ladder "
                 f"(n_rungs={self.n_rungs})")
+        expects(filter_words is None or self.n_filter_words > 0,
+                "serving: executor not configured for filters — "
+                "construct with filter_rows=<id bound>")
         # one capture of the published table: a concurrent swap_index
         # replaces self._fns wholesale, so everything below dispatches
         # against a single consistent generation
@@ -322,14 +383,24 @@ class Executor:
                 f"warmed bucket")
         if fn is None:
             fn = self._obtain(bucket, k, rung)
-        d, i = fn(queries)
+        fw = None
+        if self.n_filter_words:
+            fw = (filter_words if filter_words is not None
+                  else self._all_ones_words(bucket))
+            expects(fw.shape == (bucket, self.n_filter_words),
+                    f"serving: filter words shape {fw.shape} != "
+                    f"({bucket}, {self.n_filter_words})")
+            d, i = fn(queries, fw)
+        else:
+            d, i = fn(queries)
         delta = self._delta
         if delta is not None:
             data, ids, tombs = delta()
             d, i = _delta.merge_with_main(
                 d, i, queries, data, ids, tombs, k=k,
                 metric=getattr(self.index, "metric",
-                               DistanceType.L2Expanded))
+                               DistanceType.L2Expanded),
+                filter_words=fw)
         if n_valid < bucket:
             d, i = _boundary.mask_search_outputs(
                 d, i, valid_rows_mask(n_valid, bucket),
@@ -367,6 +438,7 @@ class Executor:
             "kt": getattr(params, "per_probe_topk", None),
             "merge_window": mw if isinstance(mw, (int, str,
                                                   type(None))) else str(mw),
+            "filtered": bool(self.n_filter_words),
         }
 
 
@@ -413,13 +485,13 @@ class DistributedExecutor(Executor):
     def __init__(self, handle, index, *, ks: Sequence[int] = (10,),
                  max_batch: int = 1024, search_params=None,
                  failed_shards: Sequence[int] = (),
-                 routing=None) -> None:
+                 routing=None, filter_rows: int = 0) -> None:
         self.handle = handle
         self.failed_shards = tuple(failed_shards)
         self.routing = routing
         super().__init__(handle, "ivf_pq", index, ks=ks,
                          max_batch=max_batch, search_params=search_params,
-                         warm="jit")
+                         warm="jit", filter_rows=filter_rows)
         self._feed_routing_rows(index)
 
     def _index_dim(self, index) -> int:
@@ -529,6 +601,18 @@ class DistributedExecutor(Executor):
     def _routed_fn(self, index, k: int, params, routing) -> Callable:
         from raft_tpu import config
         from raft_tpu.distributed import ann
+
+        if self.n_filter_words:
+            n_rows = self.filter_rows
+
+            def live_f(queries, fw):
+                with config.validation_policy("off"):
+                    return ann.search(
+                        self.handle, params, index, queries, k,
+                        failed_shards=self.failed_shards,
+                        routing=routing,
+                        filter=SampleFilter.from_words(fw, n_rows))
+            return live_f
 
         def live(queries):
             with config.validation_policy("off"):

@@ -43,6 +43,38 @@ from raft_tpu.serving.batcher import DynamicBatcher
 from raft_tpu.serving.brownout import BrownoutState
 
 
+def _host_filter_words(filter, n: int, nw: int) -> np.ndarray:
+    """Normalize a per-request filter to HOST-side ``(n, nw)`` packed
+    int32 words.  Accepts a :class:`~raft_tpu.filters.SampleFilter` (one
+    row broadcasts to the request) or a bool mask (``(n_rows,)`` or
+    ``(n, n_rows)``).  Narrower filters zero-pad — ids beyond the
+    filter's coverage stay rejected, matching the device-side coverage
+    check in :func:`raft_tpu.filters.bitset.query_bits`."""
+    from raft_tpu.filters import SampleFilter
+    if isinstance(filter, SampleFilter):
+        w = np.asarray(filter.words).astype(np.int32, copy=False)
+    else:
+        m = np.asarray(filter, dtype=bool)
+        if m.ndim == 1:
+            m = m[None, :]
+        expects(m.ndim == 2,
+                "serving: filter mask must be 1-D or (n, n_rows)")
+        pad = (-m.shape[1]) % 32
+        if pad:
+            m = np.pad(m, ((0, 0), (0, pad)))
+        w = np.packbits(m, axis=1, bitorder="little").view(np.int32)
+    expects(w.shape[0] in (1, n),
+            f"serving: filter has {w.shape[0]} rows for a {n}-row request")
+    expects(w.shape[1] <= nw,
+            f"serving: filter coverage ({w.shape[1]} words) exceeds the "
+            f"executor's filter_rows bound ({nw} words)")
+    if w.shape[1] < nw:
+        w = np.pad(w, ((0, 0), (0, nw - w.shape[1])))
+    if w.shape[0] == 1 and n > 1:
+        w = np.broadcast_to(w, (n, nw))
+    return w
+
+
 @dataclasses.dataclass
 class ServerConfig:
     """Serving knobs (see docs/api.md "Serving" for sizing guidance).
@@ -58,6 +90,12 @@ class ServerConfig:
     max_wait_us: float = 2000.0
     max_queue_rows: int = 8192
     tenant_quotas: Optional[Dict[str, Tuple[float, float]]] = None
+    # tenant NAMESPACES (round 20): a raft_tpu.filters.TenantFilter
+    # mapping tenant -> disjoint id range.  When set, every submit's
+    # tenant= resolves to its namespace bitset (ANDed with any request
+    # filter) so a tenant can only ever surface its own ids; requires an
+    # executor constructed with filter_rows > 0.
+    tenants: Optional[object] = None
     # default per-request deadline (seconds); None = no deadline
     default_deadline_s: Optional[float] = None
     # generation watchdog (auto-rollback): N integrity strikes within
@@ -81,6 +119,10 @@ class Server:
         # lock-free.  Level 0 with no controller attached — a plain
         # server behaves exactly as before.
         self.brownout = BrownoutState()
+        if self.config.tenants is not None:
+            expects(getattr(executor, "n_filter_words", 0) > 0,
+                    "serving: tenant namespaces need a filter-configured "
+                    "executor — construct with filter_rows=<id bound>")
         self.queue = AdmissionQueue(self.config.max_queue_rows,
                                     self.config.tenant_quotas,
                                     brownout=self.brownout)
@@ -268,7 +310,8 @@ class Server:
 
     def submit(self, queries, k: Optional[int] = None, *,
                tenant: str = "default",
-               deadline: Optional[Deadline] = None) -> Future:
+               deadline: Optional[Deadline] = None,
+               filter=None) -> Future:
         """Enqueue one request; returns a Future resolving to
         ``(distances, indices)`` of shape (n, k).
 
@@ -278,6 +321,17 @@ class Server:
         the deadline expires while queued.  Under validation policy
         ``mask``, non-finite query rows resolve to id -1 / worst
         distance (the integrity mask path).
+
+        ``filter`` (round 20): a per-request admission predicate — a
+        :class:`~raft_tpu.filters.SampleFilter` or a bool mask over
+        global row ids (one row broadcasts to the request; (n, n_rows)
+        applies per query).  Needs an executor constructed with
+        ``filter_rows > 0``.  With :attr:`ServerConfig.tenants`
+        configured, the request's ``tenant=`` resolves to its namespace
+        bitset and is ANDed in — a tenant can only surface its own ids
+        regardless of the request filter.  Filters are data, not shape:
+        they ride the queue host-side and never change the warmed
+        bucket executables (zero steady-state recompiles).
         """
         expects(self._started, "serving: server not started")
         # per-request trace: minted HERE, at the front door, so spans from
@@ -309,14 +363,34 @@ class Server:
                 f"{self.config.max_batch}; split the request")
         if deadline is None and self.config.default_deadline_s is not None:
             deadline = Deadline(self.config.default_deadline_s)
+        # per-request admission bitset: normalized host-side (numpy) so
+        # the queue carries no device arrays; the tenant namespace ANDs
+        # in last, making isolation non-bypassable by the request filter
+        nw = getattr(self.executor, "n_filter_words", 0)
+        fw = None
+        if filter is not None:
+            expects(nw > 0,
+                    "serving: executor not configured for filters — "
+                    "construct with filter_rows=<id bound>")
+            fw = _host_filter_words(filter, n, nw)
+        if self.config.tenants is not None:
+            tw = self.config.tenants.words_for(tenant)
+            expects(tw.size == nw,
+                    "serving: tenant namespace width "
+                    f"({tw.size} words) != executor filter width ({nw}) "
+                    "— configure TenantFilter with n_rows=filter_rows")
+            fw = (np.broadcast_to(tw, (n, nw)) if fw is None
+                  else fw & tw[None, :])
         req = Request(queries=queries, k=k, tenant=tenant,
                       deadline=deadline, future=Future(), n=n,
                       t_enqueue=time.monotonic(), ok_rows=ok_rows,
-                      trace=rt)
+                      trace=rt, filter_words=fw)
         if rt is not None:
             rt.annotate("tenant", tenant)
             rt.annotate("rows", n)
             rt.annotate("k", k)
+            if fw is not None:
+                rt.annotate("filtered", True)
             # a degraded bucket stamps every trace — including one shed
             # below — with the level that served (or refused) it
             lvl = self.brownout.level
@@ -339,10 +413,11 @@ class Server:
     def search(self, queries, k: Optional[int] = None, *,
                tenant: str = "default",
                deadline: Optional[Deadline] = None,
-               timeout: Optional[float] = None):
+               timeout: Optional[float] = None,
+               filter=None):
         """Synchronous convenience: ``submit(...).result(timeout)``."""
-        return self.submit(queries, k, tenant=tenant,
-                           deadline=deadline).result(timeout=timeout)
+        return self.submit(queries, k, tenant=tenant, deadline=deadline,
+                           filter=filter).result(timeout=timeout)
 
     # ---- routing maintenance --------------------------------------------
 

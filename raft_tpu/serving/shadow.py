@@ -105,6 +105,10 @@ class ShadowSample:
     rung: int
     index: Any                # the generation snapshot that served it
     t: float
+    # the request's packed admission bitset (host numpy, (n, n_words))
+    # — the ground-truth replay runs under the SAME filter the served
+    # answer did, so a selective filter never reads as recall loss
+    filter_words: Optional[np.ndarray] = None
 
 
 def ground_truth_search_params(kind: str, index, params=None):
@@ -202,12 +206,14 @@ class ShadowMonitor:
             # through the same placement map as live traffic
             return DistributedExecutor(ex.handle, ex.index, ks=ex.ks,
                                        max_batch=mb, search_params=params,
-                                       failed_shards=ex.failed_shards)
+                                       failed_shards=ex.failed_shards,
+                                       filter_rows=ex.filter_rows)
         params = (self.config.ground_truth_params
                   or ground_truth_search_params(ex.kind, ex.index,
                                                 ex.params))
         return Executor(ex.res, ex.kind, ex.index, ks=ex.ks, max_batch=mb,
-                        search_params=params, warm=ex.warm)
+                        search_params=params, warm=ex.warm,
+                        filter_rows=ex.filter_rows)
 
     @property
     def executor(self):
@@ -305,15 +311,20 @@ class ShadowMonitor:
                 continue
             q = r.queries
             ids = ri
+            fw = getattr(r, "filter_words", None)
             if r.ok_rows is not None:
                 ok = r.ok_rows
                 q = q[ok]
                 ids = ids[ok]
+                if fw is not None:
+                    fw = fw[np.asarray(ok)]
             if q.shape[0] == 0:
                 continue
             sample = ShadowSample(queries=q.copy(), served_ids=ids.copy(),
                                   k=k, tenant=r.tenant, rung=rung,
-                                  index=index, t=self._clock())
+                                  index=index, t=self._clock(),
+                                  filter_words=(np.array(fw, np.int32)
+                                                if fw is not None else None))
             sampled += sample.queries.shape[0]
             with self._cond:
                 self._samples.append(sample)
@@ -358,17 +369,31 @@ class ShadowMonitor:
             return
         q = sample.queries
         served = sample.served_ids
+        fw = sample.filter_words
         if q.shape[0] > ex.max_batch:
             _count("serving.shadow.truncated",
                    q.shape[0] - ex.max_batch)
             q = q[:ex.max_batch]
             served = served[:ex.max_batch]
+            if fw is not None:
+                fw = fw[:ex.max_batch]
         n = int(q.shape[0])
         bucket = bucket_for(n, ex.max_batch)
         buf = np.zeros((bucket, ex.dim), dtype=ex.query_dtype)
         buf[:n] = q
+        # filtered-recall accounting: the ground truth is computed under
+        # the SAME admission bitset the served answer used — padded rows
+        # get all-ones (they are sliced away below)
+        fwords = None
+        if fw is not None:
+            nw = int(ex.n_filter_words)
+            fbuf = np.full((bucket, nw), -1, dtype=np.int32)
+            fbuf[:n] = fw
+            fwords = jnp.asarray(fbuf)
+            _count("serving.shadow.replayed.filtered", n)
         with obs.stage("serving.shadow.replay"):
-            _d, i = ex.search_bucket(jnp.asarray(buf), n, sample.k, rung=0)
+            _d, i = ex.search_bucket(jnp.asarray(buf), n, sample.k, rung=0,
+                                     filter_words=fwords)
             gt = np.asarray(i)[:n]
         hits = total = 0
         h_sample = (obs.registry().histogram("serving.quality.sample_recall")

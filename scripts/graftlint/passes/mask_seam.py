@@ -26,6 +26,18 @@ and the kernel must agree on the VMEM footprint, the fused kernels'
 ``scratch_shapes`` must be sized by the shared budget helpers, never
 by inline shape lists.
 
+The fourth seam is the round-20 admission bit: filtered search streams
+packed per-(query, candidate) admission words into the fused kernels,
+which unpack them to 0/1 blocks (``adm`` / ``adm_ref`` / ``adm_words``).
+The ONLY safe way to apply that bit is to fold it into the existing
+validity mask (``invalid | (adm == 0)`` / ``ok & (adm > 0)``) so the
+rejected candidate takes the finite ``_ACC_WORST`` sentinel exactly
+like padding.  Multiplying admission bits into distances reintroduces
+the ``0 * inf`` hazard AND silently turns a rejected candidate into a
+zero-distance best hit; selecting with an ``inf`` branch poisons the
+merge; comparing against a non-zero constant (``adm == 1``) breaks the
+moment the unpack widens its nonzero encoding.
+
 Rules:
 
 - ``mask-seam``: ``== -1`` / ``!= -1`` comparisons against id-ish
@@ -35,6 +47,10 @@ Rules:
 - ``mask-seam``: a multiplication / matmul / ``dot`` in
   ``raft_tpu/ops/*_pallas.py`` with an ``inf`` literal anywhere in its
   operands.
+- ``admission-seam``: in ``raft_tpu/ops/*_pallas.py``, an
+  admission-bit expression used as a product operand, an admission
+  conditional select whose branches carry an ``inf`` literal, or an
+  admission bit compared against a non-zero constant.
 - ``staging-ring``: a write to a staging-ring / accumulator scratch
   ref in ``raft_tpu/ops/*_pallas.py`` whose value contains an ``inf``
   literal or a non-sentinel huge-float fill.
@@ -116,6 +132,25 @@ def _idish_expr(node: ast.AST) -> Optional[str]:
     return None
 
 
+def _admish(name: str) -> bool:
+    n = name.lower()
+    return (n == "adm" or n == "admission" or n.startswith("adm_")
+            or n.endswith("_adm") or "admission" in n)
+
+
+def _admish_expr(node: ast.AST) -> bool:
+    """True when an expression reads an admission-bit buffer (follows
+    subscript/attribute bases: ``adm_ref[0]``, ``st.adm[:, None]``)."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute):
+        return _admish(node.attr)
+    return isinstance(node, ast.Name) and _admish(node.id)
+
+
+_SELECT_CALLS = {"where", "select", "select_n"}
+
+
 def _is_minus_one(node: ast.AST) -> bool:
     return (isinstance(node, ast.UnaryOp)
             and isinstance(node.op, ast.USub)
@@ -146,6 +181,11 @@ class MaskSeamPass:
             "id arrays are masked with sign tests (tombstones are <= -2,"
             " not -1); Pallas one-hot merges need finite sentinels, "
             "never inf in a product",
+        "admission-seam":
+            "filtered-search admission bits fold into the validity "
+            "mask and take the finite _ACC_WORST sentinel — never "
+            "multiplied into distances, selected against inf, or "
+            "compared to non-zero constants",
         "staging-ring":
             "windowed-merge staging rings hold the finite _ACC_WORST "
             "sentinel: no inf literals or rogue huge-float fills may "
@@ -170,6 +210,7 @@ class MaskSeamPass:
                 if fused_mod and isinstance(node, ast.Call):
                     self._check_scratch(mod, node, out)
                 if pallas:
+                    self._check_admission(mod, node, out)
                     if (isinstance(node, ast.BinOp)
                             and isinstance(node.op, (ast.Mult,
                                                      ast.MatMult))
@@ -209,6 +250,66 @@ class MaskSeamPass:
                     f"— mask with a sign test (< 0 / >= 0) or clamp "
                     f"through grouped.finalize_topk first"))
                 return
+
+    def _check_admission(self, mod, node: ast.AST,
+                         out: List[Diagnostic]) -> None:
+        # admission bit multiplied (or matmul'd/dotted) into a value:
+        # a rejected candidate becomes distance 0 — the BEST hit — and
+        # any inf partner NaN-poisons the row.  The bit is a mask, not
+        # a scale factor.
+        if (isinstance(node, ast.BinOp)
+                and isinstance(node.op, (ast.Mult, ast.MatMult))
+                and (contains(node.left, _admish_expr)
+                     or contains(node.right, _admish_expr))):
+            out.append(Diagnostic(
+                mod.rel, node.lineno, "admission-seam",
+                "admission bit used as a product operand — a rejected "
+                "candidate would score 0 (the best distance!) instead "
+                "of worst; fold it into the validity mask (invalid | "
+                "(adm == 0)) so it takes the finite _ACC_WORST "
+                "sentinel"))
+            return
+        if (isinstance(node, ast.Call)
+                and terminal_name(node.func) in _DOT_CALLS
+                and any(contains(a, _admish_expr) for a in node.args)):
+            out.append(Diagnostic(
+                mod.rel, node.lineno, "admission-seam",
+                "admission bits flow into a dot/matmul — fold them "
+                "into the validity mask and the finite _ACC_WORST "
+                "sentinel, never into an accumulator product"))
+            return
+        # where/select on an admission condition with an inf branch:
+        # the folded value must be the finite sentinel
+        if (isinstance(node, ast.Call)
+                and terminal_name(node.func) in _SELECT_CALLS
+                and node.args
+                and contains(node.args[0], _admish_expr)
+                and any(contains(a, _is_inf) for a in node.args[1:])):
+            out.append(Diagnostic(
+                mod.rel, node.lineno, "admission-seam",
+                "admission select folds rejected candidates to inf — "
+                "the windowed one-hot merge multiplies masked rows "
+                "(0*inf=NaN); fold to the finite 3.0e38 sentinel "
+                "(_ACC_WORST) instead"))
+            return
+        # adm == 1 (or any non-zero constant): the unpack contract is
+        # only 0 vs non-zero — test the zero side
+        if isinstance(node, ast.Compare):
+            sides = [node.left] + list(node.comparators)
+            if not any(_admish_expr(s) for s in sides):
+                return
+            for s in sides:
+                if (isinstance(s, ast.Constant)
+                        and isinstance(s.value, (int, float))
+                        and not isinstance(s.value, bool)
+                        and s.value != 0):
+                    out.append(Diagnostic(
+                        mod.rel, node.lineno, "admission-seam",
+                        "admission bit compared against a non-zero "
+                        "constant — the unpack contract is 0 vs "
+                        "non-zero; test '== 0' / '> 0' so a widened "
+                        "encoding stays correct"))
+                    return
 
     def _check_ring_write(self, mod, node, out: List[Diagnostic]) -> None:
         targets = (node.targets if isinstance(node, ast.Assign)

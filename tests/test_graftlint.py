@@ -303,6 +303,77 @@ class TestMaskSeam:
 
 
 # ---------------------------------------------------------------------------
+# admission-seam (round 20: filtered-search admission bits in kernels)
+
+class TestAdmissionSeam:
+    def test_admission_bit_in_product_flagged(self):
+        # a rejected candidate scored 0*d = 0 would become the BEST hit
+        src = ("def kernel(adm, d):\n"
+               "    return d * adm\n")
+        diags = lint({"raft_tpu/ops/foo_pallas.py": src})
+        assert [d.rule for d in diags] == ["admission-seam"]
+        assert "_ACC_WORST" in diags[0].message
+
+    def test_admission_bit_in_dot_flagged(self):
+        src = ("import jax.numpy as jnp\n"
+               "def kernel(adm_block, oh):\n"
+               "    return jnp.dot(oh, adm_block)\n")
+        diags = lint({"raft_tpu/ops/foo_pallas.py": src})
+        assert [d.rule for d in diags] == ["admission-seam"]
+
+    def test_admission_select_to_inf_flagged(self):
+        src = ("import jax.numpy as jnp\n"
+               "def kernel(adm, d):\n"
+               "    return jnp.where(adm > 0, d, jnp.inf)\n")
+        diags = lint({"raft_tpu/ops/foo_pallas.py": src})
+        assert [d.rule for d in diags] == ["admission-seam"]
+        assert "3.0e38" in diags[0].message
+
+    def test_admission_select_to_finite_sentinel_clean(self):
+        src = ("import jax.numpy as jnp\n"
+               "def kernel(adm, d):\n"
+               "    return jnp.where(adm > 0, d, 3.0e38)\n")
+        assert lint({"raft_tpu/ops/foo_pallas.py": src}) == []
+
+    def test_admission_nonzero_constant_compare_flagged(self):
+        # the unpack contract is 0 vs non-zero, not exactly-1
+        src = ("def kernel(adm, invalid):\n"
+               "    return invalid | (adm == 1)\n")
+        diags = lint({"raft_tpu/ops/foo_pallas.py": src})
+        assert [d.rule for d in diags] == ["admission-seam"]
+        assert "non-zero" in diags[0].message
+
+    def test_mask_fold_idiom_clean(self):
+        # the blessed seam: fold into the validity mask, zero tests only
+        src = ("def kernel(adm, invalid, ok):\n"
+               "    invalid = invalid | (adm == 0)\n"
+               "    ok = ok & (adm > 0)\n"
+               "    return invalid, ok\n")
+        assert lint({"raft_tpu/ops/foo_pallas.py": src}) == []
+
+    def test_admission_rule_scoped_to_pallas(self):
+        # host-side code packs/ANDs admission words however it likes
+        src = ("def host(adm_words, scale):\n"
+               "    return adm_words * scale\n")
+        assert lint({"raft_tpu/filters/foo.py": src}) == []
+
+    def test_unpack_shift_mask_clean(self):
+        # the in-kernel unpack (shift/and on the packed ref) is not a
+        # product seam
+        src = ("def unpack(adm_ref, cap):\n"
+               "    aw = adm_ref[0]\n"
+               "    bits = (aw[:, :, None] >> 3) & 1\n"
+               "    return bits\n")
+        assert lint({"raft_tpu/ops/foo_pallas.py": src}) == []
+
+    def test_admission_suppression_honored(self):
+        src = ("def kernel(adm, d):\n"
+               "    return d * adm"
+               "  # graftlint: disable=admission-seam -- reason\n")
+        assert lint({"raft_tpu/ops/foo_pallas.py": src}) == []
+
+
+# ---------------------------------------------------------------------------
 # boundary-guard
 
 class TestBoundaryGuard:
@@ -812,7 +883,7 @@ class TestLiveTree:
         assert {"recompile-hazard", "generation-discipline", "mask-seam",
                 "boundary-guard", "raw-perf-counter", "bare-sleep",
                 "registry-consistency", "staging-ring",
-                "scratch-budget"} <= set(rule_docs())
+                "scratch-budget", "admission-seam"} <= set(rule_docs())
 
 
 # ---------------------------------------------------------------------------
